@@ -1,0 +1,13 @@
+"""photon-tpu: a TPU-native framework for GLM and GAME/GLMix training.
+
+A ground-up JAX/XLA re-design of the capabilities of LinkedIn Photon ML
+(reference mounted at /root/reference): generalized linear models (linear,
+logistic, Poisson regression, smoothed-hinge SVM) and GAME mixed-effect
+models (one fixed-effect GLM plus per-entity random-effect GLMs trained by
+coordinate descent) — executed as SPMD programs on a TPU device mesh instead
+of Spark RDD jobs.
+"""
+
+__version__ = "0.1.0"
+
+from photon_tpu.types import TaskType, OptimizerType, VarianceComputationType  # noqa: F401

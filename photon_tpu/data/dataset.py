@@ -1,0 +1,49 @@
+"""Core device-resident dataset containers.
+
+Reference: photon-lib data/LabeledPoint.scala:62 (label, features, offset,
+weight; margin = x.theta + offset) and data/DataPoint.scala. On TPU a
+"dataset" is a struct-of-arrays batch with static shapes; a whole Spark
+RDD[LabeledPoint] becomes one (possibly batch-sharded) DataBatch.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.ops import features as F
+
+Array = jax.Array
+
+
+class DataBatch(NamedTuple):
+    """Struct-of-arrays equivalent of RDD[LabeledPoint].
+
+    ``offsets`` also carries coordinate-descent residual scores: the
+    reference's ``Dataset.addScoresToOffsets`` becomes plain addition here.
+    """
+
+    features: F.FeatureMatrix
+    labels: Array                      # [n]
+    offsets: Optional[Array] = None    # [n]
+    weights: Optional[Array] = None    # [n]
+
+    @property
+    def num_samples(self) -> int:
+        return F.num_samples(self.features)
+
+    def with_offsets(self, offsets: Optional[Array]) -> "DataBatch":
+        return self._replace(offsets=offsets)
+
+    def add_scores_to_offsets(self, scores: Array) -> "DataBatch":
+        """Reference: Dataset.addScoresToOffsets — residual injection for
+        coordinate descent (FixedEffectDataset.scala:40)."""
+        base = self.offsets if self.offsets is not None else jnp.zeros_like(scores)
+        return self._replace(offsets=base + scores)
+
+    def total_weight(self) -> Array:
+        if self.weights is None:
+            return jnp.asarray(float(self.num_samples), dtype=self.labels.dtype)
+        return jnp.sum(self.weights)

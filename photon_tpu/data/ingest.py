@@ -1,0 +1,123 @@
+"""Host-side data ingestion: LibSVM text and synthetic generators.
+
+Reference: the demo workflow trains on a1a LibSVM data converted to Avro
+(README.md:229-268); the legacy IO layer reads LibSVM directly
+(io/deprecated/LibSVMInputDataFormat.scala). Avro container IO lives in
+photon_tpu/io (pure-Python codec — no Spark, no HDFS).
+
+Everything here produces numpy, then pads to static shapes for the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.ops import features as F
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class LibSVMData:
+    labels: np.ndarray          # [n] float, mapped to {0, 1} from {-1, +1}
+    rows: list                  # list of (indices, values)
+    dim: int
+    max_nnz: int
+
+
+def read_libsvm(path: str, dim: Optional[int] = None,
+                add_intercept: bool = True,
+                zero_based: bool = False) -> LibSVMData:
+    """Parse LibSVM text. Labels in {-1,1} or {0,1} are mapped to {0,1}.
+    If ``add_intercept``, a constant-1 feature is appended at index dim-1."""
+    labels = []
+    rows = []
+    max_idx = -1
+    max_nnz = 0
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            idx = []
+            val = []
+            for tok in parts[1:]:
+                if tok.startswith("#"):
+                    break
+                i, v = tok.split(":")
+                j = int(i) - (0 if zero_based else 1)
+                idx.append(j)
+                val.append(float(v))
+            if idx:
+                max_idx = max(max_idx, max(idx))
+            rows.append((np.asarray(idx, np.int32), np.asarray(val, np.float64)))
+            max_nnz = max(max_nnz, len(idx))
+
+    y = np.asarray(labels)
+    if set(np.unique(y)) <= {-1.0, 1.0}:
+        y = (y + 1.0) / 2.0
+
+    d = dim if dim is not None else max_idx + 1
+    if add_intercept:
+        rows = [(np.append(r[0], d), np.append(r[1], 1.0)) for r in rows]
+        d += 1
+        max_nnz += 1
+    return LibSVMData(labels=y, rows=rows, dim=d, max_nnz=max_nnz)
+
+
+def to_batch(data: LibSVMData, dtype=np.float32,
+             pad_to: Optional[int] = None) -> DataBatch:
+    """LibSVM rows -> padded-ELL DataBatch; optionally pad the sample count
+    to a multiple (pad rows get weight 0)."""
+    n = len(data.rows)
+    n_pad = pad_to if pad_to is not None else n
+    rows = list(data.rows) + [(np.zeros(0, np.int32), np.zeros(0))] * (n_pad - n)
+    feats = F.from_rows(rows, data.dim, dtype=dtype, max_nnz=data.max_nnz)
+    labels = np.zeros(n_pad, dtype=dtype)
+    labels[:n] = data.labels
+    weights = np.zeros(n_pad, dtype=dtype)
+    weights[:n] = 1.0
+    return DataBatch(
+        features=feats,
+        labels=jnp.asarray(labels),
+        offsets=None,
+        weights=jnp.asarray(weights),
+    )
+
+
+# -- synthetic generators (reference: SparkTestUtils.scala:66+) -------------
+
+def generate_binary_classification(
+    rng: np.random.Generator, n: int, dim: int,
+    sparsity: float = 0.0, intercept: bool = False,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(X, y, w_true): well-separated logistic data with optional sparsity."""
+    X = rng.normal(size=(n, dim))
+    if sparsity > 0:
+        X = X * (rng.random((n, dim)) >= sparsity)
+    if intercept:
+        X[:, -1] = 1.0
+    w = rng.normal(size=dim)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-X @ w))).astype(np.float64)
+    return X, y, w
+
+
+def generate_poisson(rng: np.random.Generator, n: int, dim: int,
+                     scale: float = 0.3) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    X = rng.normal(size=(n, dim)) * scale
+    w = rng.normal(size=dim) * 0.5
+    y = rng.poisson(np.exp(X @ w)).astype(np.float64)
+    return X, y, w
+
+
+def generate_linear(rng: np.random.Generator, n: int, dim: int,
+                    noise: float = 0.1) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    X = rng.normal(size=(n, dim))
+    w = rng.normal(size=dim)
+    y = X @ w + noise * rng.normal(size=n)
+    return X, y, w

@@ -1,0 +1,58 @@
+"""Down-sampling as static-shape masked reweighting.
+
+Reference: sampling/DownSampler.scala:50, BinaryClassificationDownSampler
+.scala:28-50 (keep positives, sample negatives at rate r, reweight kept
+negatives by 1/r), DefaultDownSampler (uniform sample + reweight),
+DownSamplerHelper.buildFactory.
+
+On TPU we never filter (dynamic shapes): dropped samples get weight 0, kept
+down-sampled ones get weight/rate — expectation-preserving, identical to
+the reference's semantics. Determinism under recompute is free: the mask is
+a pure function of the PRNG key (the reference needs byteswap64 seeding
+tricks for this — RandomEffectDataset.scala:212-215).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+def _weights_of(batch: DataBatch) -> Array:
+    if batch.weights is not None:
+        return batch.weights
+    return jnp.ones_like(batch.labels)
+
+
+def downsample_default(batch: DataBatch, rate: float, key: jax.Array) -> DataBatch:
+    """Uniform down-sample at ``rate``, reweighting kept samples by 1/rate."""
+    keep = jax.random.uniform(key, batch.labels.shape) < rate
+    w = _weights_of(batch) * jnp.where(keep, 1.0 / rate, 0.0)
+    return batch._replace(weights=w)
+
+
+def downsample_binary(batch: DataBatch, rate: float, key: jax.Array) -> DataBatch:
+    """Keep all positives; sample negatives at ``rate`` and reweight them by
+    1/rate (reference: BinaryClassificationDownSampler.scala:28-50)."""
+    pos = batch.labels > 0.5
+    keep_neg = jax.random.uniform(key, batch.labels.shape) < rate
+    w = _weights_of(batch) * jnp.where(pos, 1.0, jnp.where(keep_neg, 1.0 / rate, 0.0))
+    return batch._replace(weights=w)
+
+
+def downsampler_for_task(task: TaskType):
+    """Reference: DownSamplerHelper.buildFactory — binary tasks get the
+    class-aware sampler."""
+    return downsample_binary if task.is_classification else downsample_default
+
+
+def maybe_downsample(batch: DataBatch, task: TaskType, rate: float,
+                     key: jax.Array) -> DataBatch:
+    if rate >= 1.0 or rate <= 0.0:
+        return batch
+    return downsampler_for_task(task)(batch, rate, key)

@@ -1,0 +1,56 @@
+"""Plain-GLM training over a regularization path with warm starts.
+
+Reference: photon-api ModelTraining.trainGeneralizedLinearModel
+(ModelTraining.scala:34,73-108; warm-start chain :134-147) — the engine
+behind the legacy Driver's lambda sweep.
+
+Because the L2/L1 weights are traced arguments of one jit-compiled solve
+(optim/problem.py), the whole path reuses a single XLA program; warm
+starting is just feeding the previous lambda's coefficients as init.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.ops.normalization import NormalizationContext, no_normalization
+from photon_tpu.optim.base import SolverResult
+from photon_tpu.optim.problem import GLMOptimizationConfiguration, GlmOptimizationProblem
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+def train_generalized_linear_model(
+    task: TaskType,
+    batch: DataBatch,
+    dim: int,
+    config: GLMOptimizationConfiguration = GLMOptimizationConfiguration(),
+    regularization_weights: Sequence[float] = (0.0,),
+    norm: NormalizationContext = no_normalization(),
+    warm_start: bool = True,
+    initial: Optional[Array] = None,
+    dtype=jnp.float32,
+) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, SolverResult]]:
+    """Train one GLM per regularization weight, warm-starting along the path
+    (descending lambda order is the caller's choice, as in the reference).
+
+    Returns ({lambda: model}, {lambda: solver stats}).
+    """
+    problem = GlmOptimizationProblem(task, config, norm)
+    models: Dict[float, GeneralizedLinearModel] = {}
+    stats: Dict[float, SolverResult] = {}
+    coef = initial
+    for lam in regularization_weights:
+        model, result = problem.run(
+            batch, initial=coef, dim=dim, dtype=dtype, regularization_weight=lam)
+        models[lam] = model
+        stats[lam] = result
+        if warm_start:
+            coef = result.coef
+    return models, stats

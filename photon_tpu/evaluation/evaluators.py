@@ -1,0 +1,151 @@
+"""Evaluation metrics as jittable sharded sorts / segment ops.
+
+Reference: photon-lib evaluation/EvaluatorType.scala:56-65 (AUC, AUPR,
+RMSE, LogisticLoss, PoissonLoss, SmoothedHingeLoss, SquaredLoss, each with
+a better-than direction), photon-api evaluation/
+AreaUnderROCCurveLocalEvaluator.scala:33 (Mann-Whitney with tie handling),
+PrecisionAtKLocalEvaluator, RMSEEvaluator.
+
+All metrics are weighted and tie-correct; scores/labels/weights are [n]
+arrays (pad samples get weight 0, so static-shape padding is safe).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.ops import losses as L
+
+Array = jax.Array
+
+
+class EvaluatorType(enum.Enum):
+    AUC = "AUC"
+    AUPR = "AUPR"
+    RMSE = "RMSE"
+    LOGISTIC_LOSS = "LOGISTIC_LOSS"
+    POISSON_LOSS = "POISSON_LOSS"
+    SMOOTHED_HINGE_LOSS = "SMOOTHED_HINGE_LOSS"
+    SQUARED_LOSS = "SQUARED_LOSS"
+
+    @property
+    def bigger_is_better(self) -> bool:
+        return self in (EvaluatorType.AUC, EvaluatorType.AUPR)
+
+    def better_than(self, a: float, b: float) -> bool:
+        """Reference: EvaluatorType's per-metric comparison op."""
+        return a > b if self.bigger_is_better else a < b
+
+
+def _weights(scores: Array, weights: Optional[Array]) -> Array:
+    return jnp.ones_like(scores) if weights is None else weights
+
+
+def auc(scores: Array, labels: Array, weights: Optional[Array] = None) -> Array:
+    """Weighted, tie-corrected area under the ROC curve via Mann-Whitney:
+    AUC = sum_{pos i} w_i (W_neg<s_i + W_neg=s_i / 2) / (W_pos W_neg)."""
+    w = _weights(scores, weights)
+    order = jnp.argsort(scores)
+    s = scores[order]
+    y = labels[order] > 0.5
+    ww = w[order]
+
+    neg_w = jnp.where(y, 0.0, ww)
+    cum_neg = jnp.cumsum(neg_w)
+    # tie-group boundaries (searchsorted is jittable on sorted input)
+    first = jnp.searchsorted(s, s, side="left")
+    last = jnp.searchsorted(s, s, side="right")
+    below = jnp.where(first > 0, cum_neg[jnp.maximum(first - 1, 0)], 0.0)
+    upto = cum_neg[last - 1]
+    eq = upto - below
+
+    pos_w = jnp.where(y, ww, 0.0)
+    num = jnp.sum(pos_w * (below + 0.5 * eq))
+    w_pos = jnp.sum(pos_w)
+    w_neg = jnp.sum(neg_w)
+    return num / jnp.maximum(w_pos * w_neg, jnp.finfo(scores.dtype).tiny)
+
+
+def aupr(scores: Array, labels: Array, weights: Optional[Array] = None) -> Array:
+    """Weighted average precision (step interpolation, sklearn-style)."""
+    w = _weights(scores, weights)
+    order = jnp.argsort(-scores)
+    y = labels[order] > 0.5
+    ww = w[order]
+    pos_w = jnp.where(y, ww, 0.0)
+    cum_pos = jnp.cumsum(pos_w)
+    cum_all = jnp.cumsum(ww)
+    precision = cum_pos / jnp.maximum(cum_all, jnp.finfo(scores.dtype).tiny)
+    total_pos = jnp.maximum(cum_pos[-1], jnp.finfo(scores.dtype).tiny)
+    return jnp.sum(precision * pos_w) / total_pos
+
+
+def rmse(scores: Array, labels: Array, weights: Optional[Array] = None) -> Array:
+    w = _weights(scores, weights)
+    se = w * (scores - labels) ** 2
+    return jnp.sqrt(jnp.sum(se) / jnp.maximum(jnp.sum(w), 1e-30))
+
+
+def _mean_loss(loss: L.PointwiseLoss) -> Callable[..., Array]:
+    def fn(scores: Array, labels: Array, weights: Optional[Array] = None) -> Array:
+        w = _weights(scores, weights)
+        l, _ = loss.loss_and_dz(scores, labels)
+        return jnp.sum(w * l) / jnp.maximum(jnp.sum(w), 1e-30)
+
+    return fn
+
+
+logistic_loss_eval = _mean_loss(L.LogisticLoss)
+poisson_loss_eval = _mean_loss(L.PoissonLoss)
+smoothed_hinge_loss_eval = _mean_loss(L.SmoothedHingeLoss)
+
+
+def squared_loss_eval(scores: Array, labels: Array,
+                      weights: Optional[Array] = None) -> Array:
+    w = _weights(scores, weights)
+    l, _ = L.SquaredLoss.loss_and_dz(scores, labels)
+    return jnp.sum(w * l) / jnp.maximum(jnp.sum(w), 1e-30)
+
+
+def precision_at_k(k: int, scores: Array, labels: Array,
+                   weights: Optional[Array] = None) -> Array:
+    """Unweighted precision@k (reference: PrecisionAtKLocalEvaluator; weights
+    are ignored there too, but padded samples must carry weight 0 and are
+    excluded here via -inf scores)."""
+    w = _weights(scores, weights)
+    masked = jnp.where(w > 0, scores, -jnp.inf)
+    order = jnp.argsort(-masked)
+    topk = order[:k]
+    return jnp.mean(labels[topk] > 0.5)
+
+
+EVALUATORS: Dict[EvaluatorType, Callable[..., Array]] = {
+    EvaluatorType.AUC: auc,
+    EvaluatorType.AUPR: aupr,
+    EvaluatorType.RMSE: rmse,
+    EvaluatorType.LOGISTIC_LOSS: logistic_loss_eval,
+    EvaluatorType.POISSON_LOSS: poisson_loss_eval,
+    EvaluatorType.SMOOTHED_HINGE_LOSS: smoothed_hinge_loss_eval,
+    EvaluatorType.SQUARED_LOSS: squared_loss_eval,
+}
+
+
+def evaluate(evaluator: EvaluatorType, scores: Array, labels: Array,
+             weights: Optional[Array] = None) -> Array:
+    return EVALUATORS[evaluator](scores, labels, weights)
+
+
+def default_evaluator_for_task(task) -> EvaluatorType:
+    """Reference: the per-task primary metric used for model selection."""
+    from photon_tpu.types import TaskType
+
+    return {
+        TaskType.LOGISTIC_REGRESSION: EvaluatorType.AUC,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: EvaluatorType.AUC,
+        TaskType.LINEAR_REGRESSION: EvaluatorType.RMSE,
+        TaskType.POISSON_REGRESSION: EvaluatorType.POISSON_LOSS,
+    }[task]

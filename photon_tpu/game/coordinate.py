@@ -1,0 +1,200 @@
+"""GAME coordinates: per-coordinate training + scoring.
+
+Reference: photon-lib algorithm/Coordinate.scala:60-63 (update against
+residual-injected offsets), photon-api algorithm/FixedEffectCoordinate
+.scala:136-165 (update = DistributedOptimizationProblem.runWithSampling,
+score = broadcast dot), algorithm/RandomEffectCoordinate.scala:104-232
+(update = co-partitioned join + per-entity local solves in mapValues;
+score = join + dot + passive broadcast scoring), ModelCoordinate.scala:28
+(frozen coordinates for partial retraining).
+
+TPU re-design: the fixed effect trains one jitted solve over the sharded
+flat batch; the random effect trains ALL entities at once with a vmap-ed
+L-BFGS over the entity-blocked dataset (per-entity convergence masking via
+the while_loop batching rule) — the reference's millions of independent
+Breeze solves become one SPMD program on the entity-sharded mesh axis.
+Residual injection is a gather; score emission is a scatter-add.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.data.sampling import maybe_downsample
+from photon_tpu.function.objective import GLMObjective, Hyper
+from photon_tpu.game.model import FixedEffectModel, RandomEffectModel
+from photon_tpu.game.random_effect import RandomEffectDataset
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.ops import features as F
+from photon_tpu.ops.losses import loss_for_task
+from photon_tpu.optim import lbfgs, owlqn, tron
+from photon_tpu.optim.problem import GLMOptimizationConfiguration, GlmOptimizationProblem
+from photon_tpu.types import OptimizerType, TaskType
+
+Array = jax.Array
+
+
+class FixedEffectCoordinate:
+    """Reference: FixedEffectCoordinate.scala:136-165."""
+
+    def __init__(
+        self,
+        batch: DataBatch,
+        dim: int,
+        feature_shard_id: str,
+        task: TaskType,
+        config: GLMOptimizationConfiguration = GLMOptimizationConfiguration(),
+        norm=None,
+        sampling_key: Optional[jax.Array] = None,
+    ):
+        from photon_tpu.ops.normalization import no_normalization
+
+        self.batch = batch
+        self.dim = dim
+        self.feature_shard_id = feature_shard_id
+        self.task = task
+        self.config = config
+        self.problem = GlmOptimizationProblem(task, config, norm or no_normalization())
+        self._sampling_key = sampling_key
+
+    def update_model(
+        self, prev: Optional[FixedEffectModel], residual_scores: Optional[Array]
+    ) -> FixedEffectModel:
+        """Train against residual-injected offsets
+        (= dataset.addScoresToOffsets + runWithSampling)."""
+        batch = self.batch
+        if residual_scores is not None:
+            batch = batch.add_scores_to_offsets(residual_scores)
+        if self._sampling_key is not None and self.config.down_sampling_rate < 1.0:
+            batch = maybe_downsample(batch, self.task,
+                                     self.config.down_sampling_rate, self._sampling_key)
+        init = prev.model.coefficients.means if prev is not None else None
+        model, _ = self.problem.run(
+            batch, initial=init, dim=self.dim, dtype=batch.labels.dtype,
+            # read the weight from the coordinate's (possibly sweep-updated)
+            # config, not the problem's construction-time copy
+            regularization_weight=self.config.regularization_weight)
+        return FixedEffectModel(model, self.feature_shard_id)
+
+    @functools.cached_property
+    def _score_fn(self):
+        feats = self.batch.features
+
+        @jax.jit
+        def score(coef: Array) -> Array:
+            return F.matvec(feats, coef)
+
+        return score
+
+    def score(self, model: FixedEffectModel) -> Array:
+        """Training-data scores WITHOUT offsets — coordinate-descent score
+        algebra sums raw model scores (reference: scoreForCoordinateDescent)."""
+        return self._score_fn(model.model.coefficients.means)
+
+
+class RandomEffectCoordinate:
+    """Reference: RandomEffectCoordinate.scala:104-232 — redesigned as one
+    vmapped solve over the entity-blocked dataset."""
+
+    def __init__(
+        self,
+        dataset: RandomEffectDataset,
+        num_flat_samples: int,
+        random_effect_type: str,
+        feature_shard_id: str,
+        task: TaskType,
+        config: GLMOptimizationConfiguration = GLMOptimizationConfiguration(),
+    ):
+        self.dataset = dataset
+        self.n = num_flat_samples
+        self.random_effect_type = random_effect_type
+        self.feature_shard_id = feature_shard_id
+        self.task = task
+        self.config = config
+        self.objective = GLMObjective(loss_for_task(task))
+
+    @functools.cached_property
+    def _solve_fn(self):
+        ds = self.dataset
+        obj = self.objective
+        opt = self.config.optimizer
+        solver_cfg = opt.solver_config()
+        opt_type = opt.optimizer_type
+
+        def solve_one(feat_idx, feat_val, labels, offsets, weights, x0, l2, l1):
+            batch = DataBatch(F.SparseFeatures(feat_idx, feat_val),
+                              labels, offsets, weights)
+            hyper = Hyper(l2_weight=l2)
+            vg = lambda c: obj.value_and_gradient(c, batch, hyper)
+            if opt_type == OptimizerType.OWLQN:
+                return owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg).coef
+            if opt_type == OptimizerType.TRON:
+                hv = lambda c, v: obj.hessian_vector(c, v, batch, hyper)
+                return tron.minimize(vg, hv, x0, config=solver_cfg).coef
+            return lbfgs.minimize(vg, x0, config=solver_cfg).coef
+
+        @jax.jit
+        def solve_all(residual_flat: Optional[Array], coef0: Array, l2: Array, l1: Array) -> Array:
+            offsets = ds.offsets
+            if residual_flat is not None:
+                # gather residuals by flat row; pad rows index == n -> fill 0
+                res = residual_flat.at[ds.sample_rows].get(mode="fill", fill_value=0.0)
+                offsets = offsets + res
+            return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None))(
+                ds.features.indices, ds.features.values,
+                ds.labels, offsets, ds.weights, coef0, l2, l1)
+
+        return solve_all
+
+    def update_model(
+        self, prev: Optional[RandomEffectModel], residual_scores: Optional[Array]
+    ) -> RandomEffectModel:
+        ds = self.dataset
+        dtype = ds.labels.dtype
+        coef0 = (prev.coefficients if prev is not None
+                 else jnp.zeros((ds.num_entities, ds.projected_dim), dtype))
+        lam = self.config.regularization_weight
+        l2 = jnp.asarray(self.config.regularization.l2_weight(lam), dtype)
+        l1 = jnp.asarray(self.config.regularization.l1_weight(lam), dtype)
+        coefs = self._solve_fn(residual_scores, coef0, l2, l1)
+        return RandomEffectModel(
+            coefficients=coefs,
+            random_effect_type=self.random_effect_type,
+            feature_shard_id=self.feature_shard_id,
+            task=self.task,
+            variances=None,
+        )
+
+    @functools.cached_property
+    def _score_fn(self):
+        ds = self.dataset
+        n = self.n
+
+        @jax.jit
+        def score(coef_block: Array) -> Array:
+            # active: per-entity margins, scattered to flat rows
+            margins = jnp.sum(
+                ds.features.values
+                * jax.vmap(lambda c, i: c[i])(coef_block, ds.features.indices),
+                axis=-1,
+            )
+            flat = jnp.zeros((n,), coef_block.dtype)
+            flat = flat.at[ds.sample_rows.ravel()].add(
+                margins.ravel(), mode="drop")
+            # passive: gather entity coef rows (out-of-range entity -> 0)
+            pcoef = coef_block.at[ds.passive_entity].get(mode="fill", fill_value=0.0)
+            pmargin = jnp.sum(ds.passive_features.values
+                              * jnp.take_along_axis(pcoef, ds.passive_features.indices, axis=1),
+                              axis=-1)
+            flat = flat.at[ds.passive_rows].add(pmargin, mode="drop")
+            return flat
+
+        return score
+
+    def score(self, model: RandomEffectModel) -> Array:
+        return self._score_fn(model.coefficients)

@@ -1,0 +1,118 @@
+"""GAME data structures: host-side columnar frame -> device datasets.
+
+Reference: photon-lib data/GameDatum.scala:40-68 (response/offset/weight,
+per-shard feature vectors, id-tag map), photon-api data/GameConverters
+.scala:28 (DataFrame row -> GameDatum), data/FixedEffectDataset.scala:31,
+data/InputColumnsNames.scala:25.
+
+TPU re-design: the RDD[(uid, GameDatum)] becomes a host-side columnar
+``GameDataFrame`` (numpy struct-of-arrays + per-shard sparse rows) from
+which static-shape device views are built: a flat uid-major DataBatch per
+fixed-effect coordinate, entity-blocked padded arrays per random-effect
+coordinate (game/random_effect.py). Sample identity is the row position —
+uids never leave the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.ops import features as F
+
+SparseRows = List[Tuple[np.ndarray, np.ndarray]]  # per-row (indices, values)
+
+
+@dataclasses.dataclass
+class FeatureShard:
+    """One feature space: sparse rows or a dense matrix, plus its dim."""
+
+    rows: Union[SparseRows, np.ndarray]
+    dim: int
+
+    @property
+    def is_dense(self) -> bool:
+        return isinstance(self.rows, np.ndarray)
+
+    def max_nnz(self) -> int:
+        if self.is_dense:
+            return self.dim
+        return max((len(r[0]) for r in self.rows), default=0)
+
+
+@dataclasses.dataclass
+class GameDataFrame:
+    """Host-side columnar GAME dataset (the RDD[(uid, GameDatum)] stand-in).
+
+    ``id_tags[re_type][i]`` is sample i's entity id string for that
+    random-effect type (reference: GameDatum.idTagToValueMap).
+    """
+
+    num_samples: int
+    response: np.ndarray                       # [n]
+    feature_shards: Dict[str, FeatureShard]
+    offsets: Optional[np.ndarray] = None       # [n]
+    weights: Optional[np.ndarray] = None       # [n]
+    id_tags: Dict[str, Sequence[str]] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        n = self.num_samples
+        assert len(self.response) == n
+        for tag, vals in self.id_tags.items():
+            assert len(vals) == n, f"id tag {tag} length mismatch"
+
+    def shard_features(self, shard_id: str, dtype=np.float32) -> F.FeatureMatrix:
+        shard = self.feature_shards[shard_id]
+        if shard.is_dense:
+            return jnp.asarray(shard.rows, dtype)
+        return F.from_rows(shard.rows, shard.dim, dtype=dtype)
+
+    def fixed_effect_batch(self, shard_id: str, dtype=np.float32) -> DataBatch:
+        """Reference: FixedEffectDataset — flat uid-major batch over one
+        feature shard."""
+        return DataBatch(
+            features=self.shard_features(shard_id, dtype),
+            labels=jnp.asarray(self.response, dtype),
+            offsets=None if self.offsets is None else jnp.asarray(self.offsets, dtype),
+            weights=None if self.weights is None else jnp.asarray(self.weights, dtype),
+        )
+
+
+class EntityVocabulary:
+    """String REId <-> dense entity index, per random-effect type.
+
+    Built from training data; evaluation data maps unseen entities to -1
+    (zero score contribution — matching the reference, where a missing
+    per-entity model contributes nothing).
+    """
+
+    def __init__(self):
+        self._maps: Dict[str, Dict[str, int]] = {}
+        self._names: Dict[str, List[str]] = {}
+
+    def build(self, re_type: str, ids: Sequence[str]) -> np.ndarray:
+        m = self._maps.setdefault(re_type, {})
+        names = self._names.setdefault(re_type, [])
+        out = np.empty(len(ids), np.int32)
+        for i, s in enumerate(ids):
+            j = m.get(s)
+            if j is None:
+                j = len(names)
+                m[s] = j
+                names.append(s)
+            out[i] = j
+        return out
+
+    def lookup(self, re_type: str, ids: Sequence[str]) -> np.ndarray:
+        m = self._maps.get(re_type, {})
+        return np.asarray([m.get(s, -1) for s in ids], np.int32)
+
+    def names(self, re_type: str) -> List[str]:
+        return list(self._names.get(re_type, []))
+
+    def size(self, re_type: str) -> int:
+        return len(self._names.get(re_type, []))

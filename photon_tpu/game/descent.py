@@ -1,0 +1,133 @@
+"""Coordinate descent over GAME coordinates with score algebra.
+
+Reference: photon-lib algorithm/CoordinateDescent.scala:38 (run :93,
+descend :119): outer loop over update sequence x iterations; each
+coordinate trains against ``fullScore - ownScore`` (partial score,
+:197-204); score container updated incrementally (:223-234); validation
+after every coordinate update (:257-288); best model tracked by the primary
+validation metric over FULL sweeps only (:162-171, :292-325); locked
+coordinates (partial retraining) score but never train
+(coordinatesToTrain :45).
+
+TPU re-design: DataScores RDDs with +/- joins become flat [n] arrays with
+elementwise arithmetic; the persist/unpersist choreography disappears
+(arrays are device-resident); everything else keeps the reference's
+semantics exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.game.model import GameModel
+
+Array = jax.Array
+
+logger = logging.getLogger(__name__)
+
+# validation callback: GameModel -> {metric name: value}; first metric is primary
+ValidationFn = Callable[[GameModel], Dict[str, float]]
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateDescentConfig:
+    update_sequence: List[str]
+    num_iterations: int = 1
+    locked_coordinates: frozenset = frozenset()  # partial retraining
+
+
+@dataclasses.dataclass
+class CoordinateDescentResult:
+    model: GameModel
+    best_model: GameModel
+    validation_history: List[Dict[str, float]]
+    best_iteration: Optional[int] = None
+
+
+def run_coordinate_descent(
+    coordinates: Dict[str, object],
+    config: CoordinateDescentConfig,
+    num_samples: int,
+    initial_model: Optional[GameModel] = None,
+    validation_fn: Optional[ValidationFn] = None,
+    primary_metric_bigger_is_better: bool = True,
+    dtype=jnp.float32,
+) -> CoordinateDescentResult:
+    """Run GAME coordinate descent.
+
+    ``coordinates`` maps coordinate id -> FixedEffectCoordinate /
+    RandomEffectCoordinate (game/coordinate.py); locked ids must come with
+    their model inside ``initial_model`` (they only score).
+    """
+    to_train = [c for c in config.update_sequence
+                if c not in config.locked_coordinates]
+    if not to_train:
+        raise ValueError("no coordinates to train (all locked)")
+    for cid in config.update_sequence:
+        if cid not in coordinates:
+            raise KeyError(f"coordinate {cid!r} missing from coordinates")
+    for cid in config.locked_coordinates:
+        if initial_model is None or cid not in initial_model:
+            raise ValueError(f"locked coordinate {cid!r} needs an initial model")
+
+    models: Dict[str, object] = dict(initial_model.models) if initial_model else {}
+    scores: Dict[str, Array] = {}
+    full_score = jnp.zeros((num_samples,), dtype)
+
+    # initial scores for any pre-existing models (warm start / locked)
+    for cid in config.update_sequence:
+        if cid in models:
+            s = coordinates[cid].score(models[cid])
+            scores[cid] = s
+            full_score = full_score + s
+
+    best_model: Optional[GameModel] = None
+    best_metric: Optional[float] = None
+    best_iter: Optional[int] = None
+    history: List[Dict[str, float]] = []
+
+    for it in range(config.num_iterations):
+        for cid in config.update_sequence:
+            if cid in config.locked_coordinates:
+                continue
+            coord = coordinates[cid]
+            own = scores.get(cid)
+            partial = full_score - own if own is not None else full_score
+            residual = partial if len(config.update_sequence) > 1 else None
+
+            new_model = coord.update_model(models.get(cid), residual)
+            models[cid] = new_model
+            new_score = coord.score(new_model)
+            full_score = (full_score - own + new_score) if own is not None \
+                else (full_score + new_score)
+            scores[cid] = new_score
+
+            if validation_fn is not None:
+                metrics = validation_fn(GameModel(dict(models)))
+                history.append({"iteration": it, "coordinate": cid, **metrics})
+                logger.info("CD iter %d coord %s: %s", it, cid, metrics)
+
+        # best-model bookkeeping over FULL sweeps (reference :162-171)
+        if validation_fn is not None:
+            metrics = validation_fn(GameModel(dict(models)))
+            primary = next(iter(metrics.values()))
+            is_better = (best_metric is None
+                         or (primary > best_metric if primary_metric_bigger_is_better
+                             else primary < best_metric))
+            if is_better:
+                best_metric = primary
+                best_model = GameModel(dict(models))
+                best_iter = it
+
+    final = GameModel(dict(models))
+    return CoordinateDescentResult(
+        model=final,
+        best_model=best_model if best_model is not None else final,
+        validation_history=history,
+        best_iteration=best_iter,
+    )

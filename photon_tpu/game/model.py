@@ -1,0 +1,96 @@
+"""GAME model containers: fixed-effect and random-effect models.
+
+Reference: photon-api model/FixedEffectModel.scala:32 (broadcast GLM +
+feature shard), model/RandomEffectModel.scala:36 (RDD[(REId, GLM)] +
+random-effect type + shard; scoring = hash-join on REId), photon-lib
+model/GameModel.scala:32 (Map[CoordinateId -> DatumScoringModel] with
+type-consistency check), model/DatumScoringModel.scala:27-53.
+
+TPU re-design: a random-effect model is ONE dense [E, K] coefficient block
+in per-entity projected feature space (the IndexMapProjector equivalent is
+a static gather table built at ingest). The RDD hash-join becomes
+``coef_block[entity_index]`` — a gather. Entities are dense integer rows;
+the string REIds live in a host-side vocabulary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """GLM + feature shard id (reference: FixedEffectModel.scala:32)."""
+
+    model: GeneralizedLinearModel
+    feature_shard_id: str
+
+    @property
+    def task(self) -> TaskType:
+        return self.model.task
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity coefficient block in projected space.
+
+    ``coefficients``: [E, K] — row e is entity e's model over its projected
+    (local) feature slots; ``variances`` optional [E, K].
+    Entity row 0..E-1 indexes the ingest-time vocabulary (host side).
+    Unseen entities at scoring time get index -1 -> zero contribution.
+    """
+
+    coefficients: Array
+    random_effect_type: str
+    feature_shard_id: str
+    task: TaskType
+    variances: Optional[Array] = None
+
+    @property
+    def num_entities(self) -> int:
+        return self.coefficients.shape[0]
+
+    @property
+    def projected_dim(self) -> int:
+        return self.coefficients.shape[1]
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Map coordinate-id -> model, with task consistency
+    (reference: GameModel.scala:32,161)."""
+
+    models: Dict[str, object]  # FixedEffectModel | RandomEffectModel
+
+    def __post_init__(self):
+        tasks = {m.task for m in self.models.values()}
+        if len(tasks) > 1:
+            raise ValueError(f"inconsistent task types in GAME model: {tasks}")
+
+    def __getitem__(self, coordinate_id: str):
+        return self.models[coordinate_id]
+
+    def __contains__(self, coordinate_id: str) -> bool:
+        return coordinate_id in self.models
+
+    @property
+    def coordinate_ids(self):
+        return list(self.models.keys())
+
+    @property
+    def task(self) -> TaskType:
+        return next(iter(self.models.values())).task
+
+    def updated(self, coordinate_id: str, model) -> "GameModel":
+        new = dict(self.models)
+        new[coordinate_id] = model
+        return GameModel(new)

@@ -1,0 +1,299 @@
+"""Random-effect datasets: entity-blocked, padded, projected.
+
+Reference: photon-api data/RandomEffectDataset.scala (activeData grouped
+per-entity :46-55; build pipeline :207-340 — bounded groupBy via
+deterministic reservoir sampling with byteswap64 ordering keys :212-215,
+lower-bound filtering :319-340, Pearson feature selection :305, passive
+split :264), data/LocalDataset.scala (Pearson correlation :122),
+data/RandomEffectDataConfiguration (:68), projector/IndexMapProjectorRDD
+.scala:19,24,156 (per-entity compact reindex of observed features),
+data/MinHeapWithFixedCapacity.scala:29.
+
+TPU re-design: the groupByKey shuffle becomes ingest-time numpy grouping;
+per-entity index-map projection becomes a static [E, D_loc] gather table;
+active data is ONE padded block ([E, S] samples, ELL features in local
+slots) sharded over the mesh's entity axis; passive (score-only) samples
+are a flat gather-scored array. Reservoir capping orders samples by
+splitmix64(uid) — deterministic under recomputation exactly like the
+reference's byteswap64 trick, without needing it for fault tolerance
+(pure functions recompute identically anyway).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.game.dataset import EntityVocabulary, GameDataFrame
+from photon_tpu.ops import features as F
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataConfiguration:
+    """Reference: RandomEffectDataConfiguration (CoordinateDataConfiguration
+    .scala:68)."""
+
+    random_effect_type: str
+    feature_shard_id: str
+    active_data_lower_bound: Optional[int] = None   # min samples per entity
+    active_data_upper_bound: Optional[int] = None   # reservoir cap
+    features_to_samples_ratio: Optional[float] = None  # Pearson cap
+    keep_passive_data: bool = True
+
+
+class RandomEffectDataset(NamedTuple):
+    """Device-resident entity blocks (all pads carry weight 0)."""
+
+    # active block
+    features: F.SparseFeatures        # indices/values [E, S, K] in LOCAL slots
+    labels: Array                     # [E, S]
+    offsets: Array                    # [E, S]
+    weights: Array                    # [E, S] (0 on pads)
+    sample_rows: Array                # [E, S] int32 row in flat frame (n on pads)
+    # passive (score-only) samples
+    passive_features: F.SparseFeatures  # [P, K] local slots
+    passive_entity: Array               # [P] int32 entity row (E on pads)
+    passive_rows: Array                 # [P] int32 flat row (n on pads)
+    # projection table: local slot -> global feature index (-1 unused)
+    projection: Array                 # [E, D_loc] int32
+
+    @property
+    def num_entities(self) -> int:
+        return self.labels.shape[0]
+
+    @property
+    def max_samples(self) -> int:
+        return self.labels.shape[1]
+
+    @property
+    def projected_dim(self) -> int:
+        return self.projection.shape[1]
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Deterministic sample-ordering hash (role of byteswap64(uid),
+    RandomEffectDataset.scala:212-215)."""
+    z = (x.astype(np.uint64) + np.uint64(0x9E3779B97F4A7C15))
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def _pearson_scores(rows, labels, dim) -> np.ndarray:
+    """|Pearson corr| per observed global feature within one entity
+    (reference: LocalDataset.computePearsonCorrelationScore :122).
+    Constant features get score ~0 except the intercept-like all-constant
+    column, which the reference keeps (score 1)."""
+    n = len(rows)
+    sums = np.zeros(dim)
+    sq_sums = np.zeros(dim)
+    xy = np.zeros(dim)
+    seen = np.zeros(dim, bool)
+    ly = labels - labels.mean()
+    for i, (idx, val) in enumerate(rows):
+        sums[idx] += val
+        sq_sums[idx] += val * val
+        xy[idx] += val * ly[i]
+        seen[idx] = True
+    mean = sums / n
+    var = sq_sums / n - mean * mean
+    label_sd = labels.std()
+    with np.errstate(invalid="ignore", divide="ignore"):
+        corr = np.abs(xy / n) / np.sqrt(np.maximum(var, 0)) / max(label_sd, 1e-12)
+    corr[~np.isfinite(corr)] = 0.0
+    # constant nonzero column across all samples (intercept) -> keep
+    is_const = seen & (var <= 1e-12) & (np.abs(mean) > 0)
+    corr[is_const] = 1.0
+    corr[~seen] = -1.0
+    return corr
+
+
+def build_random_effect_dataset(
+    df: GameDataFrame,
+    config: RandomEffectDataConfiguration,
+    vocab: EntityVocabulary,
+    dtype=np.float32,
+    scores_offsets: Optional[np.ndarray] = None,
+) -> RandomEffectDataset:
+    """Ingest-time grouping/capping/projection (the reference's whole
+    RandomEffectDataset build pipeline, minus the shuffles)."""
+    re_type = config.random_effect_type
+    shard = df.feature_shards[config.feature_shard_id]
+    assert not shard.is_dense, "random-effect shards use sparse rows"
+    rows = shard.rows
+    n = df.num_samples
+
+    entity_idx = vocab.build(re_type, df.id_tags[re_type])
+    base_offsets = df.offsets if df.offsets is not None else np.zeros(n)
+    if scores_offsets is not None:
+        base_offsets = base_offsets + scores_offsets
+    weights = df.weights if df.weights is not None else np.ones(n)
+
+    # group sample row-ids per entity
+    order = np.argsort(entity_idx, kind="stable")
+    groups: Dict[int, np.ndarray] = {}
+    sorted_e = entity_idx[order]
+    bounds = np.searchsorted(sorted_e, np.arange(vocab.size(re_type) + 1))
+    for e in range(vocab.size(re_type)):
+        groups[e] = order[bounds[e]:bounds[e + 1]]
+
+    E = vocab.size(re_type)
+    active: Dict[int, np.ndarray] = {}
+    passive: List[Tuple[int, int]] = []  # (entity, row)
+    lower = config.active_data_lower_bound
+    upper = config.active_data_upper_bound
+    for e in range(E):
+        g = groups[e]
+        if lower is not None and len(g) < lower:
+            # below lower bound: all samples become passive (score-only);
+            # the entity keeps a zero model (reference drops the entity
+            # from training, RandomEffectDataset.scala:319-340)
+            passive.extend((e, int(r)) for r in g)
+            active[e] = g[:0]
+            continue
+        if upper is not None and len(g) > upper:
+            keys = _splitmix64(g.astype(np.uint64))
+            keep = g[np.argsort(keys, kind="stable")[:upper]]
+            kept_set = set(keep.tolist())
+            active[e] = keep
+            if config.keep_passive_data:
+                passive.extend((e, int(r)) for r in g if int(r) not in kept_set)
+        else:
+            active[e] = g
+
+    # per-entity feature selection + local projection
+    projections: List[np.ndarray] = []
+    local_maps: List[Dict[int, int]] = []
+    d_loc_max = 1
+    for e in range(E):
+        g = active[e]
+        observed: Dict[int, None] = {}
+        for r in g:
+            for j in rows[r][0]:
+                observed.setdefault(int(j), None)
+        obs = np.asarray(list(observed.keys()), np.int64)
+        ratio = config.features_to_samples_ratio
+        if ratio is not None and len(g) > 0 and len(obs) > ratio * len(g):
+            k = max(int(ratio * len(g)), 1)
+            scores = _pearson_scores([rows[r] for r in g],
+                                     np.asarray(df.response, np.float64)[g],
+                                     shard.dim)
+            top = np.argsort(-scores[obs], kind="stable")[:k]
+            obs = obs[np.sort(top)]
+        lm = {int(j): s for s, j in enumerate(obs)}
+        local_maps.append(lm)
+        projections.append(obs)
+        d_loc_max = max(d_loc_max, len(obs))
+
+    S = max((len(active[e]) for e in range(E)), default=1) or 1
+    K = min(shard.max_nnz(), d_loc_max) or 1
+
+    feat_idx = np.zeros((E, S, K), np.int32)
+    feat_val = np.zeros((E, S, K), dtype)
+    labels_b = np.zeros((E, S), dtype)
+    offsets_b = np.zeros((E, S), dtype)
+    weights_b = np.zeros((E, S), dtype)
+    rows_b = np.full((E, S), n, np.int32)
+    resp = np.asarray(df.response, np.float64)
+
+    for e in range(E):
+        lm = local_maps[e]
+        for s, r in enumerate(active[e]):
+            idx, val = rows[r]
+            kk = 0
+            for j, v in zip(idx, val):
+                slot = lm.get(int(j))
+                if slot is not None:
+                    feat_idx[e, s, kk] = slot
+                    feat_val[e, s, kk] = v
+                    kk += 1
+            labels_b[e, s] = resp[r]
+            offsets_b[e, s] = base_offsets[r]
+            weights_b[e, s] = weights[r]
+            rows_b[e, s] = r
+
+    proj = np.full((E, d_loc_max), -1, np.int32)
+    for e in range(E):
+        proj[e, : len(projections[e])] = projections[e]
+
+    # passive block
+    P = max(len(passive), 1)
+    p_idx = np.zeros((P, K), np.int32)
+    p_val = np.zeros((P, K), dtype)
+    p_entity = np.full(P, E, np.int32)
+    p_rows = np.full(P, n, np.int32)
+    for p, (e, r) in enumerate(passive):
+        lm = local_maps[e]
+        idx, val = rows[r]
+        kk = 0
+        for j, v in zip(idx, val):
+            slot = lm.get(int(j))
+            if slot is not None and kk < K:
+                p_idx[p, kk] = slot
+                p_val[p, kk] = v
+                kk += 1
+        p_entity[p] = e
+        p_rows[p] = r
+
+    return RandomEffectDataset(
+        features=F.SparseFeatures(jnp.asarray(feat_idx), jnp.asarray(feat_val)),
+        labels=jnp.asarray(labels_b),
+        offsets=jnp.asarray(offsets_b),
+        weights=jnp.asarray(weights_b),
+        sample_rows=jnp.asarray(rows_b),
+        passive_features=F.SparseFeatures(jnp.asarray(p_idx), jnp.asarray(p_val)),
+        passive_entity=jnp.asarray(p_entity),
+        passive_rows=jnp.asarray(p_rows),
+        projection=jnp.asarray(proj),
+    )
+
+
+def project_for_scoring(
+    df: GameDataFrame,
+    config: RandomEffectDataConfiguration,
+    vocab: EntityVocabulary,
+    projection: np.ndarray,
+    dtype=np.float32,
+) -> Tuple[F.SparseFeatures, Array]:
+    """Project an evaluation frame into each sample's entity-local feature
+    space (reference: IndexMapProjector applied to scoring data). Unseen
+    entities -> entity index E (out of range => zero score); unmapped
+    features are dropped."""
+    shard = df.feature_shards[config.feature_shard_id]
+    rows = shard.rows
+    n = df.num_samples
+    entity_idx = vocab.lookup(config.random_effect_type, df.id_tags[config.random_effect_type])
+    E, d_loc = projection.shape
+
+    local_maps: List[Dict[int, int]] = []
+    proj_np = np.asarray(projection)
+    for e in range(E):
+        lm = {int(j): s for s, j in enumerate(proj_np[e]) if j >= 0}
+        local_maps.append(lm)
+
+    K = min(shard.max_nnz() or 1, d_loc)
+    out_idx = np.zeros((n, K), np.int32)
+    out_val = np.zeros((n, K), dtype)
+    ent = np.empty(n, np.int32)
+    for i in range(n):
+        e = int(entity_idx[i])
+        ent[i] = e if e >= 0 else E
+        if e < 0:
+            continue
+        lm = local_maps[e]
+        idx, val = rows[i]
+        kk = 0
+        for j, v in zip(idx, val):
+            slot = lm.get(int(j))
+            if slot is not None and kk < K:
+                out_idx[i, kk] = slot
+                out_val[i, kk] = v
+                kk += 1
+    return (F.SparseFeatures(jnp.asarray(out_idx), jnp.asarray(out_val)),
+            jnp.asarray(ent))

@@ -1,0 +1,85 @@
+"""Supervised GLM model containers.
+
+Reference: photon-api supervised/model/GeneralizedLinearModel.scala:12-27
+(computeScore = theta.x, computeMean via link), LogisticRegressionModel
+.scala:31, LinearRegressionModel.scala:29, PoissonRegressionModel.scala:29,
+SmoothedHingeLossLinearSVMModel; photon-lib model/Coefficients.scala:31
+(means + optional variances).
+
+One dataclass parameterized by TaskType replaces the subclass-per-task
+hierarchy — the link function comes from the task's PointwiseLoss.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.ops import features as F
+from photon_tpu.ops.losses import loss_for_task
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+class Coefficients(NamedTuple):
+    """means + optional variances (reference: Coefficients.scala:31)."""
+
+    means: Array                      # [d]
+    variances: Optional[Array] = None  # [d]
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[0]
+
+    def compute_score(self, x: F.FeatureMatrix) -> Array:
+        return F.matvec(x, self.means)
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32) -> "Coefficients":
+        return Coefficients(means=jnp.zeros((dim,), dtype))
+
+
+class GeneralizedLinearModel(NamedTuple):
+    """A trained GLM: coefficients + task (link)."""
+
+    coefficients: Coefficients
+    task: TaskType
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.dim
+
+    def compute_score(self, x: F.FeatureMatrix, offsets: Optional[Array] = None) -> Array:
+        """Raw margin theta.x (+ offset) — what GAME score algebra sums."""
+        s = self.coefficients.compute_score(x)
+        return s if offsets is None else s + offsets
+
+    def compute_mean(self, x: F.FeatureMatrix, offsets: Optional[Array] = None) -> Array:
+        """Mean response via the inverse link (sigmoid / exp / identity)."""
+        return loss_for_task(self.task).mean(self.compute_score(x, offsets))
+
+    def predict_class(self, x: F.FeatureMatrix, threshold: float = 0.5,
+                      offsets: Optional[Array] = None) -> Array:
+        """Binary prediction (reference: BinaryClassifier threshold scoring)."""
+        if not self.task.is_classification:
+            raise ValueError(f"{self.task} is not a classification task")
+        return (self.compute_mean(x, offsets) >= threshold).astype(jnp.int32)
+
+
+def logistic_regression_model(coef: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(coef, TaskType.LOGISTIC_REGRESSION)
+
+
+def linear_regression_model(coef: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(coef, TaskType.LINEAR_REGRESSION)
+
+
+def poisson_regression_model(coef: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(coef, TaskType.POISSON_REGRESSION)
+
+
+def smoothed_hinge_svm_model(coef: Coefficients) -> GeneralizedLinearModel:
+    return GeneralizedLinearModel(coef, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM)

@@ -1,0 +1,128 @@
+"""Device-resident feature matrices: dense and padded-sparse (ELL) layouts.
+
+The reference streams per-sample Breeze sparse vectors through Spark
+closures. On TPU every batch is one static-shape array; sparse rows use a
+padded ELL layout (``indices [n, k]``, ``values [n, k]``) with pad slots
+pointing at column 0 with value 0, so no masking is ever needed:
+pads contribute ``0 * theta[0]`` to margins and scatter ``+0`` into
+gradients.
+
+All four aggregator kernels (see ops/aggregators.py) reduce to three
+primitives on this layout:
+
+  * ``matvec(X, theta)        -> margins [n]``   (MXU-friendly when dense)
+  * ``rmatvec(X, w, dim)      -> X^T w    [d]``  (segment-sum scatter when sparse)
+  * ``sq_rmatvec(X, w, dim)   -> (X*X)^T w [d]`` (for Hessian diagonals)
+
+plus ``weighted_gram`` for small-dimension full Hessians.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+class SparseFeatures(NamedTuple):
+    """Padded ELL rows: ``indices[i, j]`` / ``values[i, j]`` is the j-th
+    nonzero of sample i; pad slots are ``(0, 0.0)``."""
+
+    indices: Array  # [n, k] int32
+    values: Array   # [n, k] float
+
+
+FeatureMatrix = Union[Array, SparseFeatures]
+
+
+def num_samples(x: FeatureMatrix) -> int:
+    return (x.values if isinstance(x, SparseFeatures) else x).shape[0]
+
+
+def matvec(x: FeatureMatrix, theta: Array) -> Array:
+    """Per-sample margins ``X @ theta`` -> [n]."""
+    if isinstance(x, SparseFeatures):
+        return jnp.sum(x.values * theta[x.indices], axis=-1)
+    return x @ theta
+
+
+def rmatvec(x: FeatureMatrix, w: Array, dim: int) -> Array:
+    """``X^T w`` -> [d]; ``w`` is a per-sample weight vector [n]."""
+    if isinstance(x, SparseFeatures):
+        contrib = (x.values * w[:, None]).ravel()
+        return jnp.zeros((dim,), dtype=contrib.dtype).at[x.indices.ravel()].add(contrib)
+    return x.T @ w
+
+
+def sq_rmatvec(x: FeatureMatrix, w: Array, dim: int) -> Array:
+    """``(X * X)^T w`` -> [d] (elementwise square), for Hessian diagonals."""
+    if isinstance(x, SparseFeatures):
+        contrib = (x.values * x.values * w[:, None]).ravel()
+        return jnp.zeros((dim,), dtype=contrib.dtype).at[x.indices.ravel()].add(contrib)
+    return (x * x).T @ w
+
+
+def weighted_gram(x: FeatureMatrix, w: Array, dim: int) -> Array:
+    """``X^T diag(w) X`` -> [d, d], for small-dim full Hessians
+    (reference: HessianMatrixAggregator.scala:31)."""
+    if isinstance(x, SparseFeatures):
+        dense = to_dense(x, dim)
+        return dense.T @ (dense * w[:, None])
+    return x.T @ (x * w[:, None])
+
+
+def to_dense(x: FeatureMatrix, dim: int) -> Array:
+    if isinstance(x, SparseFeatures):
+        n, k = x.indices.shape
+        out = jnp.zeros((n, dim), dtype=x.values.dtype)
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k))
+        return out.at[rows.ravel(), x.indices.ravel()].add(x.values.ravel())
+    return x
+
+
+def from_scipy_csr(csr, max_nnz: int | None = None, dtype=np.float32) -> SparseFeatures:
+    """Host-side: scipy CSR -> padded ELL arrays (vectorized).
+
+    ``max_nnz`` pads/clips the row width; rows with more nonzeros than
+    ``max_nnz`` are rejected — silent feature truncation would corrupt
+    margins. Callers that want capping must subsample explicitly.
+    """
+    csr = csr.tocsr()
+    n = csr.shape[0]
+    row_nnz = np.diff(csr.indptr)
+    widest = int(row_nnz.max()) if n else 0
+    k = int(max_nnz) if max_nnz is not None else widest
+    if widest > k:
+        raise ValueError(f"row has {widest} nonzeros > max_nnz={k}; "
+                         "refusing to silently truncate features")
+    indices = np.zeros((n, k), dtype=np.int32)
+    values = np.zeros((n, k), dtype=dtype)
+    if n and k:
+        cols = np.arange(k)[None, :]
+        mask = cols < row_nnz[:, None]
+        src = csr.indptr[:-1, None] + cols
+        indices[mask] = csr.indices[src[mask]]
+        values[mask] = csr.data[src[mask]]
+    return SparseFeatures(indices=jnp.asarray(indices), values=jnp.asarray(values))
+
+
+def from_rows(rows, dim: int, dtype=np.float32, max_nnz: int | None = None) -> SparseFeatures:
+    """Host-side: list of (indices, values) pairs -> padded ELL arrays."""
+    n = len(rows)
+    widest = max((len(r[0]) for r in rows), default=0)
+    k = max_nnz if max_nnz is not None else widest
+    if widest > k:
+        raise ValueError(f"row has {widest} nonzeros > max_nnz={k}; "
+                         "refusing to silently truncate features")
+    indices = np.zeros((n, k), dtype=np.int32)
+    values = np.zeros((n, k), dtype=dtype)
+    for i, (idx, val) in enumerate(rows):
+        m = len(idx)
+        indices[i, :m] = np.asarray(idx, dtype=np.int32)
+        values[i, :m] = np.asarray(val, dtype=dtype)
+    del dim  # shape is carried by coefficient vectors, not the ELL arrays
+    return SparseFeatures(indices=jnp.asarray(indices), values=jnp.asarray(values))

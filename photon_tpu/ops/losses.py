@@ -1,0 +1,168 @@
+"""Pointwise GLM losses.
+
+The scalar contract every distributed kernel reduces to (reference:
+photon-lib function/glm/PointwiseLossFunction.scala:36): given a per-sample
+margin ``z = theta . x + offset`` and a label, produce
+
+  * ``loss_and_dz(z, y) -> (l(z, y), dl/dz)``
+  * ``d2z(z, y)        -> d2l/dz2``
+
+Labels follow the reference conventions: ``{0, 1}`` for logistic regression,
+non-negative counts for Poisson, reals for squared loss, and ``{0, 1}``
+(mapped internally to ``{-1, +1}``) for the Rennie smoothed hinge
+(reference: function/svm/SmoothedHingeLossFunction.scala:26-60).
+
+Everything here is shape-polymorphic and jit/vmap-safe; margins and labels
+may be any broadcastable arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def log1p_exp(x: Array) -> Array:
+    """Numerically stable log(1 + exp(x)) (reference: util/MathUtils log1pExp)."""
+    return jnp.logaddexp(0.0, x)
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise GLM loss: everything the aggregators need.
+
+    ``has_hessian`` mirrors the reference's split between ``DiffFunction``
+    (smoothed hinge is first-order only) and ``TwiceDiffFunction``.
+    """
+
+    name: str
+    loss_and_dz: Callable[[Array, Array], Tuple[Array, Array]]
+    d2z: Callable[[Array, Array], Array]
+    # Inverse link: margin -> mean prediction, used by the GLM models
+    # (reference: supervised/model/GeneralizedLinearModel.computeMean).
+    mean: Callable[[Array], Array]
+    has_hessian: bool = True
+
+    def value(self, z: Array, y: Array) -> Array:
+        return self.loss_and_dz(z, y)[0]
+
+
+# ---------------------------------------------------------------------------
+# Logistic loss (reference: function/glm/LogisticLossFunction.scala:45)
+#   l(z, y) = log(1 + e^z) - y z       with y in {0, 1}
+#   dl/dz   = sigmoid(z) - y
+#   d2l/dz2 = sigmoid(z) (1 - sigmoid(z))
+# ---------------------------------------------------------------------------
+
+def _logistic_loss_and_dz(z: Array, y: Array) -> Tuple[Array, Array]:
+    return log1p_exp(z) - y * z, jax.nn.sigmoid(z) - y
+
+
+def _logistic_d2z(z: Array, y: Array) -> Array:
+    del y
+    s = jax.nn.sigmoid(z)
+    return s * (1.0 - s)
+
+
+LogisticLoss = PointwiseLoss(
+    name="logistic",
+    loss_and_dz=_logistic_loss_and_dz,
+    d2z=_logistic_d2z,
+    mean=jax.nn.sigmoid,
+)
+
+
+# ---------------------------------------------------------------------------
+# Squared loss (reference: function/glm/SquaredLossFunction.scala:32)
+#   l(z, y) = 1/2 (z - y)^2
+# ---------------------------------------------------------------------------
+
+def _squared_loss_and_dz(z: Array, y: Array) -> Tuple[Array, Array]:
+    r = z - y
+    return 0.5 * r * r, r
+
+
+def _squared_d2z(z: Array, y: Array) -> Array:
+    del y
+    return jnp.ones_like(z)
+
+
+SquaredLoss = PointwiseLoss(
+    name="squared",
+    loss_and_dz=_squared_loss_and_dz,
+    d2z=_squared_d2z,
+    mean=lambda z: z,
+)
+
+
+# ---------------------------------------------------------------------------
+# Poisson loss (reference: function/glm/PoissonLossFunction.scala:31)
+#   l(z, y) = e^z - y z
+# ---------------------------------------------------------------------------
+
+def _poisson_loss_and_dz(z: Array, y: Array) -> Tuple[Array, Array]:
+    ez = jnp.exp(z)
+    return ez - y * z, ez - y
+
+
+def _poisson_d2z(z: Array, y: Array) -> Array:
+    del y
+    return jnp.exp(z)
+
+
+PoissonLoss = PointwiseLoss(
+    name="poisson",
+    loss_and_dz=_poisson_loss_and_dz,
+    d2z=_poisson_d2z,
+    mean=jnp.exp,
+)
+
+
+# ---------------------------------------------------------------------------
+# Rennie smoothed hinge (reference: function/svm/SmoothedHingeLossFunction.scala:26-60)
+# With t = (2y - 1) z  (labels {0,1} -> {-1,+1}):
+#   l = 1/2 - t          t <= 0
+#   l = 1/2 (1 - t)^2    0 < t < 1
+#   l = 0                t >= 1
+# Piecewise-quadratic; second derivative exists a.e. (1 on the middle piece).
+# The reference treats it as first-order only; has_hessian=False mirrors that.
+# ---------------------------------------------------------------------------
+
+def _smoothed_hinge_loss_and_dz(z: Array, y: Array) -> Tuple[Array, Array]:
+    s = 2.0 * y - 1.0
+    t = s * z
+    loss = jnp.where(t <= 0.0, 0.5 - t, jnp.where(t < 1.0, 0.5 * (1.0 - t) ** 2, 0.0))
+    dldt = jnp.where(t <= 0.0, -1.0, jnp.where(t < 1.0, t - 1.0, 0.0))
+    return loss, s * dldt
+
+
+def _smoothed_hinge_d2z(z: Array, y: Array) -> Array:
+    s = 2.0 * y - 1.0
+    t = s * z
+    return jnp.where((t > 0.0) & (t < 1.0), 1.0, 0.0)
+
+
+SmoothedHingeLoss = PointwiseLoss(
+    name="smoothed_hinge",
+    loss_and_dz=_smoothed_hinge_loss_and_dz,
+    d2z=_smoothed_hinge_d2z,
+    mean=lambda z: z,
+    has_hessian=False,
+)
+
+
+def loss_for_task(task) -> PointwiseLoss:
+    """TaskType -> PointwiseLoss (reference: ObjectiveFunctionHelper.scala:27)."""
+    from photon_tpu.types import TaskType
+
+    return {
+        TaskType.LOGISTIC_REGRESSION: LogisticLoss,
+        TaskType.LINEAR_REGRESSION: SquaredLoss,
+        TaskType.POISSON_REGRESSION: PoissonLoss,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SmoothedHingeLoss,
+    }[task]

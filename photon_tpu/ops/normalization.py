@@ -1,0 +1,103 @@
+"""Feature normalization as pure algebra folded into the training kernels.
+
+Reference: photon-lib normalization/NormalizationContext.scala:37,80-126 and
+NormalizationType.scala:26-41. The transformed feature is
+
+    x' = (x - shift) * factor          (identity on the intercept column)
+
+and optimizers run in *transformed* coefficient space while the data stays
+raw: the aggregators (ops/aggregators.py) fold the affine map in
+algebraically, exactly as ValueAndGradientAggregator.scala:36-80 does with
+``effectiveCoefficients`` and the margin-shift prefactor. This module holds
+the context plus the model <-> transformed-space conversions that keep
+margins invariant (NormalizationContext.scala:80-100).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class NormalizationType(enum.Enum):
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+
+class NormalizationContext(NamedTuple):
+    """``factors``/``shifts`` are [d] arrays or None; intercept slots (if an
+    intercept column exists) must hold factor=1, shift=0 — enforced by the
+    builders below."""
+
+    factors: Optional[Array] = None
+    shifts: Optional[Array] = None
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    # -- coefficient-space conversions (margin-invariant) -------------------
+
+    def model_to_transformed_space(self, coef: Array,
+                                   intercept_index: Optional[int] = None) -> Array:
+        """Original-space model -> transformed-space coefficients."""
+        out = coef
+        if self.factors is not None:
+            out = out / self.factors
+        if self.shifts is not None and intercept_index is not None:
+            out = out.at[intercept_index].add(jnp.dot(coef, self.shifts))
+        return out
+
+    def transformed_space_to_model(self, coef: Array,
+                                   intercept_index: Optional[int] = None) -> Array:
+        """Transformed-space coefficients -> original-space model."""
+        eff = coef * self.factors if self.factors is not None else coef
+        out = eff
+        if self.shifts is not None and intercept_index is not None:
+            out = out.at[intercept_index].add(-jnp.dot(eff, self.shifts))
+        return out
+
+
+def no_normalization() -> NormalizationContext:
+    return NormalizationContext(None, None)
+
+
+def build_normalization_context(
+    norm_type: NormalizationType,
+    mean: Array,
+    variance: Array,
+    abs_max: Array,
+    intercept_index: Optional[int] = None,
+) -> NormalizationContext:
+    """Build a context from feature statistics
+    (reference: NormalizationContext factory from FeatureDataStatistics)."""
+    std = jnp.sqrt(variance)
+    inv_std = 1.0 / jnp.where(std > 0, std, 1.0)
+    inv_mag = 1.0 / jnp.where(abs_max > 0, abs_max, 1.0)
+
+    factors: Optional[Array]
+    shifts: Optional[Array]
+    if norm_type == NormalizationType.NONE:
+        return no_normalization()
+    if norm_type == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factors, shifts = inv_std, None
+    elif norm_type == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factors, shifts = inv_mag, None
+    elif norm_type == NormalizationType.STANDARDIZATION:
+        factors, shifts = inv_std, mean
+    else:  # pragma: no cover
+        raise ValueError(f"unknown normalization type {norm_type}")
+
+    if intercept_index is not None:
+        if factors is not None:
+            factors = factors.at[intercept_index].set(1.0)
+        if shifts is not None:
+            shifts = shifts.at[intercept_index].set(0.0)
+    return NormalizationContext(factors, shifts)

@@ -1,0 +1,39 @@
+"""Convex solvers for GLM training, all as single jittable XLA programs.
+
+Reference: photon-lib optimization/ (Optimizer.scala, LBFGS.scala,
+OWLQN.scala, LBFGSB.scala, TRON.scala, OptimizerFactory.scala:26).
+"""
+
+from photon_tpu.optim.base import (  # noqa: F401
+    ConvergenceReason,
+    SolverConfig,
+    SolverResult,
+)
+from photon_tpu.optim import lbfgs, owlqn, tron  # noqa: F401
+from photon_tpu.types import OptimizerType
+
+
+def minimize(
+    optimizer_type: OptimizerType,
+    value_and_grad,
+    x0,
+    *args,
+    hess_vec=None,
+    l1_weight=0.0,
+    config: SolverConfig = SolverConfig(),
+) -> SolverResult:
+    """Dispatch on optimizer type (reference: OptimizerFactory.scala:26).
+
+    LBFGSB is LBFGS with box projection — set bounds in ``config``
+    (reference projects into the constraint box after each step).
+    """
+    if optimizer_type in (OptimizerType.LBFGS, OptimizerType.LBFGSB):
+        return lbfgs.minimize(value_and_grad, x0, *args, config=config)
+    if optimizer_type == OptimizerType.OWLQN:
+        return owlqn.minimize(value_and_grad, x0, *args,
+                              l1_weight=l1_weight, config=config)
+    if optimizer_type == OptimizerType.TRON:
+        if hess_vec is None:
+            raise ValueError("TRON requires hess_vec")
+        return tron.minimize(value_and_grad, hess_vec, x0, *args, config=config)
+    raise ValueError(f"unknown optimizer type {optimizer_type}")

@@ -1,0 +1,129 @@
+"""Solver contracts: state, convergence reasons, tolerance semantics.
+
+Reference: photon-lib optimization/Optimizer.scala:36-190 (template method:
+absolute tolerances derived from the initial state, convergence reasons at
+:135-149), OptimizerState.scala, OptimizationStatesTracker.scala:31.
+
+TPU re-design: a solver is a pure jittable function
+``minimize(obj, x0, data, hyper, config) -> SolverResult``; the optimize
+loop is a ``lax.while_loop`` carry rather than a driver-side iteration, so
+the whole solve (including every "treeAggregate") is ONE XLA program.
+Because all control flow is lax-level, the same solver can be ``vmap``-ed
+over entity blocks for the random-effect path — per-entity convergence
+masking falls out of the while_loop batching rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class ConvergenceReason(enum.IntEnum):
+    """Reference: Optimizer.getConvergenceReason (Optimizer.scala:135-149)."""
+
+    NOT_CONVERGED = 0
+    MAX_ITERATIONS = 1
+    FUNCTION_VALUES_CONVERGED = 2
+    GRADIENT_CONVERGED = 3
+    OBJECTIVE_NOT_IMPROVING = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverConfig:
+    """Reference: OptimizerConfig.scala:28 + per-solver defaults
+    (LBFGS.scala:152-157, TRON.scala:256-262)."""
+
+    max_iterations: int = 100
+    tolerance: float = 1e-7
+    # L-BFGS
+    num_corrections: int = 10
+    # TRON
+    max_cg_iterations: int = 20
+    max_improvement_failures: int = 5
+    # Line search
+    linesearch_max_iterations: int = 25
+    # Box constraints (reference: constraintMap / LBFGSB bounds) — arrays [d]
+    lower_bounds: Optional[jax.Array] = None
+    upper_bounds: Optional[jax.Array] = None
+    # L1 (OWL-QN): per-index weight mask multiplying the l1 weight from hyper;
+    # None means regularize every index.
+    l1_mask: Optional[jax.Array] = None
+
+
+class SolverResult(NamedTuple):
+    """Final state, mirroring OptimizerState + convergence bookkeeping."""
+
+    coef: Array
+    value: Array
+    gradient: Array
+    iterations: Array          # int32
+    reason: Array              # int32 ConvergenceReason
+    num_fun_evals: Array       # int32 — objective evaluations (profiling)
+
+
+class Tolerances(NamedTuple):
+    """Absolute tolerances set from the initial state
+    (reference: Optimizer.setAbsTolerances)."""
+
+    value_tol: Array
+    gradient_tol: Array
+
+
+def absolute_tolerances(f0: Array, g0: Array, rel_tol: float) -> Tolerances:
+    eps = jnp.asarray(jnp.finfo(g0.dtype).tiny, dtype=g0.dtype)
+    return Tolerances(
+        value_tol=rel_tol * jnp.maximum(jnp.abs(f0), eps),
+        gradient_tol=rel_tol * jnp.maximum(jnp.linalg.norm(g0), eps),
+    )
+
+
+def convergence_reason(
+    it: Array,
+    f_prev: Array,
+    f: Array,
+    g: Array,
+    tols: Tolerances,
+    max_iterations: int,
+) -> Array:
+    """Priority-ordered convergence decision, matching the reference order
+    MaxIterations -> FunctionValuesConverged -> GradientConverged
+    (Optimizer.scala:135-149). OBJECTIVE_NOT_IMPROVING is emitted by
+    solvers that track improvement failures (TRON), not here."""
+    gnorm = jnp.linalg.norm(g)
+    reason = jnp.where(
+        it >= max_iterations,
+        ConvergenceReason.MAX_ITERATIONS,
+        jnp.where(
+            jnp.abs(f_prev - f) <= tols.value_tol,
+            ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+            jnp.where(
+                gnorm <= tols.gradient_tol,
+                ConvergenceReason.GRADIENT_CONVERGED,
+                ConvergenceReason.NOT_CONVERGED,
+            ),
+        ),
+    )
+    return reason.astype(jnp.int32)
+
+
+# Objective closures the solvers consume: fg(x, data, hyper) -> (f, g) and
+# (second order) hv(x, v, data, hyper) -> Hv.
+ValueAndGrad = Callable[..., Tuple[Array, Array]]
+HessVec = Callable[..., Array]
+
+
+def project_box(x: Array, config: SolverConfig) -> Array:
+    """Box projection after each step (reference: LBFGS.scala box-constraint
+    projection; OptimizerConfig.constraintMap)."""
+    if config.lower_bounds is not None:
+        x = jnp.maximum(x, config.lower_bounds)
+    if config.upper_bounds is not None:
+        x = jnp.minimum(x, config.upper_bounds)
+    return x

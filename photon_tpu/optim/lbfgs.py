@@ -1,0 +1,193 @@
+"""L-BFGS as one jittable lax.while_loop (replaces breeze.optimize.LBFGS
+behind the reference's LBFGS adapter, optimization/LBFGS.scala:39).
+
+Two-loop recursion over a fixed-size circular (S, Y) history, strong-Wolfe
+line search (optim/linesearch.py), optional box projection after each step
+(the reference projects into the constraint box after each Breeze step —
+LBFGS.scala; LBFGSB.scala:40 gets the same treatment here).
+
+Defaults mirror the reference: maxIter=100, numCorrections=10, tol=1e-7
+(LBFGS.scala:152-157).
+
+Because every branch is lax-level, this function serves both roles the
+reference splits into DistributedOptimizationProblem (one big solve over a
+sharded batch) and SingleNodeOptimizationProblem (vmap-ed over entity
+blocks with per-entity convergence masking).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from photon_tpu.optim.base import (
+    ConvergenceReason,
+    SolverConfig,
+    SolverResult,
+    absolute_tolerances,
+    convergence_reason,
+    project_box,
+)
+from photon_tpu.optim.linesearch import wolfe_linesearch
+
+Array = jax.Array
+
+
+class _Carry(NamedTuple):
+    x: Array
+    f: Array
+    g: Array
+    f_prev: Array
+    s_hist: Array      # [m, d]
+    y_hist: Array      # [m, d]
+    rho: Array         # [m]
+    n_pairs: Array     # int32: number of valid pairs (<= m)
+    head: Array        # int32: next write slot
+    it: Array
+    reason: Array
+    n_evals: Array
+    ls_failed: Array   # bool: last line search failed to decrease
+
+
+def two_loop_direction(g, s_hist, y_hist, rho, n_pairs, head, m):
+    """Standard two-loop recursion with circular-buffer masking."""
+    dtype = g.dtype
+
+    def bwd(j, carry):
+        q, alphas = carry
+        idx = (head - 1 - j) % m
+        valid = j < n_pairs
+        a = rho[idx] * jnp.dot(s_hist[idx], q)
+        a = jnp.where(valid, a, 0.0)
+        q = q - a * y_hist[idx]
+        return q, alphas.at[idx].set(a)
+
+    q, alphas = lax.fori_loop(0, m, bwd, (g, jnp.zeros((m,), dtype)))
+
+    # initial Hessian scaling from the most recent pair
+    last = (head - 1) % m
+    sy = jnp.dot(s_hist[last], y_hist[last])
+    yy = jnp.dot(y_hist[last], y_hist[last])
+    gamma = jnp.where((n_pairs > 0) & (yy > 0), sy / jnp.where(yy > 0, yy, 1.0), 1.0)
+    r = gamma * q
+
+    def fwd(j, r):
+        idx = (head - n_pairs + j) % m
+        valid = j < n_pairs
+        beta = rho[idx] * jnp.dot(y_hist[idx], r)
+        upd = s_hist[idx] * (alphas[idx] - beta)
+        return r + jnp.where(valid, upd, 0.0)
+
+    r = lax.fori_loop(0, m, fwd, r)
+    return -r
+
+
+def minimize(
+    value_and_grad,
+    x0: Array,
+    *args,
+    config: SolverConfig = SolverConfig(),
+) -> SolverResult:
+    """Minimize ``value_and_grad(x, *args) -> (f, g)`` from ``x0``."""
+    m = config.num_corrections
+    d = x0.shape[0]
+    dtype = x0.dtype
+    has_box = config.lower_bounds is not None or config.upper_bounds is not None
+
+    x0 = project_box(x0, config)
+    f0, g0 = value_and_grad(x0, *args)
+    tols = absolute_tolerances(f0, g0, config.tolerance)
+
+    def cond(c: _Carry):
+        return c.reason == ConvergenceReason.NOT_CONVERGED
+
+    def body(c: _Carry) -> _Carry:
+        direction = two_loop_direction(c.g, c.s_hist, c.y_hist, c.rho,
+                                       c.n_pairs, c.head, m)
+        # safeguard: fall back to steepest descent on non-descent directions
+        descent = jnp.dot(direction, c.g) < 0
+        direction = jnp.where(descent, direction, -c.g)
+
+        gnorm = jnp.linalg.norm(c.g)
+        first = c.n_pairs == 0
+        init_step = jnp.where(first, jnp.minimum(1.0, 1.0 / jnp.maximum(gnorm, 1e-12)), 1.0)
+
+        ls = wolfe_linesearch(
+            value_and_grad, c.x, direction, c.f, c.g, *args,
+            initial_step=init_step.astype(dtype),
+            max_evals=config.linesearch_max_iterations,
+        )
+
+        x_new = c.x + ls.step * direction
+        f_new, g_new = ls.f, ls.g
+        if has_box:
+            # Project and re-evaluate at the projected point (reference
+            # projects coefficients into the box after each step).
+            x_proj = project_box(x_new, config)
+            changed = jnp.any(x_proj != x_new)
+            f_proj, g_proj = value_and_grad(x_proj, *args)
+            x_new = x_proj
+            f_new = jnp.where(changed, f_proj, f_new)
+            g_new = jnp.where(changed, g_proj[...], g_new)
+
+        decreased = f_new < c.f
+        # reject non-decreasing steps entirely
+        x_new = jnp.where(decreased, x_new, c.x)
+        f_kept = jnp.where(decreased, f_new, c.f)
+        g_kept = jnp.where(decreased, g_new, c.g)
+
+        # curvature update
+        s = x_new - c.x
+        yv = g_kept - c.g
+        sy = jnp.dot(s, yv)
+        store = decreased & (sy > 1e-10 * jnp.maximum(jnp.dot(yv, yv), 1e-30))
+        write = c.head % m
+        s_hist = jnp.where(store, c.s_hist.at[write].set(s), c.s_hist)
+        y_hist = jnp.where(store, c.y_hist.at[write].set(yv), c.y_hist)
+        rho = jnp.where(store, c.rho.at[write].set(1.0 / jnp.where(sy != 0, sy, 1.0)), c.rho)
+        head = jnp.where(store, (c.head + 1) % m, c.head)
+        n_pairs = jnp.where(store, jnp.minimum(c.n_pairs + 1, m), c.n_pairs)
+
+        it = c.it + 1
+        reason = convergence_reason(it, c.f, f_kept, g_kept, tols, config.max_iterations)
+        # two consecutive failed line searches -> objective not improving
+        both_failed = (~decreased) & c.ls_failed
+        reason = jnp.where(
+            (reason == ConvergenceReason.NOT_CONVERGED) & both_failed,
+            jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
+            reason,
+        )
+
+        return _Carry(
+            x=x_new, f=f_kept, g=g_kept, f_prev=c.f,
+            s_hist=s_hist, y_hist=y_hist, rho=rho,
+            n_pairs=n_pairs, head=head.astype(jnp.int32),
+            it=it, reason=reason,
+            n_evals=c.n_evals + ls.num_evals + (1 if has_box else 0),
+            ls_failed=~decreased,
+        )
+
+    init = _Carry(
+        x=x0, f=f0, g=g0, f_prev=f0 + jnp.asarray(jnp.inf, dtype),
+        s_hist=jnp.zeros((m, d), dtype), y_hist=jnp.zeros((m, d), dtype),
+        rho=jnp.zeros((m,), dtype),
+        n_pairs=jnp.asarray(0, jnp.int32), head=jnp.asarray(0, jnp.int32),
+        it=jnp.asarray(0, jnp.int32),
+        # handle an already-converged start (zero gradient)
+        reason=jnp.where(
+            jnp.linalg.norm(g0) <= tols.gradient_tol,
+            jnp.asarray(ConvergenceReason.GRADIENT_CONVERGED, jnp.int32),
+            jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32),
+        ),
+        n_evals=jnp.asarray(1, jnp.int32),
+        ls_failed=jnp.asarray(False),
+    )
+
+    out = lax.while_loop(cond, body, init)
+    return SolverResult(
+        coef=out.x, value=out.f, gradient=out.g,
+        iterations=out.it, reason=out.reason, num_fun_evals=out.n_evals,
+    )

@@ -1,0 +1,64 @@
+"""Device mesh + sharding helpers: the Spark-cluster replacement.
+
+Reference §5.8: Spark broadcasts / treeAggregate / shuffle joins become one
+SPMD program on a `jax.sharding.Mesh`. Conventions:
+
+  * axis "data"   — batch (sample) sharding; gradient reductions ride ICI
+                    as psum (the treeAggregate replacement).
+  * axis "entity" — random-effect entity-block sharding (the co-partitioned
+                    RandomEffectDataset replacement).
+
+Parameters are replicated (`PartitionSpec()`) — the broadcast-variable
+replacement; feature-sharded theta for billion-feature fixed effects is the
+model-parallel extension (SURVEY §5.7).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+ENTITY_AXIS = "entity"
+
+
+def create_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    devices = jax.devices()
+    n = n_devices if n_devices is not None else len(devices)
+    devs = np.asarray(devices[:n])
+    if shape is None:
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    return Mesh(devs.reshape(tuple(shape)), tuple(axis_names))
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Leading-dim sharding for sample-major arrays."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (the broadcast-variable equivalent)."""
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = DATA_AXIS):
+    """Place every array of a DataBatch pytree with its leading dim sharded
+    over ``axis``. Pads are the caller's job (static shapes)."""
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+
+def replicate(params, mesh: Mesh):
+    sharding = replicated(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), params)
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k

@@ -1,0 +1,54 @@
+"""Core type aliases and task enums.
+
+TPU-native re-design of the reference's type vocabulary
+(reference: photon-lib .../Types.scala:21-44, TaskType.scala:24).
+"""
+
+from __future__ import annotations
+
+import enum
+
+# Reference: UniqueSampleId = Long, CoordinateId = String, REId = String.
+# In the TPU build, sample / entity identity is positional: every sample has a
+# dense row index in the device-resident arrays, and entities have dense block
+# indices assigned at ingest. The string identities survive only on the host
+# side (ingest tables, model IO).
+UniqueSampleId = int
+CoordinateId = str
+REId = str
+REType = str
+FeatureShardId = str
+
+
+class TaskType(enum.Enum):
+    """Supported GLM training tasks (reference: TaskType.scala:24)."""
+
+    LINEAR_REGRESSION = "LINEAR_REGRESSION"
+    POISSON_REGRESSION = "POISSON_REGRESSION"
+    LOGISTIC_REGRESSION = "LOGISTIC_REGRESSION"
+    SMOOTHED_HINGE_LOSS_LINEAR_SVM = "SMOOTHED_HINGE_LOSS_LINEAR_SVM"
+
+    @property
+    def is_classification(self) -> bool:
+        return self in (
+            TaskType.LOGISTIC_REGRESSION,
+            TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        )
+
+
+class VarianceComputationType(enum.Enum):
+    """Coefficient-variance computation mode
+    (reference: optimization/VarianceComputationType.scala:20)."""
+
+    NONE = "NONE"
+    SIMPLE = "SIMPLE"  # 1 / diag(H)
+    FULL = "FULL"      # diag(H^-1) via Cholesky
+
+
+class OptimizerType(enum.Enum):
+    """Available convex solvers (reference: optimization/OptimizerType.scala)."""
+
+    LBFGS = "LBFGS"
+    OWLQN = "OWLQN"
+    LBFGSB = "LBFGSB"
+    TRON = "TRON"

@@ -1,0 +1,41 @@
+"""Test fixture: force an 8-device virtual CPU mesh before JAX initializes.
+
+This plays the role of the reference's SparkTestUtils.sparkTest local-mode
+fixture (photon-test-utils .../SparkTestUtils.scala:30-60): "distributed"
+behavior — sharded batches, psum reductions, entity-sharded solves — is
+exercised on host-platform virtual devices without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize (TPU tunnel) force-sets jax_platforms="axon,cpu"
+# via jax.config, overriding the env var — which would route "CPU" tests
+# onto the single real TPU chip and serialize/deadlock concurrent runs.
+# Override it back: tests always run on the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+# Float64 on the CPU test mesh so optimizer convergence tests can assert
+# tight tolerances against scipy oracles; production TPU runs use f32/bf16.
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def devices8():
+    ds = jax.devices()
+    assert len(ds) == 8, f"expected 8 virtual devices, got {len(ds)}"
+    return ds

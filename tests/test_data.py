@@ -1,0 +1,90 @@
+"""Feature stats, down-sampling, LibSVM ingest."""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from photon_tpu.data import ingest, sampling
+from photon_tpu.data.stats import compute_feature_stats
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.ops import features as F
+from photon_tpu.types import TaskType
+
+
+def test_feature_stats_dense_vs_numpy(rng):
+    X = rng.normal(size=(200, 7))
+    X[:, 2] *= 0.0
+    s = compute_feature_stats(jnp.asarray(X), 7)
+    np.testing.assert_allclose(s.mean, X.mean(0), rtol=1e-9)
+    np.testing.assert_allclose(s.variance, X.var(0, ddof=1), rtol=1e-9)
+    np.testing.assert_allclose(s.min, X.min(0), rtol=1e-12)
+    np.testing.assert_allclose(s.max, X.max(0), rtol=1e-12)
+    np.testing.assert_allclose(s.num_nonzeros, (X != 0).sum(0))
+
+
+def test_feature_stats_sparse_accounts_for_implicit_zeros(rng):
+    X = rng.normal(size=(150, 9))
+    X[np.abs(X) < 0.8] = 0.0
+    X[:, 0] = np.abs(X[:, 0]) + 1.0  # all-positive dense column
+    sparse = F.from_scipy_csr(sp.csr_matrix(X), dtype=np.float64)
+    s = compute_feature_stats(sparse, 9)
+    np.testing.assert_allclose(s.mean, X.mean(0), rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(s.variance, X.var(0, ddof=1), rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(s.min, X.min(0), rtol=1e-12)
+    np.testing.assert_allclose(s.max, X.max(0), rtol=1e-12)
+    np.testing.assert_allclose(s.num_nonzeros, (X != 0).sum(0))
+    np.testing.assert_allclose(s.abs_max, np.abs(X).max(0), rtol=1e-12)
+
+
+def test_binary_downsampler_preserves_expectation(rng):
+    n = 20000
+    labels = (rng.random(n) < 0.1).astype(np.float64)
+    batch = DataBatch(jnp.zeros((n, 1)), jnp.asarray(labels))
+    rate = 0.3
+    out = sampling.downsample_binary(batch, rate, jax.random.PRNGKey(0))
+    w = np.asarray(out.weights)
+    # positives untouched
+    np.testing.assert_allclose(w[labels > 0.5], 1.0)
+    # negative total weight preserved in expectation (1/sqrt(n) tolerance)
+    neg_w = w[labels < 0.5].sum()
+    neg_n = (labels < 0.5).sum()
+    assert abs(neg_w - neg_n) / neg_n < 0.03
+    # deterministic under same key (recompute-stability, reference
+    # RandomEffectDataset.scala:212-215 concern)
+    out2 = sampling.downsample_binary(batch, rate, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(out2.weights), w)
+
+
+def test_default_downsampler(rng):
+    n = 10000
+    batch = DataBatch(jnp.zeros((n, 1)), jnp.asarray(rng.normal(size=n)))
+    out = sampling.maybe_downsample(batch, TaskType.LINEAR_REGRESSION, 0.5,
+                                    jax.random.PRNGKey(1))
+    w = np.asarray(out.weights)
+    assert abs(w.sum() - n) / n < 0.03
+    # rate >= 1 is a no-op
+    assert sampling.maybe_downsample(batch, TaskType.LINEAR_REGRESSION, 1.0,
+                                     jax.random.PRNGKey(1)) is batch
+
+
+def test_libsvm_roundtrip():
+    content = "+1 1:0.5 3:1.5\n-1 2:2.0\n+1 1:1.0 2:1.0 3:1.0\n"
+    with tempfile.NamedTemporaryFile("w", suffix=".libsvm", delete=False) as f:
+        f.write(content)
+        path = f.name
+    try:
+        data = ingest.read_libsvm(path, add_intercept=True)
+        assert data.dim == 4  # 3 features + intercept
+        np.testing.assert_allclose(data.labels, [1.0, 0.0, 1.0])
+        batch = ingest.to_batch(data, dtype=np.float64, pad_to=8)
+        assert batch.num_samples == 8
+        dense = np.asarray(F.to_dense(batch.features, 4))
+        np.testing.assert_allclose(dense[0], [0.5, 0.0, 1.5, 1.0])
+        np.testing.assert_allclose(dense[1], [0.0, 2.0, 0.0, 1.0])
+        np.testing.assert_allclose(np.asarray(batch.weights), [1, 1, 1, 0, 0, 0, 0, 0])
+    finally:
+        os.unlink(path)

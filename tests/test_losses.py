@@ -1,0 +1,64 @@
+"""Unit tests for pointwise losses: derivatives vs autodiff, known values."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.ops import losses as L
+
+ALL_LOSSES = [L.LogisticLoss, L.SquaredLoss, L.PoissonLoss, L.SmoothedHingeLoss]
+
+
+def _labels_for(loss, rng, n):
+    if loss.name in ("logistic", "smoothed_hinge"):
+        return rng.integers(0, 2, size=n).astype(np.float64)
+    if loss.name == "poisson":
+        return rng.poisson(3.0, size=n).astype(np.float64)
+    return rng.normal(size=n)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_dz_matches_autodiff(loss, rng):
+    z = jnp.asarray(rng.normal(size=64) * 2.0)
+    y = jnp.asarray(_labels_for(loss, rng, 64))
+    _, dz = loss.loss_and_dz(z, y)
+    dz_auto = jax.vmap(jax.grad(lambda zi, yi: loss.loss_and_dz(zi, yi)[0]))(z, y)
+    np.testing.assert_allclose(dz, dz_auto, rtol=1e-9, atol=1e-9)
+
+
+@pytest.mark.parametrize("loss", [l for l in ALL_LOSSES if l.name != "smoothed_hinge"],
+                         ids=lambda l: l.name)
+def test_d2z_matches_autodiff(loss, rng):
+    z = jnp.asarray(rng.normal(size=64) * 2.0)
+    y = jnp.asarray(_labels_for(loss, rng, 64))
+    d2 = loss.d2z(z, y)
+    d2_auto = jax.vmap(jax.grad(jax.grad(lambda zi, yi: loss.loss_and_dz(zi, yi)[0])))(z, y)
+    np.testing.assert_allclose(d2, d2_auto, rtol=1e-9, atol=1e-9)
+
+
+def test_logistic_known_values():
+    l, dz = L.LogisticLoss.loss_and_dz(jnp.asarray(0.0), jnp.asarray(1.0))
+    np.testing.assert_allclose(l, np.log(2.0), rtol=1e-12)
+    np.testing.assert_allclose(dz, -0.5, rtol=1e-12)
+    # extreme margins stay finite
+    l, _ = L.LogisticLoss.loss_and_dz(jnp.asarray(1000.0), jnp.asarray(0.0))
+    assert np.isfinite(float(l)) and float(l) == pytest.approx(1000.0)
+    l, _ = L.LogisticLoss.loss_and_dz(jnp.asarray(-1000.0), jnp.asarray(1.0))
+    np.testing.assert_allclose(l, 1000.0, rtol=1e-9)
+
+
+def test_smoothed_hinge_piecewise():
+    # y=1 -> t=z. Three pieces (SmoothedHingeLossFunction.scala:26-60).
+    y = jnp.asarray(1.0)
+    assert float(L.SmoothedHingeLoss.value(jnp.asarray(2.0), y)) == 0.0
+    np.testing.assert_allclose(L.SmoothedHingeLoss.value(jnp.asarray(0.5), y), 0.125)
+    np.testing.assert_allclose(L.SmoothedHingeLoss.value(jnp.asarray(-1.0), y), 1.5)
+    # y=0 flips the sign of the margin
+    np.testing.assert_allclose(L.SmoothedHingeLoss.value(jnp.asarray(1.0), jnp.asarray(0.0)), 1.5)
+
+
+def test_means():
+    np.testing.assert_allclose(L.LogisticLoss.mean(jnp.asarray(0.0)), 0.5)
+    np.testing.assert_allclose(L.PoissonLoss.mean(jnp.asarray(1.0)), np.e, rtol=1e-6)
+    np.testing.assert_allclose(L.SquaredLoss.mean(jnp.asarray(3.7)), 3.7)

@@ -1,0 +1,157 @@
+"""Solver tests vs analytic objectives and scipy/sklearn oracles — the role
+of the reference's OptimizerTest/TRON tests against TestObjective."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.function.objective import GLMObjective, Hyper
+from photon_tpu.ops.losses import LogisticLoss, PoissonLoss
+from photon_tpu.optim import ConvergenceReason, SolverConfig, lbfgs, minimize, owlqn, tron
+from photon_tpu.types import OptimizerType
+
+D = 12
+
+
+def rosen_vg(x):
+    fn = lambda z: jnp.sum(100.0 * (z[1:] - z[:-1] ** 2) ** 2 + (1 - z[:-1]) ** 2)
+    return fn(x), jax.grad(fn)(x)
+
+
+def make_logistic(rng, n=1500, d=D, seed_scale=1.0):
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d) * seed_scale
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-X @ w))).astype(np.float64)
+    return DataBatch(jnp.asarray(X), jnp.asarray(y)), X, y
+
+
+def test_lbfgs_rosenbrock():
+    res = jax.jit(
+        lambda x: lbfgs.minimize(rosen_vg, x,
+                                 config=SolverConfig(max_iterations=300, tolerance=1e-12))
+    )(jnp.zeros(10))
+    assert float(jnp.linalg.norm(res.coef - 1.0)) < 1e-5
+    assert int(res.reason) != ConvergenceReason.NOT_CONVERGED
+
+
+def test_lbfgs_quadratic_exact(rng):
+    A = rng.normal(size=(25, 25))
+    Q = jnp.asarray(A @ A.T + 25 * np.eye(25))
+    b = jnp.asarray(rng.normal(size=25))
+    vg = lambda x: (0.5 * x @ Q @ x - b @ x, Q @ x - b)
+    res = lbfgs.minimize(vg, jnp.zeros(25),
+                         config=SolverConfig(tolerance=1e-13, max_iterations=400))
+    xstar = np.linalg.solve(np.asarray(Q), np.asarray(b))
+    np.testing.assert_allclose(res.coef, xstar, rtol=1e-6, atol=1e-8)
+
+
+def test_lbfgs_logistic_vs_sklearn(rng):
+    from sklearn.linear_model import LogisticRegression
+
+    batch, X, y = make_logistic(rng)
+    obj = GLMObjective(LogisticLoss)
+    hyper = Hyper.of(1.0, dtype=jnp.float64)
+    vg = lambda c: obj.value_and_gradient(c, batch, hyper)
+    res = lbfgs.minimize(vg, jnp.zeros(D),
+                         config=SolverConfig(tolerance=1e-12, max_iterations=300))
+    sk = LogisticRegression(C=1.0, fit_intercept=False, tol=1e-12, max_iter=5000)
+    sk.fit(X, y)
+    np.testing.assert_allclose(res.coef, sk.coef_[0], rtol=1e-4, atol=1e-6)
+
+
+def test_tron_matches_lbfgs_logistic(rng):
+    batch, _, _ = make_logistic(rng)
+    obj = GLMObjective(LogisticLoss)
+    hyper = Hyper.of(0.5, dtype=jnp.float64)
+    vg = lambda c: obj.value_and_gradient(c, batch, hyper)
+    hv = lambda c, v: obj.hessian_vector(c, v, batch, hyper)
+    r1 = lbfgs.minimize(vg, jnp.zeros(D), config=SolverConfig(tolerance=1e-12, max_iterations=300))
+    r2 = tron.minimize(vg, hv, jnp.zeros(D),
+                       config=SolverConfig(max_iterations=50, tolerance=1e-12))
+    np.testing.assert_allclose(r1.coef, r2.coef, rtol=1e-5, atol=1e-7)
+    # TRON (Newton) should use far fewer outer iterations
+    assert int(r2.iterations) <= int(r1.iterations)
+
+
+def test_tron_poisson(rng):
+    n = 800
+    X = rng.normal(size=(n, D)) * 0.3
+    w = rng.normal(size=D) * 0.5
+    y = rng.poisson(np.exp(X @ w)).astype(np.float64)
+    batch = DataBatch(jnp.asarray(X), jnp.asarray(y))
+    obj = GLMObjective(PoissonLoss)
+    hyper = Hyper.of(1e-3, dtype=jnp.float64)
+    vg = lambda c: obj.value_and_gradient(c, batch, hyper)
+    hv = lambda c, v: obj.hessian_vector(c, v, batch, hyper)
+    res = tron.minimize(vg, hv, jnp.zeros(D),
+                        config=SolverConfig(max_iterations=60, tolerance=1e-12))
+    assert float(jnp.linalg.norm(res.gradient)) < 1e-6
+    # recovered coefficients close to truth on easy data
+    assert float(jnp.linalg.norm(res.coef - w)) / np.linalg.norm(w) < 0.35
+
+
+def test_owlqn_l1_logistic_vs_sklearn(rng):
+    from sklearn.linear_model import LogisticRegression
+
+    batch, X, y = make_logistic(rng)
+    obj = GLMObjective(LogisticLoss)
+    vg = lambda c: obj.value_and_gradient(c, batch, Hyper.of(0.0, dtype=jnp.float64))
+    lam = 8.0
+    res = owlqn.minimize(vg, jnp.zeros(D), l1_weight=lam,
+                         config=SolverConfig(tolerance=1e-12, max_iterations=400))
+    sk = LogisticRegression(l1_ratio=1.0, C=1.0 / lam, solver="liblinear",
+                            fit_intercept=False, tol=1e-12, max_iter=5000)
+    sk.fit(X, y)
+    f = lambda c: float(obj.value(jnp.asarray(c), batch, Hyper.of(0.0, dtype=jnp.float64))
+                        + lam * np.abs(np.asarray(c)).sum())
+    # at least as good an objective as the sklearn solution, same support
+    assert f(res.coef) <= f(sk.coef_[0]) + 1e-4
+    assert set(np.nonzero(np.asarray(res.coef))[0]) == set(np.nonzero(sk.coef_[0])[0])
+
+
+def test_owlqn_produces_sparsity(rng):
+    batch, _, _ = make_logistic(rng)
+    obj = GLMObjective(LogisticLoss)
+    vg = lambda c: obj.value_and_gradient(c, batch, Hyper.of(0.0, dtype=jnp.float64))
+    res = owlqn.minimize(vg, jnp.zeros(D), l1_weight=60.0,
+                         config=SolverConfig(tolerance=1e-10, max_iterations=200))
+    assert int(jnp.sum(res.coef != 0)) < D // 2
+
+
+def test_box_constrained_lbfgs(rng):
+    # minimize ||x - 2|| s.t. x <= 1 -> solution clipped at 1
+    vg = lambda x: (0.5 * jnp.sum((x - 2.0) ** 2), x - 2.0)
+    cfg = SolverConfig(tolerance=1e-12, max_iterations=100,
+                       upper_bounds=jnp.ones(5), lower_bounds=-jnp.ones(5))
+    res = minimize(OptimizerType.LBFGSB, vg, jnp.zeros(5), config=cfg)
+    np.testing.assert_allclose(res.coef, np.ones(5), rtol=1e-8)
+
+
+def test_solver_vmaps_over_problems(rng):
+    """The property the random-effect path depends on: the same jittable
+    solver vmaps over a batch of independent problems."""
+    B, d = 6, 5
+    Xs = rng.normal(size=(B, 200, d))
+    ws = rng.normal(size=(B, d))
+    ys = (rng.random((B, 200)) < 1.0 / (1.0 + np.exp(-np.einsum("bnd,bd->bn", Xs, ws)))).astype(np.float64)
+
+    obj = GLMObjective(LogisticLoss)
+    hyper = Hyper.of(0.1, dtype=jnp.float64)
+
+    def solve_one(x, y):
+        batch = DataBatch(x, y)
+        vg = lambda c: obj.value_and_gradient(c, batch, hyper)
+        return lbfgs.minimize(vg, jnp.zeros(d, dtype=x.dtype),
+                              config=SolverConfig(tolerance=1e-10, max_iterations=100))
+
+    batched = jax.jit(jax.vmap(solve_one))(jnp.asarray(Xs), jnp.asarray(ys))
+    for b in range(B):
+        single = solve_one(jnp.asarray(Xs[b]), jnp.asarray(ys[b]))
+        np.testing.assert_allclose(batched.coef[b], single.coef, rtol=1e-5, atol=1e-7)
+
+
+def test_minimize_dispatch_errors():
+    with pytest.raises(ValueError):
+        minimize(OptimizerType.TRON, lambda x: (x @ x, 2 * x), jnp.zeros(3))
