@@ -120,7 +120,11 @@ def precision_at_k(k: int, scores: Array, labels: Array,
     masked = jnp.where(w > 0, scores, -jnp.inf)
     order = jnp.argsort(-masked)
     topk = order[:k]
-    return jnp.mean(labels[topk] > 0.5)
+    # zero-weight pad rows may enter the top-k when fewer than k valid
+    # samples exist; they must not count as hits. The denominator stays k
+    # (reference: PrecisionAtKLocalEvaluator computes hits / k).
+    valid = w[topk] > 0
+    return jnp.sum((labels[topk] > 0.5) & valid) / k
 
 
 EVALUATORS: Dict[EvaluatorType, Callable[..., Array]] = {

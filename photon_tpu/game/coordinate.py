@@ -61,6 +61,7 @@ class FixedEffectCoordinate:
         self.config = config
         self.problem = GlmOptimizationProblem(task, config, norm or no_normalization())
         self._sampling_key = sampling_key
+        self._update_count = 0
 
     def update_model(
         self, prev: Optional[FixedEffectModel], residual_scores: Optional[Array]
@@ -71,8 +72,12 @@ class FixedEffectCoordinate:
         if residual_scores is not None:
             batch = batch.add_scores_to_offsets(residual_scores)
         if self._sampling_key is not None and self.config.down_sampling_rate < 1.0:
+            # fresh subsample per coordinate-descent sweep (the reference
+            # draws a new down-sample on every update)
+            key = jax.random.fold_in(self._sampling_key, self._update_count)
+            self._update_count += 1
             batch = maybe_downsample(batch, self.task,
-                                     self.config.down_sampling_rate, self._sampling_key)
+                                     self.config.down_sampling_rate, key)
         init = prev.model.coefficients.means if prev is not None else None
         model, _ = self.problem.run(
             batch, initial=init, dim=self.dim, dtype=batch.labels.dtype,

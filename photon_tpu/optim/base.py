@@ -91,17 +91,29 @@ def convergence_reason(
     g: Array,
     tols: Tolerances,
     max_iterations: int,
+    improved: Optional[Array] = None,
 ) -> Array:
     """Priority-ordered convergence decision, matching the reference order
     MaxIterations -> FunctionValuesConverged -> GradientConverged
     (Optimizer.scala:135-149). OBJECTIVE_NOT_IMPROVING is emitted by
-    solvers that track improvement failures (TRON), not here."""
+    solvers that track improvement failures (TRON), not here.
+
+    ``improved`` (bool) says the iterate actually changed this iteration:
+    a rejected step leaves f == f_prev, and |delta f| = 0 must NOT read as
+    FUNCTION_VALUES_CONVERGED — the reference classifies an unchanged
+    iterate as ObjectiveNotImproving before checking function values
+    (Optimizer.scala:140-142); here the solver's own failure counting
+    handles that, so the function-values check is simply gated off.
+    """
     gnorm = jnp.linalg.norm(g)
+    f_conv = jnp.abs(f_prev - f) <= tols.value_tol
+    if improved is not None:
+        f_conv = f_conv & improved
     reason = jnp.where(
         it >= max_iterations,
         ConvergenceReason.MAX_ITERATIONS,
         jnp.where(
-            jnp.abs(f_prev - f) <= tols.value_tol,
+            f_conv,
             ConvergenceReason.FUNCTION_VALUES_CONVERGED,
             jnp.where(
                 gnorm <= tols.gradient_tol,

@@ -152,7 +152,8 @@ def minimize(
         n_pairs = jnp.where(store, jnp.minimum(c.n_pairs + 1, m), c.n_pairs)
 
         it = c.it + 1
-        reason = convergence_reason(it, c.f, f_kept, g_kept, tols, config.max_iterations)
+        reason = convergence_reason(it, c.f, f_kept, g_kept, tols,
+                                    config.max_iterations, improved=decreased)
         # two consecutive failed line searches -> objective not improving
         both_failed = (~decreased) & c.ls_failed
         reason = jnp.where(

@@ -137,7 +137,8 @@ def minimize(
         n_pairs = jnp.where(store, jnp.minimum(c.n_pairs + 1, m), c.n_pairs)
 
         it = c.it + 1
-        reason = convergence_reason(it, c.f, f_kept, pg_new, tols, config.max_iterations)
+        reason = convergence_reason(it, c.f, f_kept, pg_new, tols,
+                                    config.max_iterations, improved=decreased)
         reason = jnp.where(
             (reason == ConvergenceReason.NOT_CONVERGED) & ~decreased,
             jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),

@@ -160,7 +160,8 @@ def minimize(
         failures = jnp.where(accept, 0, c.failures + 1).astype(jnp.int32)
 
         it = c.it + 1
-        reason = convergence_reason(it, c.f, f_new, g_new, tols, config.max_iterations)
+        reason = convergence_reason(it, c.f, f_new, g_new, tols,
+                                    config.max_iterations, improved=accept)
         reason = jnp.where(
             (reason == ConvergenceReason.NOT_CONVERGED)
             & (failures >= config.max_improvement_failures),

@@ -87,7 +87,12 @@ def test_tron_poisson(rng):
     hv = lambda c, v: obj.hessian_vector(c, v, batch, hyper)
     res = tron.minimize(vg, hv, jnp.zeros(D),
                         config=SolverConfig(max_iterations=60, tolerance=1e-12))
-    assert float(jnp.linalg.norm(res.gradient)) < 1e-6
+    # the f0-relative value tolerance may legitimately fire before the
+    # gradient tolerance (an accepted decrease of ~1e-10 <= 1e-12*|f0|), so
+    # assert a *converged* reason and a near-stationary point, not 1e-6
+    assert int(res.reason) in (ConvergenceReason.FUNCTION_VALUES_CONVERGED,
+                               ConvergenceReason.GRADIENT_CONVERGED)
+    assert float(jnp.linalg.norm(res.gradient)) < 1e-4
     # recovered coefficients close to truth on easy data
     assert float(jnp.linalg.norm(res.coef - w)) / np.linalg.norm(w) < 0.35
 
@@ -111,13 +116,29 @@ def test_owlqn_l1_logistic_vs_sklearn(rng):
     assert set(np.nonzero(np.asarray(res.coef))[0]) == set(np.nonzero(sk.coef_[0])[0])
 
 
-def test_owlqn_produces_sparsity(rng):
-    batch, _, _ = make_logistic(rng)
+def test_owlqn_sparsity_path_vs_sklearn(rng):
+    """Support must match liblinear's along a whole lambda path, shrinking
+    to the empty model — genuine L1 sparsity, not incidental zeros."""
+    from sklearn.linear_model import LogisticRegression
+
+    batch, X, y = make_logistic(rng)
     obj = GLMObjective(LogisticLoss)
     vg = lambda c: obj.value_and_gradient(c, batch, Hyper.of(0.0, dtype=jnp.float64))
-    res = owlqn.minimize(vg, jnp.zeros(D), l1_weight=60.0,
-                         config=SolverConfig(tolerance=1e-10, max_iterations=200))
-    assert int(jnp.sum(res.coef != 0)) < D // 2
+    prev_nnz = D + 1
+    for lam, expect_nnz_below in [(60.0, None), (150.0, D // 2), (500.0, 1)]:
+        res = owlqn.minimize(vg, jnp.zeros(D), l1_weight=lam,
+                             config=SolverConfig(tolerance=1e-10, max_iterations=400))
+        sk = LogisticRegression(l1_ratio=1.0, C=1.0 / lam, solver="liblinear",
+                                fit_intercept=False, tol=1e-13, max_iter=20000)
+        sk.fit(X, y)
+        ours = set(np.nonzero(np.asarray(res.coef))[0])
+        theirs = set(np.nonzero(sk.coef_[0])[0])
+        assert ours == theirs, f"lambda={lam}: support {ours} != sklearn {theirs}"
+        nnz = len(ours)
+        assert nnz <= prev_nnz
+        prev_nnz = nnz
+        if expect_nnz_below is not None:
+            assert nnz < expect_nnz_below
 
 
 def test_box_constrained_lbfgs(rng):
