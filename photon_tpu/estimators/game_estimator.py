@@ -93,7 +93,11 @@ class GameEstimator:
         validation_evaluators: Optional[Sequence[EvaluatorType]] = None,
         locked_coordinates: Sequence[str] = (),
         dtype=jnp.float32,
+        mesh=None,
     ):
+        """``mesh``: a `jax.sharding.Mesh` — fixed-effect batches are
+        sample-sharded and random-effect entity blocks entity-sharded over
+        its data axis, so each coordinate's solve runs SPMD (SURVEY §5.8)."""
         self.task = task
         self.coordinate_configs = coordinate_configs
         self.update_sequence = update_sequence or list(coordinate_configs.keys())
@@ -102,6 +106,7 @@ class GameEstimator:
             else [default_evaluator_for_task(task)]
         self.locked = frozenset(locked_coordinates)
         self.dtype = dtype
+        self.mesh = mesh
 
     # -- dataset / coordinate preparation ----------------------------------
 
@@ -116,14 +121,15 @@ class GameEstimator:
                 re_datasets[cid] = ds
                 coordinates[cid] = RandomEffectCoordinate(
                     ds, df.num_samples, cfg.data.random_effect_type,
-                    cfg.data.feature_shard_id, self.task, cfg.optimization)
+                    cfg.data.feature_shard_id, self.task, cfg.optimization,
+                    mesh=self.mesh)
             else:
                 shard_id = cfg.data.feature_shard_id
                 batch = df.fixed_effect_batch(shard_id, dtype=np.dtype(self.dtype).type)
                 key = jax.random.PRNGKey(sampling_seed + i)
                 coordinates[cid] = FixedEffectCoordinate(
                     batch, df.feature_shards[shard_id].dim, shard_id, self.task,
-                    cfg.optimization, sampling_key=key)
+                    cfg.optimization, sampling_key=key, mesh=self.mesh)
         return coordinates, re_datasets
 
     def _build_scorer(self, df: GameDataFrame, vocab: EntityVocabulary,

@@ -51,9 +51,16 @@ class FixedEffectCoordinate:
         config: GLMOptimizationConfiguration = GLMOptimizationConfiguration(),
         norm=None,
         sampling_key: Optional[jax.Array] = None,
+        mesh=None,
     ):
         from photon_tpu.ops.normalization import no_normalization
 
+        self._n_orig = batch.num_samples
+        if mesh is not None:
+            from photon_tpu.parallel import mesh as M
+            # sample-shard once at construction; every solve and score pass
+            # then runs SPMD over the data axis
+            batch = M.shard_batch(batch, mesh)
         self.batch = batch
         self.dim = dim
         self.feature_shard_id = feature_shard_id
@@ -62,6 +69,7 @@ class FixedEffectCoordinate:
         self.problem = GlmOptimizationProblem(task, config, norm or no_normalization())
         self._sampling_key = sampling_key
         self._update_count = 0
+        self.mesh = mesh
 
     def update_model(
         self, prev: Optional[FixedEffectModel], residual_scores: Optional[Array]
@@ -70,6 +78,9 @@ class FixedEffectCoordinate:
         (= dataset.addScoresToOffsets + runWithSampling)."""
         batch = self.batch
         if residual_scores is not None:
+            extra = batch.num_samples - residual_scores.shape[0]
+            if extra:  # mesh padding: zero residual on zero-weight pad rows
+                residual_scores = jnp.pad(residual_scores, (0, extra))
             batch = batch.add_scores_to_offsets(residual_scores)
         if self._sampling_key is not None and self.config.down_sampling_rate < 1.0:
             # fresh subsample per coordinate-descent sweep (the reference
@@ -98,8 +109,12 @@ class FixedEffectCoordinate:
 
     def score(self, model: FixedEffectModel) -> Array:
         """Training-data scores WITHOUT offsets — coordinate-descent score
-        algebra sums raw model scores (reference: scoreForCoordinateDescent)."""
-        return self._score_fn(model.model.coefficients.means)
+        algebra sums raw model scores (reference: scoreForCoordinateDescent).
+        Mesh pad rows are sliced off so score algebra stays [n]."""
+        s = self._score_fn(model.model.coefficients.means)
+        if s.shape[0] != self._n_orig:
+            s = s[: self._n_orig]
+        return s
 
 
 class RandomEffectCoordinate:
@@ -114,7 +129,16 @@ class RandomEffectCoordinate:
         feature_shard_id: str,
         task: TaskType,
         config: GLMOptimizationConfiguration = GLMOptimizationConfiguration(),
+        mesh=None,
     ):
+        self._num_entities_orig = dataset.num_entities
+        if mesh is not None:
+            from photon_tpu.parallel import mesh as M
+            # entity-shard once at construction (the co-partitioning
+            # replacement); the vmapped solves are independent per entity,
+            # so this axis runs collective-free
+            dataset = M.shard_entity_blocks(dataset, mesh,
+                                            num_flat_samples=num_flat_samples)
         self.dataset = dataset
         self.n = num_flat_samples
         self.random_effect_type = random_effect_type
@@ -122,6 +146,7 @@ class RandomEffectCoordinate:
         self.task = task
         self.config = config
         self.objective = GLMObjective(loss_for_task(task))
+        self.mesh = mesh
 
     @functools.cached_property
     def _solve_fn(self):
@@ -163,10 +188,14 @@ class RandomEffectCoordinate:
         dtype = ds.labels.dtype
         coef0 = (prev.coefficients if prev is not None
                  else jnp.zeros((ds.num_entities, ds.projected_dim), dtype))
+        coef0 = self._pad_entity_rows(coef0)
         lam = self.config.regularization_weight
         l2 = jnp.asarray(self.config.regularization.l2_weight(lam), dtype)
         l1 = jnp.asarray(self.config.regularization.l1_weight(lam), dtype)
         coefs = self._solve_fn(residual_scores, coef0, l2, l1)
+        # publish the model at the vocabulary's true entity count; mesh
+        # padding stays an internal detail of this coordinate
+        coefs = coefs[: self._num_entities_orig]
         return RandomEffectModel(
             coefficients=coefs,
             random_effect_type=self.random_effect_type,
@@ -174,6 +203,16 @@ class RandomEffectCoordinate:
             task=self.task,
             variances=None,
         )
+
+    def _pad_entity_rows(self, coef_block: Array) -> Array:
+        """Match a model's entity rows to this coordinate's (possibly
+        mesh-padded) block: pad with zero rows or slice down."""
+        extra = self.dataset.num_entities - coef_block.shape[0]
+        if extra > 0:
+            coef_block = jnp.pad(coef_block, [(0, extra), (0, 0)])
+        elif extra < 0:
+            coef_block = coef_block[: self.dataset.num_entities]
+        return coef_block
 
     @functools.cached_property
     def _score_fn(self):
@@ -202,4 +241,4 @@ class RandomEffectCoordinate:
         return score
 
     def score(self, model: RandomEffectModel) -> Array:
-        return self._score_fn(model.coefficients)
+        return self._score_fn(self._pad_entity_rows(model.coefficients))

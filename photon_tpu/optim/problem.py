@@ -118,13 +118,24 @@ class GlmOptimizationProblem:
         dim: Optional[int] = None,
         dtype=jnp.float32,
         regularization_weight: Optional[float] = None,
+        mesh=None,
     ) -> Tuple[GeneralizedLinearModel, SolverResult]:
         """Solve and return (model, solver stats). Variances are computed
         separately via ``compute_variances`` (reference behavior: variances
-        only on the final model)."""
+        only on the final model).
+
+        With ``mesh``, the batch is sample-sharded over the mesh's data
+        axis and the coefficients replicated before the jitted solve — the
+        whole optimize loop then runs as ONE SPMD program whose gradient
+        reductions are all-reduces over ICI (the treeAggregate + broadcast
+        replacement, SURVEY §5.8)."""
         if initial is None:
             assert dim is not None, "need dim when no initial coefficients"
             initial = jnp.zeros((dim,), dtype)
+        if mesh is not None:
+            from photon_tpu.parallel import mesh as M
+            batch = M.shard_batch(batch, mesh)
+            initial = M.replicate(initial, mesh)
         lam = (self.config.regularization_weight
                if regularization_weight is None else regularization_weight)
         l2 = jnp.asarray(self.config.regularization.l2_weight(lam), initial.dtype)

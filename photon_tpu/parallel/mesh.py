@@ -1,16 +1,32 @@
-"""Device mesh + sharding helpers: the Spark-cluster replacement.
+"""Device mesh + sharding: the Spark-cluster replacement, wired into training.
 
 Reference §5.8: Spark broadcasts / treeAggregate / shuffle joins become one
 SPMD program on a `jax.sharding.Mesh`. Conventions:
 
-  * axis "data"   — batch (sample) sharding; gradient reductions ride ICI
-                    as psum (the treeAggregate replacement).
+  * axis "data"   — batch (sample) sharding; the `jnp.sum` reductions inside
+                    the aggregator kernels (ops/aggregators.py) lower to
+                    `all-reduce` over this axis — the treeAggregate
+                    replacement (ValueAndGradientAggregator.scala:240-255).
   * axis "entity" — random-effect entity-block sharding (the co-partitioned
-                    RandomEffectDataset replacement).
+                    RandomEffectDataset replacement,
+                    RandomEffectDatasetPartitioner.scala:44). Entity solves
+                    are independent, so this axis needs no collectives.
+  * axis "model"  — feature-dimension sharding of theta for billion-feature
+                    fixed effects (SURVEY §5.7): partial dots per shard,
+                    psum to form margins.
 
 Parameters are replicated (`PartitionSpec()`) — the broadcast-variable
-replacement; feature-sharded theta for billion-feature fixed effects is the
-model-parallel extension (SURVEY §5.7).
+replacement (DistributedObjectiveFunction.scala:34).
+
+The reference's `treeAggregateDepth` knob (GameEstimator.scala:100) has no
+equivalent degree of freedom here: ICI all-reduce topology is chosen by the
+XLA compiler/hardware, so the knob is intentionally absent.
+
+Divisibility: NamedSharding needs leading dims divisible by the mesh axis
+size, so `pad_batch` / `pad_entities` append zero-weight rows / empty
+entity blocks. Zero-weight pads contribute exactly nothing to any
+aggregator (every per-sample term is multiplied by its weight) or metric
+(all evaluators are weighted).
 """
 
 from __future__ import annotations
@@ -18,11 +34,16 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.ops import features as F
+
 DATA_AXIS = "data"
 ENTITY_AXIS = "entity"
+MODEL_AXIS = "model"
 
 
 def create_mesh(
@@ -38,21 +59,69 @@ def create_mesh(
     return Mesh(devs.reshape(tuple(shape)), tuple(axis_names))
 
 
-def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
-    """Leading-dim sharding for sample-major arrays."""
-    return NamedSharding(mesh, P(axis))
-
-
 def replicated(mesh: Mesh) -> NamedSharding:
     """Fully replicated (the broadcast-variable equivalent)."""
     return NamedSharding(mesh, P())
 
 
-def shard_batch(batch, mesh: Mesh, axis: str = DATA_AXIS):
-    """Place every array of a DataBatch pytree with its leading dim sharded
-    over ``axis``. Pads are the caller's job (static shapes)."""
-    sharding = batch_sharding(mesh, axis)
-    return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return ((n + k - 1) // k) * k
+
+
+# -- batch padding + placement (fixed-effect path) --------------------------
+
+def pad_batch(batch: DataBatch, multiple: int) -> DataBatch:
+    """Append zero-weight samples until num_samples % multiple == 0.
+
+    Weights are materialized (implicit all-ones otherwise) so pads carry
+    weight 0 and vanish from every aggregator sum.
+    """
+    n = batch.num_samples
+    n_pad = pad_to_multiple(n, multiple)
+    if n_pad == n and batch.weights is not None:
+        return batch
+    extra = n_pad - n
+
+    def pad0(a, rows):
+        if a is None:
+            return None
+        widths = [(0, rows)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths)
+
+    feats = batch.features
+    if isinstance(feats, F.SparseFeatures):
+        feats = F.SparseFeatures(pad0(feats.indices, extra), pad0(feats.values, extra))
+    else:
+        feats = pad0(feats, extra)
+    weights = batch.weights if batch.weights is not None \
+        else jnp.ones_like(batch.labels)
+    return DataBatch(
+        features=feats,
+        labels=pad0(batch.labels, extra),
+        offsets=pad0(batch.offsets, extra),
+        weights=pad0(weights, extra),
+    )
+
+
+def shard_batch(batch: DataBatch, mesh: Mesh, axis: str = DATA_AXIS) -> DataBatch:
+    """Pad + place a DataBatch with its sample dim sharded over ``axis``.
+
+    The treeAggregate replacement: once inputs are placed this way, the
+    jitted aggregator kernels' reductions compile to all-reduce over ICI.
+    """
+    batch = pad_batch(batch, axis_size(mesh, axis))
+
+    def put(a):
+        if a is None:
+            return None
+        spec = P(axis, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, batch)
 
 
 def replicate(params, mesh: Mesh):
@@ -60,5 +129,92 @@ def replicate(params, mesh: Mesh):
     return jax.tree.map(lambda a: jax.device_put(a, sharding), params)
 
 
-def pad_to_multiple(n: int, k: int) -> int:
-    return ((n + k - 1) // k) * k
+# -- entity-block padding + placement (random-effect path) -------------------
+
+def pad_entities(ds, multiple: int, num_flat_samples: Optional[int] = None):
+    """Pad a RandomEffectDataset's entity dim (and passive rows) so both
+    shard evenly; pad entities have zero-weight samples and scatter rows at
+    the drop sentinel ``num_flat_samples`` (the documented 'n on pads'
+    invariant of RandomEffectDataset.sample_rows)."""
+    from photon_tpu.game.random_effect import RandomEffectDataset
+
+    E = ds.num_entities
+    E_pad = pad_to_multiple(E, multiple)
+    Ppas = ds.passive_entity.shape[0]
+    P_pad = pad_to_multiple(Ppas, multiple)
+    if E_pad == E and P_pad == Ppas:
+        return ds
+
+    def pad0(a, rows, fill=0):
+        widths = [(0, rows)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths, constant_values=fill)
+
+    eE, eP = E_pad - E, P_pad - Ppas
+    n_sentinel = (num_flat_samples if num_flat_samples is not None
+                  else int(jnp.max(ds.sample_rows)) if ds.sample_rows.size else 0)
+    return RandomEffectDataset(
+        features=F.SparseFeatures(pad0(ds.features.indices, eE),
+                                  pad0(ds.features.values, eE)),
+        labels=pad0(ds.labels, eE),
+        offsets=pad0(ds.offsets, eE),
+        weights=pad0(ds.weights, eE),
+        sample_rows=pad0(ds.sample_rows, eE, fill=n_sentinel),
+        passive_features=F.SparseFeatures(pad0(ds.passive_features.indices, eP),
+                                          pad0(ds.passive_features.values, eP)),
+        passive_entity=pad0(ds.passive_entity, eP, fill=E_pad),
+        passive_rows=pad0(ds.passive_rows, eP, fill=n_sentinel),
+        projection=pad0(ds.projection, eE, fill=-1),
+    )
+
+
+def shard_entity_blocks(ds, mesh: Mesh, axis: str = DATA_AXIS,
+                        num_flat_samples: Optional[int] = None):
+    """Pad + place a RandomEffectDataset with entities (and passive rows)
+    sharded over ``axis`` — the static replacement for the reference's
+    entity co-partitioning (RandomEffectDatasetPartitioner.scala:44)."""
+    ds = pad_entities(ds, axis_size(mesh, axis), num_flat_samples)
+
+    def put(a):
+        spec = P(axis, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, ds)
+
+
+# -- feature-dimension (model-parallel) sharding -----------------------------
+
+def shard_features_model_parallel(batch: DataBatch, mesh: Mesh,
+                                  data_axis: str = DATA_AXIS,
+                                  model_axis: str = MODEL_AXIS) -> DataBatch:
+    """Dense-feature model sharding: X is [n, d] sharded (data, model),
+    per-sample vectors sharded (data,). Used with a theta placed P(model)
+    so margins are psum-ed partial dots (SURVEY §5.7 — the moral
+    equivalent of sequence parallelism for billion-feature fixed effects)."""
+    assert not isinstance(batch.features, F.SparseFeatures), \
+        "model-parallel sharding needs dense features"
+    d_mult = axis_size(mesh, model_axis)
+    batch = pad_batch(batch, axis_size(mesh, data_axis))
+    x = batch.features
+    d = x.shape[1]
+    d_pad = pad_to_multiple(d, d_mult)
+    if d_pad != d:
+        x = jnp.pad(x, [(0, 0), (0, d_pad - d)])
+    x = jax.device_put(x, NamedSharding(mesh, P(data_axis, model_axis)))
+
+    def put_vec(a):
+        return None if a is None else jax.device_put(
+            a, NamedSharding(mesh, P(data_axis)))
+
+    return DataBatch(features=x, labels=put_vec(batch.labels),
+                     offsets=put_vec(batch.offsets),
+                     weights=put_vec(batch.weights))
+
+
+def shard_coef_model_parallel(coef: jax.Array, mesh: Mesh,
+                              model_axis: str = MODEL_AXIS) -> jax.Array:
+    d_mult = axis_size(mesh, model_axis)
+    d = coef.shape[0]
+    d_pad = pad_to_multiple(d, d_mult)
+    if d_pad != d:
+        coef = jnp.pad(coef, [(0, d_pad - d)])
+    return jax.device_put(coef, NamedSharding(mesh, P(model_axis)))

@@ -1,0 +1,155 @@
+"""SPMD execution tests on the 8-virtual-device mesh: sharded solves must
+match single-device results and the compiled programs must actually
+communicate (all-reduce in HLO) — the proof that the treeAggregate
+replacement (SURVEY §5.8) executes, not just exists.
+
+Reference behaviors being replaced: ValueAndGradientAggregator.scala:240-255
+(treeAggregate), DistributedObjectiveFunction.scala:34 (coefficient
+broadcast), RandomEffectCoordinate.scala:104-129 (co-partitioned per-entity
+solves)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.function.objective import GLMObjective, Hyper
+from photon_tpu.ops import features as F
+from photon_tpu.ops.losses import LogisticLoss
+from photon_tpu.parallel import mesh as M
+from photon_tpu.optim.problem import GlmOptimizationProblem, GLMOptimizationConfiguration, OptimizerConfig
+from photon_tpu.types import TaskType
+
+from tests.test_game import glmix, glmix_estimator, make_glmix_frame  # noqa: F401
+
+
+def make_logistic(rng, n=1024, d=16):
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-X @ w))).astype(np.float64)
+    return DataBatch(jnp.asarray(X), jnp.asarray(y)), X, y
+
+
+def test_sharded_gradient_matches_and_allreduces(rng, devices8):
+    """Data-sharded value+gradient == replicated result, and the compiled
+    HLO contains an all-reduce (the treeAggregate equivalent on ICI)."""
+    batch, _, _ = make_logistic(rng)
+    mesh = M.create_mesh()
+    obj = GLMObjective(LogisticLoss)
+    hyper = Hyper.of(0.3, dtype=jnp.float64)
+    coef = jnp.asarray(rng.normal(size=16))
+
+    f_ref, g_ref = obj.value_and_gradient(coef, batch, hyper)
+
+    sharded = M.shard_batch(batch, mesh)
+    coef_r = M.replicate(coef, mesh)
+    fn = jax.jit(lambda c, b: obj.value_and_gradient(c, b, hyper))
+    f_sh, g_sh = fn(coef_r, sharded)
+
+    np.testing.assert_allclose(float(f_sh), float(f_ref), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(g_sh), np.asarray(g_ref), rtol=1e-10)
+
+    hlo = fn.lower(coef_r, sharded).compile().as_text()
+    assert "all-reduce" in hlo, "sharded gradient must communicate over the mesh"
+
+
+def test_sharded_solve_matches_single_device(rng, devices8):
+    """A whole L-BFGS solve over the sharded batch equals the unsharded
+    solve (the reference's Distributed vs SingleNode parity)."""
+    batch, _, _ = make_logistic(rng, n=1000)  # 1000 % 8 != 0: exercises padding
+    mesh = M.create_mesh()
+    problem = GlmOptimizationProblem(
+        TaskType.LOGISTIC_REGRESSION,
+        GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(max_iterations=200, tolerance=1e-12)),
+    )
+    m_single, r_single = problem.run(batch, dim=16, dtype=jnp.float64,
+                                     regularization_weight=1.0)
+    problem2 = GlmOptimizationProblem(
+        TaskType.LOGISTIC_REGRESSION,
+        GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(max_iterations=200, tolerance=1e-12)),
+    )
+    m_mesh, r_mesh = problem2.run(batch, dim=16, dtype=jnp.float64,
+                                  regularization_weight=1.0, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(m_mesh.coefficients.means),
+                               np.asarray(m_single.coefficients.means),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_zero_weight_padding_is_exact(rng, devices8):
+    """Padding to the device multiple must not change value or gradient."""
+    batch, _, _ = make_logistic(rng, n=997)  # prime: heavy padding
+    obj = GLMObjective(LogisticLoss)
+    hyper = Hyper.of(0.0, dtype=jnp.float64)
+    coef = jnp.asarray(rng.normal(size=16))
+    f0, g0 = obj.value_and_gradient(coef, batch, hyper)
+    padded = M.pad_batch(batch, 8)
+    assert padded.num_samples == 1000
+    f1, g1 = obj.value_and_gradient(coef, padded, hyper)
+    np.testing.assert_allclose(float(f1), float(f0), rtol=1e-14)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=1e-14)
+
+
+def test_game_estimator_mesh_parity(glmix, devices8):  # noqa: F811
+    """GLMix fit on the 8-device mesh == single-device fit (sharded fixed
+    batch + entity-sharded random effects), and validation AUC matches."""
+    train, val, _ = glmix
+    mesh = M.create_mesh()
+
+    est_single = glmix_estimator(num_iterations=1)
+    res_single = est_single.fit(train, validation_df=val)[-1]
+
+    est_mesh = glmix_estimator(num_iterations=1)
+    est_mesh.mesh = mesh
+    res_mesh = est_mesh.fit(train, validation_df=val)[-1]
+
+    fixed_s = res_single.model["fixed"].model.coefficients.means
+    fixed_m = res_mesh.model["fixed"].model.coefficients.means
+    np.testing.assert_allclose(np.asarray(fixed_m), np.asarray(fixed_s),
+                               rtol=1e-6, atol=1e-8)
+
+    re_s = np.asarray(res_single.model["per-user"].coefficients)
+    re_m = np.asarray(res_mesh.model["per-user"].coefficients)
+    # published models carry the vocabulary's true entity count either way
+    assert re_m.shape == re_s.shape
+    np.testing.assert_allclose(re_m, re_s, rtol=1e-6, atol=1e-8)
+
+    assert abs(res_mesh.evaluation["AUC"] - res_single.evaluation["AUC"]) < 1e-9
+
+
+def test_entity_sharded_blocks_cover_all_devices(glmix, devices8):  # noqa: F811
+    """Entity blocks must actually land sharded across the mesh."""
+    train, _, _ = glmix
+    mesh = M.create_mesh()
+    est = glmix_estimator(num_iterations=1)
+    est.mesh = mesh
+    est.fit(train)
+    from photon_tpu.game.coordinate import RandomEffectCoordinate
+    # rebuild a coordinate directly to inspect placement
+    ds = est._re_datasets["per-user"]
+    coord = RandomEffectCoordinate(ds, train.num_samples, "userId",
+                                   "user_feats", TaskType.LOGISTIC_REGRESSION,
+                                   mesh=mesh)
+    sharding = coord.dataset.labels.sharding
+    assert len(sharding.device_set) == 8, "entity blocks not spread over mesh"
+
+
+def test_model_parallel_margins_allreduce(rng, devices8):
+    """Feature-dimension sharding of theta (SURVEY §5.7): dense X sharded
+    (data, model), theta sharded (model,) -> psum-ed partial dots."""
+    n, d = 256, 64
+    X = rng.normal(size=(n, d))
+    coef = rng.normal(size=d)
+    mesh = M.create_mesh(axis_names=(M.DATA_AXIS, M.MODEL_AXIS), shape=(4, 2))
+    batch = M.shard_features_model_parallel(
+        DataBatch(jnp.asarray(X), jnp.zeros(n)), mesh)
+    theta = M.shard_coef_model_parallel(jnp.asarray(coef), mesh)
+
+    fn = jax.jit(lambda x, t: F.matvec(x, t))
+    margins = fn(batch.features, theta)
+    np.testing.assert_allclose(np.asarray(margins), X @ coef, rtol=1e-10)
+    hlo = fn.lower(batch.features, theta).compile().as_text()
+    assert "all-reduce" in hlo, "model-parallel matvec must psum partial dots"
