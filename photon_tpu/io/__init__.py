@@ -1,0 +1,43 @@
+"""IO layer: Avro codec, data contracts, index maps, model persistence.
+
+Replaces the reference's photon-avro-schemas module + photon-client Avro
+IO stack (AvroDataReader/AvroUtils/ModelProcessingUtils) without a JVM.
+"""
+
+from photon_tpu.io.avro import AvroFileReader, iter_avro_dir, read_avro, write_avro
+from photon_tpu.io.data_io import (
+    FeatureShardConfiguration,
+    build_index_maps,
+    read_game_dataframe,
+    records_to_game_dataframe,
+    write_scores,
+    write_training_examples,
+)
+from photon_tpu.io.index_map import (
+    DELIMITER,
+    INTERCEPT_KEY,
+    INTERCEPT_NAME,
+    INTERCEPT_TERM,
+    IndexMap,
+    IndexMapBuilder,
+    feature_key,
+    split_feature_key,
+)
+from photon_tpu.io.model_io import (
+    DEFAULT_SPARSITY_THRESHOLD,
+    LoadedGameModel,
+    load_game_model,
+    load_model_metadata,
+    save_game_model,
+    save_model_metadata,
+)
+
+__all__ = [
+    "AvroFileReader", "read_avro", "write_avro", "iter_avro_dir",
+    "FeatureShardConfiguration", "build_index_maps", "read_game_dataframe",
+    "records_to_game_dataframe", "write_scores", "write_training_examples",
+    "IndexMap", "IndexMapBuilder", "feature_key", "split_feature_key",
+    "DELIMITER", "INTERCEPT_KEY", "INTERCEPT_NAME", "INTERCEPT_TERM",
+    "LoadedGameModel", "load_game_model", "save_game_model",
+    "load_model_metadata", "save_model_metadata", "DEFAULT_SPARSITY_THRESHOLD",
+]

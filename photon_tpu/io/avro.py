@@ -1,0 +1,426 @@
+"""Pure-Python Avro object-container codec.
+
+The reference's layer-0 data contract is Avro (photon-avro-schemas/
+src/main/avro/*.avsc; files written/read via Spark + avro-mapred,
+reference: photon-client data/avro/AvroUtils.scala:47). The TPU build has
+no JVM, so this module implements the Avro 1.x binary encoding and the
+object-container file format from the specification directly: enough to
+read the reference's training data and write/read models the reference
+can consume byte-for-byte.
+
+Supported: null/boolean/int/long/float/double/bytes/string, records,
+enums, arrays, maps, unions, fixed; container codecs ``null`` and
+``deflate``. Schema resolution is writer-schema-only (no reader-schema
+projection) — sufficient for framework parity.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import json
+import os
+import struct
+import zlib
+from typing import Any, BinaryIO, Dict, Iterable, Iterator, List, Optional, Tuple
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+
+# ---------------------------------------------------------------------------
+# Schema handling: schemas are plain parsed-JSON values (dict/list/str).
+# Named types may be referenced by full name after first definition.
+# ---------------------------------------------------------------------------
+
+_PRIMITIVES = {"null", "boolean", "int", "long", "float", "double", "bytes", "string"}
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def _full_name(schema: dict, enclosing_ns: Optional[str]) -> str:
+    name = schema["name"]
+    if "." in name:
+        return name
+    ns = schema.get("namespace", enclosing_ns)
+    return f"{ns}.{name}" if ns else name
+
+
+class _Names:
+    """Registry of named types seen while walking a schema."""
+
+    def __init__(self):
+        self.types: Dict[str, dict] = {}
+
+    def resolve(self, schema: Any, enclosing_ns: Optional[str] = None) -> Any:
+        """Return the concrete schema for ``schema``, registering named types."""
+        if isinstance(schema, str):
+            if schema in _PRIMITIVES:
+                return schema
+            for cand in (schema, f"{enclosing_ns}.{schema}" if enclosing_ns else None):
+                if cand and cand in self.types:
+                    return self.types[cand]
+            raise SchemaError(f"unknown type reference: {schema!r}")
+        if isinstance(schema, list):
+            return schema
+        t = schema.get("type")
+        if t in ("record", "enum", "fixed"):
+            self.types[_full_name(schema, enclosing_ns)] = schema
+        return schema
+
+
+# ---------------------------------------------------------------------------
+# Binary decoder
+# ---------------------------------------------------------------------------
+
+
+class BinaryDecoder:
+    def __init__(self, buf: bytes):
+        self._buf = buf
+        self._pos = 0
+
+    def eof(self) -> bool:
+        return self._pos >= len(self._buf)
+
+    def read(self, n: int) -> bytes:
+        b = self._buf[self._pos:self._pos + n]
+        if len(b) != n:
+            raise EOFError("truncated avro data")
+        self._pos += n
+        return b
+
+    def read_long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            b = self._buf[self._pos]
+            self._pos += 1
+            acc |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)  # zigzag
+
+    read_int = read_long
+
+    def read_boolean(self) -> bool:
+        return self.read(1) != b"\x00"
+
+    def read_float(self) -> float:
+        return struct.unpack("<f", self.read(4))[0]
+
+    def read_double(self) -> float:
+        return struct.unpack("<d", self.read(8))[0]
+
+    def read_bytes(self) -> bytes:
+        return self.read(self.read_long())
+
+    def read_string(self) -> str:
+        return self.read_bytes().decode("utf-8")
+
+
+class BinaryEncoder:
+    def __init__(self):
+        self._out = _io.BytesIO()
+
+    def getvalue(self) -> bytes:
+        return self._out.getvalue()
+
+    def write(self, b: bytes):
+        self._out.write(b)
+
+    def write_long(self, v: int):
+        v = (v << 1) ^ (v >> 63) if v >= 0 else (((-v - 1) << 1) | 1)
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+        self._out.write(bytes(out))
+
+    write_int = write_long
+
+    def write_boolean(self, v: bool):
+        self._out.write(b"\x01" if v else b"\x00")
+
+    def write_float(self, v: float):
+        self._out.write(struct.pack("<f", v))
+
+    def write_double(self, v: float):
+        self._out.write(struct.pack("<d", v))
+
+    def write_bytes(self, v: bytes):
+        self.write_long(len(v))
+        self._out.write(v)
+
+    def write_string(self, v: str):
+        self.write_bytes(v.encode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# Datum reader / writer (schema-driven)
+# ---------------------------------------------------------------------------
+
+
+def _read_datum(dec: BinaryDecoder, schema: Any, names: _Names,
+                ns: Optional[str] = None) -> Any:
+    schema = names.resolve(schema, ns)
+    if isinstance(schema, list):  # union: long index then value
+        idx = dec.read_long()
+        return _read_datum(dec, schema[idx], names, ns)
+    if isinstance(schema, str):
+        if schema == "null":
+            return None
+        if schema == "boolean":
+            return dec.read_boolean()
+        if schema in ("int", "long"):
+            return dec.read_long()
+        if schema == "float":
+            return dec.read_float()
+        if schema == "double":
+            return dec.read_double()
+        if schema == "bytes":
+            return dec.read_bytes()
+        if schema == "string":
+            return dec.read_string()
+        raise SchemaError(f"bad primitive {schema!r}")
+    t = schema["type"]
+    if t in _PRIMITIVES:
+        return _read_datum(dec, t, names, ns)
+    if t == "record":
+        rec_ns = schema.get("namespace", ns)
+        return {f["name"]: _read_datum(dec, f["type"], names, rec_ns)
+                for f in schema["fields"]}
+    if t == "enum":
+        return schema["symbols"][dec.read_long()]
+    if t == "fixed":
+        return dec.read(schema["size"])
+    if t == "array":
+        out: List[Any] = []
+        while True:
+            count = dec.read_long()
+            if count == 0:
+                break
+            if count < 0:  # block with byte size
+                dec.read_long()
+                count = -count
+            for _ in range(count):
+                out.append(_read_datum(dec, schema["items"], names, ns))
+        return out
+    if t == "map":
+        m: Dict[str, Any] = {}
+        while True:
+            count = dec.read_long()
+            if count == 0:
+                break
+            if count < 0:
+                dec.read_long()
+                count = -count
+            for _ in range(count):
+                k = dec.read_string()
+                m[k] = _read_datum(dec, schema["values"], names, ns)
+        return m
+    raise SchemaError(f"unsupported schema {schema!r}")
+
+
+def _union_branch(schema_list: list, datum: Any, names: _Names, ns) -> int:
+    """Pick the union branch for a Python datum (null/record/primitive)."""
+    for i, branch in enumerate(schema_list):
+        b = names.resolve(branch, ns)
+        bt = b if isinstance(b, str) else b.get("type")
+        if datum is None and bt == "null":
+            return i
+        if datum is not None and bt != "null":
+            if isinstance(datum, bool) and bt == "boolean":
+                return i
+            if isinstance(datum, int) and not isinstance(datum, bool) \
+                    and bt in ("int", "long"):
+                return i
+            if isinstance(datum, float) and bt in ("float", "double"):
+                return i
+            if isinstance(datum, str) and bt in ("string", "enum"):
+                return i
+            if isinstance(datum, bytes) and bt in ("bytes", "fixed"):
+                return i
+            if isinstance(datum, dict) and bt in ("record", "map"):
+                return i
+            if isinstance(datum, (list, tuple)) and bt == "array":
+                return i
+    raise SchemaError(f"no union branch for {type(datum)} in {schema_list}")
+
+
+def _write_datum(enc: BinaryEncoder, schema: Any, datum: Any, names: _Names,
+                 ns: Optional[str] = None):
+    schema = names.resolve(schema, ns)
+    if isinstance(schema, list):
+        idx = _union_branch(schema, datum, names, ns)
+        enc.write_long(idx)
+        _write_datum(enc, schema[idx], datum, names, ns)
+        return
+    if isinstance(schema, str):
+        if schema == "null":
+            return
+        if schema == "boolean":
+            enc.write_boolean(bool(datum))
+        elif schema in ("int", "long"):
+            enc.write_long(int(datum))
+        elif schema == "float":
+            enc.write_float(float(datum))
+        elif schema == "double":
+            enc.write_double(float(datum))
+        elif schema == "bytes":
+            enc.write_bytes(datum)
+        elif schema == "string":
+            enc.write_string(datum)
+        else:
+            raise SchemaError(f"bad primitive {schema!r}")
+        return
+    t = schema["type"]
+    if t in _PRIMITIVES:
+        _write_datum(enc, t, datum, names, ns)
+        return
+    if t == "record":
+        rec_ns = schema.get("namespace", ns)
+        for f in schema["fields"]:
+            name = f["name"]
+            if name in datum:
+                val = datum[name]
+            elif "default" in f:
+                val = f["default"]
+            else:
+                raise SchemaError(f"missing field {name} for {schema['name']}")
+            _write_datum(enc, f["type"], val, names, rec_ns)
+        return
+    if t == "enum":
+        enc.write_long(schema["symbols"].index(datum))
+        return
+    if t == "fixed":
+        enc.write(datum)
+        return
+    if t == "array":
+        if datum:
+            enc.write_long(len(datum))
+            for item in datum:
+                _write_datum(enc, schema["items"], item, names, ns)
+        enc.write_long(0)
+        return
+    if t == "map":
+        if datum:
+            enc.write_long(len(datum))
+            for k, v in datum.items():
+                enc.write_string(k)
+                _write_datum(enc, schema["values"], v, names, ns)
+        enc.write_long(0)
+        return
+    raise SchemaError(f"unsupported schema {schema!r}")
+
+
+# ---------------------------------------------------------------------------
+# Object container files
+# ---------------------------------------------------------------------------
+
+
+class AvroFileReader:
+    """Iterate records of one Avro object-container file."""
+
+    def __init__(self, fileobj: BinaryIO):
+        self._f = fileobj
+        header = fileobj.read(4)
+        if header != MAGIC:
+            raise SchemaError(f"not an avro container file (magic={header!r})")
+        meta_dec = BinaryDecoder(fileobj.read())  # rest of file
+        self._meta = _read_datum(meta_dec, {"type": "map", "values": "bytes"},
+                                 _Names())
+        self._sync = meta_dec.read(SYNC_SIZE)
+        self._body = meta_dec  # positioned at first block
+        self.schema = json.loads(self._meta[b"avro.schema"]
+                                 if b"avro.schema" in self._meta
+                                 else self._meta["avro.schema"])
+        codec = self._meta.get(b"avro.codec", self._meta.get("avro.codec", b"null"))
+        self.codec = codec.decode() if isinstance(codec, bytes) else codec
+        self._names = _Names()
+
+    def __iter__(self) -> Iterator[Any]:
+        dec = self._body
+        while not dec.eof():
+            count = dec.read_long()
+            nbytes = dec.read_long()
+            raw = dec.read(nbytes)
+            if self.codec == "deflate":
+                raw = zlib.decompress(raw, -15)
+            elif self.codec != "null":
+                raise SchemaError(f"unsupported codec {self.codec}")
+            block = BinaryDecoder(raw)
+            for _ in range(count):
+                yield _read_datum(block, self.schema, self._names)
+            sync = dec.read(SYNC_SIZE)
+            if sync != self._sync:
+                raise SchemaError("sync marker mismatch")
+
+
+def read_avro(path: str) -> Tuple[Any, List[Any]]:
+    """Read one container file -> (writer schema, list of records)."""
+    with open(path, "rb") as f:
+        r = AvroFileReader(f)
+        return r.schema, list(r)
+
+
+def iter_avro_dir(path: str) -> Iterator[Any]:
+    """Iterate records across all ``*.avro`` files in a directory (or a
+    single file) in name order — the reference reads part-files the same
+    way (AvroUtils.scala:47)."""
+    if os.path.isfile(path):
+        files = [path]
+    else:
+        files = sorted(
+            os.path.join(path, n) for n in os.listdir(path)
+            if n.endswith(".avro") and not n.startswith("."))
+    for fp in files:
+        with open(fp, "rb") as f:
+            yield from AvroFileReader(f)
+
+
+def write_avro(path: str, schema: Any, records: Iterable[Any],
+               codec: str = "deflate", sync_interval: int = 4000) -> None:
+    """Write records to one Avro object-container file."""
+    names = _Names()
+    sync = os.urandom(SYNC_SIZE)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        meta_enc = BinaryEncoder()
+        meta = {"avro.schema": json.dumps(schema).encode(),
+                "avro.codec": codec.encode()}
+        _write_datum(meta_enc, {"type": "map", "values": "bytes"}, meta, names)
+        f.write(meta_enc.getvalue())
+        f.write(sync)
+
+        buf = BinaryEncoder()
+        count = 0
+
+        def flush():
+            nonlocal buf, count
+            if count == 0:
+                return
+            raw = buf.getvalue()
+            if codec == "deflate":
+                comp = zlib.compressobj(6, zlib.DEFLATED, -15)
+                raw = comp.compress(raw) + comp.flush()
+            head = BinaryEncoder()
+            head.write_long(count)
+            head.write_long(len(raw))
+            f.write(head.getvalue())
+            f.write(raw)
+            f.write(sync)
+            buf = BinaryEncoder()
+            count = 0
+
+        for rec in records:
+            _write_datum(buf, schema, rec, names)
+            count += 1
+            if count >= sync_interval:
+                flush()
+        flush()

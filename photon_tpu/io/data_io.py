@@ -1,0 +1,232 @@
+"""Avro training data -> GameDataFrame; score/data writers.
+
+Reference: photon-client data/avro/AvroDataReader.scala (readMerged :34 —
+one sparse vector column per feature shard, shards merge feature bags,
+optional intercept; readFeaturesFromRecord :246), data/DataReader.scala,
+data/avro/AvroDataWriter.scala, GameScoringDriver.saveScoresToHDFS :187,
+data/InputColumnsNames.scala:25 (reserved columns uid/response/offset/
+weight), util/Utils.getFeatureKey (key = name + \\u0001 + term).
+
+TPU re-design: no DataFrame middleman — Avro records stream straight into
+the host-side columnar GameDataFrame (sparse rows per shard) from which
+static-shape device blocks are built.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+from photon_tpu.io import avro as avro_io
+from photon_tpu.io.index_map import (
+    INTERCEPT_KEY,
+    IndexMap,
+    feature_key,
+)
+from photon_tpu.io.schemas import SCORING_RESULT_AVRO, TRAINING_EXAMPLE_AVRO
+
+# Reference: InputColumnsNames.scala:25 — reserved columns, remappable.
+RESPONSE_COLUMNS = ("response", "label")
+OFFSET_COLUMN = "offset"
+WEIGHT_COLUMN = "weight"
+UID_COLUMN = "uid"
+METADATA_COLUMN = "metadataMap"
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureShardConfiguration:
+    """Reference: io/FeatureShardConfiguration.scala:23 — a shard merges
+    one or more feature bags (record fields holding FeatureAvro arrays),
+    optionally with an intercept column."""
+
+    feature_bags: Tuple[str, ...]
+    has_intercept: bool = True
+
+    @staticmethod
+    def of(*bags: str, intercept: bool = True) -> "FeatureShardConfiguration":
+        return FeatureShardConfiguration(tuple(bags), intercept)
+
+
+def _record_keys(record: dict, bags: Sequence[str]) -> Iterable[Tuple[str, float]]:
+    for bag in bags:
+        arr = record.get(bag)
+        if not arr:
+            continue
+        for f in arr:
+            yield feature_key(str(f["name"]), str(f["term"])), float(f["value"])
+
+
+def build_index_maps(
+    records: Iterable[dict],
+    shard_configs: Dict[str, FeatureShardConfiguration],
+) -> Dict[str, IndexMap]:
+    """Scan data once, build one IndexMap per shard (reference:
+    DefaultIndexMapLoader via GameDriver.prepareFeatureMapsDefault :155)."""
+    keys: Dict[str, set] = {sid: set() for sid in shard_configs}
+    for rec in records:
+        for sid, cfg in shard_configs.items():
+            for k, _ in _record_keys(rec, cfg.feature_bags):
+                keys[sid].add(k)
+    return {
+        sid: IndexMap.from_keys(keys[sid], add_intercept=cfg.has_intercept)
+        for sid, cfg in shard_configs.items()
+    }
+
+
+def records_to_game_dataframe(
+    records: Sequence[dict],
+    shard_configs: Dict[str, FeatureShardConfiguration],
+    index_maps: Dict[str, IndexMap],
+    id_tag_columns: Sequence[str] = (),
+    response_columns: Sequence[str] = RESPONSE_COLUMNS,
+) -> GameDataFrame:
+    """Assemble the columnar frame: response/offset/weight + one sparse
+    row set per shard + id tags (reference: AvroDataReader.readMerged +
+    GameConverters.getGameDatumFromRow)."""
+    n = len(records)
+    response = np.zeros(n)
+    offsets = np.zeros(n)
+    weights = np.ones(n)
+    any_offset = any_weight = False
+    id_tags: Dict[str, List[str]] = {c: [None] * n for c in id_tag_columns}
+    rows: Dict[str, List[Tuple[np.ndarray, np.ndarray]]] = {
+        sid: [None] * n for sid in shard_configs}
+
+    for i, rec in enumerate(records):
+        for col in response_columns:
+            if rec.get(col) is not None:
+                response[i] = float(rec[col])
+                break
+        else:
+            raise KeyError(f"record {i} has none of {response_columns}")
+        if rec.get(OFFSET_COLUMN) is not None:
+            offsets[i] = float(rec[OFFSET_COLUMN])
+            any_offset = True
+        if rec.get(WEIGHT_COLUMN) is not None:
+            weights[i] = float(rec[WEIGHT_COLUMN])
+            any_weight = True
+        meta = rec.get(METADATA_COLUMN) or {}
+        for col in id_tag_columns:
+            v = rec.get(col, meta.get(col))
+            if v is None:
+                raise KeyError(f"record {i} missing id tag column {col!r}")
+            id_tags[col][i] = str(v)
+        for sid, cfg in shard_configs.items():
+            imap = index_maps[sid]
+            idx: List[int] = []
+            val: List[float] = []
+            seen = {}
+            for k, v in _record_keys(rec, cfg.feature_bags):
+                j = imap.get_index(k)
+                if j < 0:
+                    continue  # unseen at index-build time -> dropped
+                if j in seen:  # duplicate (name, term): last wins (ref:
+                    idx[seen[j]] = j  # undefined behavior; we pick last)
+                    val[seen[j]] = v
+                    continue
+                seen[j] = len(idx)
+                idx.append(j)
+                val.append(v)
+            if cfg.has_intercept:
+                j = imap.get_index(INTERCEPT_KEY)
+                if j >= 0:
+                    idx.append(j)
+                    val.append(1.0)
+            rows[sid][i] = (np.asarray(idx, np.int32), np.asarray(val))
+
+    return GameDataFrame(
+        num_samples=n,
+        response=response,
+        feature_shards={
+            sid: FeatureShard(rows[sid], index_maps[sid].feature_dimension)
+            for sid in shard_configs},
+        offsets=offsets if any_offset else None,
+        weights=weights if any_weight else None,
+        id_tags=id_tags,
+    )
+
+
+def read_game_dataframe(
+    path: str,
+    shard_configs: Dict[str, FeatureShardConfiguration],
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+    id_tag_columns: Sequence[str] = (),
+) -> Tuple[GameDataFrame, Dict[str, IndexMap]]:
+    """Read a file or directory of Avro training records into a frame,
+    building index maps from the data when not supplied."""
+    records = list(avro_io.iter_avro_dir(path))
+    if index_maps is None:
+        index_maps = build_index_maps(records, shard_configs)
+    df = records_to_game_dataframe(records, shard_configs, index_maps,
+                                   id_tag_columns)
+    return df, index_maps
+
+
+# ---------------------------------------------------------------------------
+# writers
+# ---------------------------------------------------------------------------
+
+
+def write_training_examples(
+    path: str,
+    response: np.ndarray,
+    rows: Sequence[Tuple[np.ndarray, np.ndarray]],
+    index_map: IndexMap,
+    offsets: Optional[np.ndarray] = None,
+    weights: Optional[np.ndarray] = None,
+    uids: Optional[Sequence[str]] = None,
+) -> None:
+    """Write TrainingExampleAvro records (reference: AvroDataWriter)."""
+    from photon_tpu.io.index_map import split_feature_key
+
+    def gen():
+        for i in range(len(response)):
+            idx, val = rows[i]
+            feats = []
+            for j, v in zip(idx, val):
+                key = index_map.get_feature_name(int(j))
+                if key is None:
+                    continue
+                name, term = split_feature_key(key)
+                feats.append({"name": name, "term": term, "value": float(v)})
+            yield {
+                "uid": None if uids is None else str(uids[i]),
+                "label": float(response[i]),
+                "features": feats,
+                "metadataMap": None,
+                "weight": None if weights is None else float(weights[i]),
+                "offset": None if offsets is None else float(offsets[i]),
+            }
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    avro_io.write_avro(path, TRAINING_EXAMPLE_AVRO, gen())
+
+
+def write_scores(
+    path: str,
+    scores: np.ndarray,
+    labels: Optional[np.ndarray] = None,
+    weights: Optional[np.ndarray] = None,
+    uids: Optional[Sequence[str]] = None,
+    model_id: str = "photon_tpu",
+) -> None:
+    """Write ScoringResultAvro records (reference:
+    GameScoringDriver.saveScoresToHDFS :187)."""
+
+    def gen():
+        for i, s in enumerate(scores):
+            yield {
+                "uid": None if uids is None else str(uids[i]),
+                "label": None if labels is None else float(labels[i]),
+                "modelId": model_id,
+                "predictionScore": float(s),
+                "weight": None if weights is None else float(weights[i]),
+                "metadataMap": None,
+            }
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    avro_io.write_avro(path, SCORING_RESULT_AVRO, gen())

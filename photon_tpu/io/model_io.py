@@ -1,0 +1,348 @@
+"""GAME model persistence in the reference's on-disk layout.
+
+Reference: photon-client data/avro/ModelProcessingUtils.scala
+(saveGameModelToHDFS :40 — layout ``<out>/fixed-effect/<coord>/
+coefficients/part-*.avro`` + ``id-info``, ``random-effect/<coord>/...``,
+``model-metadata.json`` of optimization configs :314-372;
+loadGameModelFromHDFS :96), data/avro/AvroUtils.scala:344
+(GLM <-> BayesianLinearModelAvro with sparsity threshold).
+
+A model saved here is byte-level readable by the reference (same Avro
+records, same directory layout, same metadata JSON) and vice versa. The
+TPU twist is only on load of random effects: per-entity (name, term,
+value) records are re-packed into the dense [E, K] coefficient block +
+projection gather table that the TPU scorer consumes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_tpu.game.dataset import EntityVocabulary
+from photon_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
+from photon_tpu.io import avro as avro_io
+from photon_tpu.io.index_map import IndexMap, split_feature_key
+from photon_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_AVRO
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.types import TaskType
+
+import jax.numpy as jnp
+
+FIXED_EFFECT = "fixed-effect"
+RANDOM_EFFECT = "random-effect"
+COEFFICIENTS = "coefficients"
+ID_INFO = "id-info"
+METADATA_FILE = "model-metadata.json"
+
+# Reference: VectorUtils.DEFAULT_SPARSITY_THRESHOLD
+DEFAULT_SPARSITY_THRESHOLD = 1e-4
+
+# modelClass strings the reference writes (AvroUtils.scala:359) and
+# dispatches on at load (:405) — kept verbatim for interchange.
+_MODEL_CLASS = {
+    TaskType.LOGISTIC_REGRESSION:
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+_TASK_FOR_CLASS = {v: k for k, v in _MODEL_CLASS.items()}
+
+
+# ---------------------------------------------------------------------------
+# vector <-> NameTermValue record lists
+# ---------------------------------------------------------------------------
+
+
+def _vector_to_ntvs(vec: np.ndarray, index_map: IndexMap,
+                    indices: Optional[np.ndarray] = None,
+                    sparsity_threshold: float = DEFAULT_SPARSITY_THRESHOLD,
+                    ) -> List[dict]:
+    """Nonzero (name, term, value) records for a coefficient vector.
+    ``indices``: optional global column per vector slot (projected models);
+    None = slot i IS global column i."""
+    out = []
+    for slot, v in enumerate(vec):
+        v = float(v)
+        if abs(v) <= sparsity_threshold:
+            continue
+        g = int(indices[slot]) if indices is not None else slot
+        if g < 0:
+            continue
+        key = index_map.get_feature_name(g)
+        if key is None:
+            raise KeyError(f"no feature name for column {g}")
+        name, term = split_feature_key(key)
+        out.append({"name": name, "term": term, "value": v})
+    return out
+
+
+def _ntvs_to_vector(ntvs: Sequence[dict], index_map: IndexMap,
+                    dim: int) -> np.ndarray:
+    vec = np.zeros(dim)
+    for r in ntvs:
+        idx = index_map.index_of(str(r["name"]), str(r["term"]))
+        if idx >= 0:
+            vec[idx] = r["value"]
+    return vec
+
+
+# ---------------------------------------------------------------------------
+# metadata JSON (reference: ModelProcessingUtils.gameOptConfigToJson :314)
+# ---------------------------------------------------------------------------
+
+
+def _opt_config_json(cfg) -> dict:
+    """GLMOptimizationConfiguration -> the reference's JSON shape."""
+    reg = cfg.regularization
+    reg_type = getattr(getattr(reg, "reg_type", None), "value", "NONE")
+    alpha = getattr(reg, "elastic_net_alpha", None)
+    return {
+        "optimizerConfig": {
+            "optimizerType": cfg.optimizer.optimizer_type.value,
+            "maximumIterations": cfg.optimizer.max_iterations,
+            "tolerance": cfg.optimizer.tolerance,
+        },
+        "regularizationContext": {
+            "regularizationType": reg_type,
+            "elasticNetParam": alpha,
+        },
+        "regularizationWeight": cfg.regularization_weight,
+        "downSamplingRate": cfg.down_sampling_rate,
+    }
+
+
+def save_model_metadata(output_dir: str, task: TaskType,
+                        coordinate_configs: Optional[dict] = None,
+                        model_name: str = "photon_tpu GAME model") -> None:
+    fixed_vals, random_vals = [], []
+    for cid, ccfg in (coordinate_configs or {}).items():
+        entry = {"name": cid, "configuration": _opt_config_json(ccfg.optimization)}
+        (random_vals if ccfg.is_random_effect else fixed_vals).append(entry)
+    meta = {
+        "modelType": task.value,
+        "modelName": model_name,
+        "fixedEffectOptimizationConfigurations": {
+            "configurations": FIXED_EFFECT, "values": fixed_vals},
+        "randomEffectOptimizationConfigurations": {
+            "configurations": RANDOM_EFFECT, "values": random_vals},
+    }
+    with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_model_metadata(model_dir: str) -> dict:
+    with open(os.path.join(model_dir, METADATA_FILE)) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
+
+
+def save_game_model(
+    output_dir: str,
+    model: GameModel,
+    index_maps: Dict[str, IndexMap],
+    vocab: Optional[EntityVocabulary] = None,
+    projections: Optional[Dict[str, np.ndarray]] = None,
+    coordinate_configs: Optional[dict] = None,
+    sparsity_threshold: float = DEFAULT_SPARSITY_THRESHOLD,
+    records_per_file: Optional[int] = None,
+) -> None:
+    """Write a GAME model in the reference layout.
+
+    ``index_maps``: feature shard id -> IndexMap (global columns).
+    ``vocab`` + ``projections``: required when the model has random
+    effects (entity row -> REId string; local slot -> global column).
+    ``records_per_file``: max per-entity records per part file (the
+    reference's randomEffectModelFileLimit).
+    """
+    os.makedirs(output_dir, exist_ok=True)
+    save_model_metadata(output_dir, model.task, coordinate_configs)
+
+    for cid in model.coordinate_ids:
+        m = model[cid]
+        if isinstance(m, FixedEffectModel):
+            cdir = os.path.join(output_dir, FIXED_EFFECT, cid)
+            os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(cdir, ID_INFO), "w") as f:
+                f.write(m.feature_shard_id + "\n")
+            imap = index_maps[m.feature_shard_id]
+            coefs = m.model.coefficients
+            rec = {
+                "modelId": FIXED_EFFECT,
+                "modelClass": _MODEL_CLASS[m.task],
+                "means": _vector_to_ntvs(
+                    np.asarray(coefs.means), imap,
+                    sparsity_threshold=sparsity_threshold),
+                "variances": None if coefs.variances is None else
+                    _vector_to_ntvs(np.asarray(coefs.variances), imap,
+                                    sparsity_threshold=0.0),
+                "lossFunction": "",
+            }
+            avro_io.write_avro(
+                os.path.join(cdir, COEFFICIENTS, "part-00000.avro"),
+                BAYESIAN_LINEAR_MODEL_AVRO, [rec])
+        elif isinstance(m, RandomEffectModel):
+            if vocab is None or projections is None or cid not in projections:
+                raise ValueError(
+                    f"random-effect coordinate {cid} needs vocab + projection")
+            cdir = os.path.join(output_dir, RANDOM_EFFECT, cid)
+            os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
+            with open(os.path.join(cdir, ID_INFO), "w") as f:
+                f.write(m.random_effect_type + "\n" + m.feature_shard_id + "\n")
+            imap = index_maps[m.feature_shard_id]
+            names = vocab.names(m.random_effect_type)
+            proj = np.asarray(projections[cid])
+            coef = np.asarray(m.coefficients)
+            var = None if m.variances is None else np.asarray(m.variances)
+
+            def entity_records():
+                for e, re_id in enumerate(names):
+                    yield {
+                        "modelId": re_id,
+                        "modelClass": _MODEL_CLASS[m.task],
+                        "means": _vector_to_ntvs(
+                            coef[e], imap, indices=proj[e],
+                            sparsity_threshold=sparsity_threshold),
+                        "variances": None if var is None else
+                            _vector_to_ntvs(var[e], imap, indices=proj[e],
+                                            sparsity_threshold=0.0),
+                        "lossFunction": "",
+                    }
+
+            recs = list(entity_records())
+            per_file = records_per_file or max(len(recs), 1)
+            nfiles = max((len(recs) + per_file - 1) // per_file, 1)
+            for p in range(nfiles):
+                avro_io.write_avro(
+                    os.path.join(cdir, COEFFICIENTS, f"part-{p:05d}.avro"),
+                    BAYESIAN_LINEAR_MODEL_AVRO,
+                    recs[p * per_file:(p + 1) * per_file])
+        else:
+            raise TypeError(f"unknown model type for coordinate {cid}: {type(m)}")
+
+
+# ---------------------------------------------------------------------------
+# load
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LoadedGameModel:
+    """A GAME model plus the host-side artifacts scoring needs."""
+
+    model: GameModel
+    vocab: EntityVocabulary                  # entity row <-> REId per type
+    projections: Dict[str, np.ndarray]       # cid -> [E, K] local->global
+    metadata: dict
+
+    @property
+    def task(self) -> TaskType:
+        return self.model.task
+
+
+def load_game_model(
+    model_dir: str,
+    index_maps: Dict[str, IndexMap],
+    coordinates_to_load: Optional[Sequence[str]] = None,
+    dtype=np.float32,
+) -> LoadedGameModel:
+    """Reference: ModelProcessingUtils.loadGameModelFromHDFS :96."""
+    metadata = load_model_metadata(model_dir)
+    task = TaskType(metadata["modelType"])
+    wanted = set(coordinates_to_load) if coordinates_to_load else None
+
+    models: Dict[str, object] = {}
+    vocab = EntityVocabulary()
+    projections: Dict[str, np.ndarray] = {}
+
+    fixed_dir = os.path.join(model_dir, FIXED_EFFECT)
+    if os.path.isdir(fixed_dir):
+        for cid in sorted(os.listdir(fixed_dir)):
+            if wanted is not None and cid not in wanted:
+                continue
+            cdir = os.path.join(fixed_dir, cid)
+            with open(os.path.join(cdir, ID_INFO)) as f:
+                shard_id = f.read().split()[0]
+            if shard_id not in index_maps:
+                if wanted is not None:
+                    raise KeyError(f"no index map for feature shard {shard_id!r}")
+                continue
+            imap = index_maps[shard_id]
+            dim = imap.feature_dimension
+            recs = list(avro_io.iter_avro_dir(os.path.join(cdir, COEFFICIENTS)))
+            if len(recs) != 1:
+                raise ValueError(f"expected 1 fixed-effect record, got {len(recs)}")
+            rec = recs[0]
+            rec_task = _TASK_FOR_CLASS.get(rec.get("modelClass") or "", task)
+            means = jnp.asarray(_ntvs_to_vector(rec["means"], imap, dim), dtype)
+            variances = rec.get("variances")
+            var = None if variances is None else jnp.asarray(
+                _ntvs_to_vector(variances, imap, dim), dtype)
+            models[cid] = FixedEffectModel(
+                GeneralizedLinearModel(Coefficients(means, var), rec_task),
+                shard_id)
+
+    random_dir = os.path.join(model_dir, RANDOM_EFFECT)
+    if os.path.isdir(random_dir):
+        for cid in sorted(os.listdir(random_dir)):
+            if wanted is not None and cid not in wanted:
+                continue
+            cdir = os.path.join(random_dir, cid)
+            with open(os.path.join(cdir, ID_INFO)) as f:
+                re_type, shard_id = f.read().split()[:2]
+            if shard_id not in index_maps:
+                if wanted is not None:
+                    raise KeyError(f"no index map for feature shard {shard_id!r}")
+                continue
+            imap = index_maps[shard_id]
+            entities: List[Tuple[str, List[dict], Optional[List[dict]], str]] = []
+            for rec in avro_io.iter_avro_dir(os.path.join(cdir, COEFFICIENTS)):
+                entities.append((str(rec["modelId"]), rec["means"],
+                                 rec.get("variances"),
+                                 rec.get("modelClass") or ""))
+            # dense block: entity row per record order, local slots = each
+            # entity's own nonzero support (the IndexMapProjector role)
+            vocab.build(re_type, [e[0] for e in entities])
+            E = len(entities)
+            k_max = max((len(e[1]) for e in entities), default=1) or 1
+            coef = np.zeros((E, k_max), dtype)
+            var_block = np.zeros((E, k_max), dtype)
+            have_var = False
+            proj = np.full((E, k_max), -1, np.int32)
+            rec_task = task
+            for e, (re_id, means, variances, cls) in enumerate(entities):
+                rec_task = _TASK_FOR_CLASS.get(cls, task)
+                var_map = {}
+                if variances:
+                    have_var = True
+                    var_map = {(str(r["name"]), str(r["term"])): r["value"]
+                               for r in variances}
+                for s, r in enumerate(means):
+                    g = imap.index_of(str(r["name"]), str(r["term"]))
+                    if g < 0:
+                        continue
+                    proj[e, s] = g
+                    coef[e, s] = r["value"]
+                    var_block[e, s] = var_map.get((str(r["name"]), str(r["term"])), 0.0)
+            models[cid] = RandomEffectModel(
+                coefficients=jnp.asarray(coef),
+                random_effect_type=re_type,
+                feature_shard_id=shard_id,
+                task=rec_task,
+                variances=jnp.asarray(var_block) if have_var else None,
+            )
+            projections[cid] = proj
+
+    return LoadedGameModel(GameModel(models), vocab, projections, metadata)

@@ -150,8 +150,10 @@ def pad_entities(ds, multiple: int, num_flat_samples: Optional[int] = None):
         return jnp.pad(a, widths, constant_values=fill)
 
     eE, eP = E_pad - E, P_pad - Ppas
+    # sample_rows is n on build-time pads, so max is a safe drop sentinel
+    # only when pads exist; max+1 keeps pads inert when every block is full
     n_sentinel = (num_flat_samples if num_flat_samples is not None
-                  else int(jnp.max(ds.sample_rows)) if ds.sample_rows.size else 0)
+                  else int(jnp.max(ds.sample_rows)) + 1 if ds.sample_rows.size else 0)
     return RandomEffectDataset(
         features=F.SparseFeatures(pad0(ds.features.indices, eE),
                                   pad0(ds.features.values, eE)),
@@ -167,11 +169,17 @@ def pad_entities(ds, multiple: int, num_flat_samples: Optional[int] = None):
     )
 
 
-def shard_entity_blocks(ds, mesh: Mesh, axis: str = DATA_AXIS,
+def shard_entity_blocks(ds, mesh: Mesh, axis: Optional[str] = None,
                         num_flat_samples: Optional[int] = None):
     """Pad + place a RandomEffectDataset with entities (and passive rows)
     sharded over ``axis`` — the static replacement for the reference's
-    entity co-partitioning (RandomEffectDatasetPartitioner.scala:44)."""
+    entity co-partitioning (RandomEffectDatasetPartitioner.scala:44).
+
+    Default axis: the mesh's "entity" axis when it has one, else "data"
+    (entity solves are independent, so reusing the data-axis devices is
+    valid and the common single-axis-mesh case)."""
+    if axis is None:
+        axis = ENTITY_AXIS if ENTITY_AXIS in mesh.axis_names else DATA_AXIS
     ds = pad_entities(ds, axis_size(mesh, axis), num_flat_samples)
 
     def put(a):

@@ -1,0 +1,287 @@
+"""IO layer tests: Avro codec, index maps, model persistence round-trips.
+
+Mirrors the reference's AvroDataReaderIntegTest / ModelProcessingUtilsIntegTest
+coverage (photon-client src/integTest), plus byte-level interchange checks
+against the reference's checked-in fixtures when the reference snapshot is
+mounted.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.game.dataset import EntityVocabulary
+from photon_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
+from photon_tpu.io import (
+    FeatureShardConfiguration,
+    IndexMap,
+    IndexMapBuilder,
+    feature_key,
+    split_feature_key,
+    INTERCEPT_KEY,
+    read_avro,
+    write_avro,
+    build_index_maps,
+    records_to_game_dataframe,
+    load_game_model,
+    save_game_model,
+    write_scores,
+    write_training_examples,
+)
+from photon_tpu.io.schemas import (
+    BAYESIAN_LINEAR_MODEL_AVRO,
+    TRAINING_EXAMPLE_AVRO,
+)
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.types import TaskType
+
+REFERENCE = "/root/reference/photon-client/src/integTest/resources/GameIntegTest"
+
+
+# -- Avro codec --------------------------------------------------------------
+
+
+def test_avro_roundtrip_training_examples(tmp_path):
+    recs = [
+        {"uid": "u1", "label": 1.0,
+         "features": [{"name": "f", "term": "1", "value": 0.5},
+                      {"name": "g", "term": "", "value": -2.0}],
+         "metadataMap": {"k": "v"}, "weight": 2.0, "offset": 0.25},
+        {"uid": None, "label": 0.0, "features": [],
+         "metadataMap": None, "weight": None, "offset": None},
+    ]
+    for codec in ("null", "deflate"):
+        p = str(tmp_path / f"t_{codec}.avro")
+        write_avro(p, TRAINING_EXAMPLE_AVRO, recs, codec=codec)
+        schema, back = read_avro(p)
+        assert back == recs
+        assert schema["name"] == "TrainingExampleAvro"
+
+
+def test_avro_block_splitting(tmp_path):
+    recs = [{"uid": None, "label": float(i), "features": [],
+             "metadataMap": None, "weight": None, "offset": None}
+            for i in range(257)]
+    p = str(tmp_path / "blocks.avro")
+    write_avro(p, TRAINING_EXAMPLE_AVRO, recs, sync_interval=100)
+    _, back = read_avro(p)
+    assert [r["label"] for r in back] == [float(i) for i in range(257)]
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference not mounted")
+def test_avro_reads_reference_model_file():
+    schema, recs = read_avro(
+        f"{REFERENCE}/gameModel/fixed-effect/globalShard/coefficients/part-00000.avro")
+    assert len(recs) == 1
+    assert recs[0]["modelId"] == "fixed-effect"
+    assert len(recs[0]["means"]) == 14982
+    names = {m["name"] for m in recs[0]["means"][:50]}
+    assert "(INTERCEPT)" in names or len(names) > 0
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference not mounted")
+def test_avro_reads_reference_training_data():
+    schema, recs = read_avro(
+        f"{REFERENCE}/input/duplicateFeatures/yahoo-music-train.avro")
+    assert len(recs) > 0
+    assert {"response", "userFeatures", "songFeatures"} <= set(recs[0].keys())
+
+
+# -- index maps --------------------------------------------------------------
+
+
+def test_feature_key_roundtrip():
+    k = feature_key("age", "18-25")
+    assert split_feature_key(k) == ("age", "18-25")
+    assert split_feature_key(feature_key("solo")) == ("solo", "")
+
+
+def test_index_map_build_and_lookup():
+    im = IndexMap.from_name_terms([("b", ""), ("a", "1"), ("b", "")],
+                                  add_intercept=True)
+    assert len(im) == 3
+    assert im.feature_dimension == 3
+    assert im.has_intercept
+    assert im.get_index(INTERCEPT_KEY) == 2  # intercept last
+    assert im.index_of("a", "1") >= 0
+    assert im.index_of("zzz") == -1
+    # bidirectional
+    for key in im:
+        assert im.get_feature_name(im.get_index(key)) == key
+
+
+def test_index_map_builder_first_seen_order():
+    b = IndexMapBuilder()
+    assert b.put("x") == 0
+    assert b.put("y") == 1
+    assert b.put("x") == 0
+    assert b.build().get_index("y") == 1
+
+
+# -- records -> GameDataFrame ------------------------------------------------
+
+
+def _toy_records(n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        recs.append({
+            "response": float(rng.integers(0, 2)),
+            "weight": 1.0 + float(rng.random()),
+            "offset": 0.0,
+            "features": [{"name": "g", "term": str(t), "value": float(rng.normal())}
+                         for t in rng.choice(6, size=3, replace=False)],
+            "userFeatures": [{"name": "u", "term": str(t), "value": float(rng.normal())}
+                             for t in rng.choice(4, size=2, replace=False)],
+            "userId": f"user{int(rng.integers(0, 5))}",
+        })
+    return recs
+
+
+def test_records_to_game_dataframe():
+    recs = _toy_records()
+    shards = {"global": FeatureShardConfiguration.of("features"),
+              "per_user": FeatureShardConfiguration.of("userFeatures", intercept=False)}
+    imaps = build_index_maps(recs, shards)
+    assert imaps["global"].has_intercept
+    assert not imaps["per_user"].has_intercept
+    df = records_to_game_dataframe(recs, shards, imaps, id_tag_columns=["userId"])
+    assert df.num_samples == len(recs)
+    assert df.weights is not None and df.offsets is not None
+    # every global row has the intercept column
+    icol = imaps["global"].get_index(INTERCEPT_KEY)
+    for idx, val in df.feature_shards["global"].rows:
+        assert icol in idx
+    assert set(df.id_tags["userId"]) <= {f"user{i}" for i in range(5)}
+
+
+def test_training_example_writer_reader_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    im = IndexMap.from_keys([feature_key("f", str(j)) for j in range(5)])
+    rows = [(np.asarray([0, 2], np.int32), np.asarray([1.0, -0.5])),
+            (np.asarray([1], np.int32), np.asarray([2.0]))]
+    y = np.asarray([1.0, 0.0])
+    p = str(tmp_path / "data.avro")
+    write_training_examples(p, y, rows, im, uids=["a", "b"])
+    _, recs = read_avro(p)
+    assert [r["uid"] for r in recs] == ["a", "b"]
+    assert recs[0]["label"] == 1.0
+    assert {f["term"] for f in recs[0]["features"]} == {"0", "2"}
+
+
+# -- model save/load ---------------------------------------------------------
+
+
+def _fixed_model(task=TaskType.LOGISTIC_REGRESSION, dim=6):
+    import jax.numpy as jnp
+    means = jnp.asarray(np.linspace(-1.0, 1.0, dim))
+    return FixedEffectModel(
+        GeneralizedLinearModel(Coefficients(means), task), "global")
+
+
+def test_fixed_effect_model_roundtrip(tmp_path):
+    im = IndexMap.from_keys([feature_key("f", str(j)) for j in range(6)])
+    fe = _fixed_model()
+    model = GameModel({"global_coord": fe})
+    out = str(tmp_path / "model")
+    save_game_model(out, model, {"global": im}, sparsity_threshold=0.0)
+    assert os.path.exists(os.path.join(out, "model-metadata.json"))
+    assert os.path.exists(os.path.join(
+        out, "fixed-effect", "global_coord", "coefficients", "part-00000.avro"))
+
+    loaded = load_game_model(out, {"global": im})
+    assert loaded.task == TaskType.LOGISTIC_REGRESSION
+    got = np.asarray(loaded.model["global_coord"].model.coefficients.means)
+    np.testing.assert_allclose(got, np.linspace(-1.0, 1.0, 6), atol=1e-12)
+
+
+def test_game_model_roundtrip_with_random_effects(tmp_path):
+    import jax.numpy as jnp
+    im_g = IndexMap.from_keys([feature_key("g", str(j)) for j in range(6)])
+    im_u = IndexMap.from_keys([feature_key("u", str(j)) for j in range(4)])
+    vocab = EntityVocabulary()
+    vocab.build("userId", ["alice", "bob", "carol"])
+
+    # entity-projected coefficients: entity e uses global columns proj[e]
+    proj = np.asarray([[0, 2, -1], [1, 3, -1], [0, 1, 2]], np.int32)
+    coef = jnp.asarray(np.asarray([[0.5, -1.0, 0.0],
+                                   [2.0, 0.25, 0.0],
+                                   [-0.75, 1.5, 3.0]]))
+    re = RandomEffectModel(coef, "userId", "per_user",
+                           TaskType.LOGISTIC_REGRESSION)
+    model = GameModel({"fixed": _fixed_model(), "per_user_coord": re})
+
+    out = str(tmp_path / "game_model")
+    save_game_model(out, model, {"global": im_g, "per_user": im_u},
+                    vocab=vocab, projections={"per_user_coord": proj},
+                    sparsity_threshold=0.0)
+
+    with open(os.path.join(out, "random-effect", "per_user_coord", "id-info")) as f:
+        assert f.read().split() == ["userId", "per_user"]
+
+    loaded = load_game_model(out, {"global": im_g, "per_user": im_u})
+    lre = loaded.model["per_user_coord"]
+    assert isinstance(lre, RandomEffectModel)
+    assert lre.random_effect_type == "userId"
+    assert loaded.vocab.names("userId") == ["alice", "bob", "carol"]
+
+    # scores must agree entity-by-entity: reconstruct global-space vectors
+    lproj = loaded.projections["per_user_coord"]
+    for e in range(3):
+        orig = np.zeros(4)
+        for s in range(proj.shape[1]):
+            if proj[e, s] >= 0:
+                orig[proj[e, s]] += float(coef[e, s])
+        back = np.zeros(4)
+        lc = np.asarray(lre.coefficients)
+        for s in range(lproj.shape[1]):
+            if lproj[e, s] >= 0:
+                back[lproj[e, s]] += lc[e, s]
+        np.testing.assert_allclose(back, orig, atol=1e-12)
+
+
+def test_model_metadata_shape(tmp_path):
+    from photon_tpu.estimators.game_estimator import (
+        CoordinateConfiguration, FixedEffectDataConfiguration)
+    ccfg = {"fixed": CoordinateConfiguration(FixedEffectDataConfiguration("global"))}
+    im = IndexMap.from_keys([feature_key("f", str(j)) for j in range(6)])
+    model = GameModel({"fixed": _fixed_model()})
+    out = str(tmp_path / "m")
+    save_game_model(out, model, {"global": im}, coordinate_configs=ccfg)
+    meta = json.load(open(os.path.join(out, "model-metadata.json")))
+    assert meta["modelType"] == "LOGISTIC_REGRESSION"
+    vals = meta["fixedEffectOptimizationConfigurations"]["values"]
+    assert vals[0]["name"] == "fixed"
+    assert vals[0]["configuration"]["optimizerConfig"]["optimizerType"] == "LBFGS"
+    assert meta["randomEffectOptimizationConfigurations"]["values"] == []
+
+
+@pytest.mark.skipif(not os.path.isdir(REFERENCE), reason="reference not mounted")
+def test_load_reference_game_model():
+    """Byte-level interchange: load the reference's own persisted model."""
+    schema, recs = read_avro(
+        f"{REFERENCE}/gameModel/fixed-effect/globalShard/coefficients/part-00000.avro")
+    keys = [feature_key(str(m["name"]), str(m["term"])) for m in recs[0]["means"]]
+    im = IndexMap.from_keys(keys)
+    # fixture metadata says LINEAR_REGRESSION
+    loaded = load_game_model(f"{REFERENCE}/gameModel", {"globalShard": im},
+                             dtype=np.float64)
+    assert loaded.task == TaskType.LINEAR_REGRESSION
+    fe = loaded.model["globalShard"]
+    means = np.asarray(fe.model.coefficients.means)
+    assert means.shape[0] == len(im)
+    lookup = {feature_key(str(m["name"]), str(m["term"])): m["value"]
+              for m in recs[0]["means"]}
+    for key in list(lookup)[:100]:
+        assert means[im.get_index(key)] == pytest.approx(lookup[key])
+
+
+def test_scores_writer(tmp_path):
+    p = str(tmp_path / "scores.avro")
+    write_scores(p, np.asarray([0.1, -0.2]), labels=np.asarray([1.0, 0.0]),
+                 uids=["a", "b"])
+    _, recs = read_avro(p)
+    assert recs[0]["predictionScore"] == pytest.approx(0.1)
+    assert recs[1]["uid"] == "b"
