@@ -33,10 +33,22 @@ from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.ops import features as F
 from photon_tpu.ops.losses import loss_for_task
 from photon_tpu.optim import lbfgs, owlqn, tron
-from photon_tpu.optim.problem import GLMOptimizationConfiguration, GlmOptimizationProblem
+from photon_tpu.optim.problem import (
+    GLMOptimizationConfiguration,
+    GlmOptimizationProblem,
+    solver_cache_key,
+)
 from photon_tpu.types import OptimizerType, TaskType
+from photon_tpu.utils import jitcache
 
 Array = jax.Array
+
+
+@jax.jit
+def _fixed_score(feats, coef: Array) -> Array:
+    # data enters as an argument, never a closure: closed-over arrays
+    # would be baked into the HLO as giant literal constants
+    return F.matvec(feats, coef)
 
 
 class FixedEffectCoordinate:
@@ -97,21 +109,11 @@ class FixedEffectCoordinate:
             regularization_weight=self.config.regularization_weight)
         return FixedEffectModel(model, self.feature_shard_id)
 
-    @functools.cached_property
-    def _score_fn(self):
-        feats = self.batch.features
-
-        @jax.jit
-        def score(coef: Array) -> Array:
-            return F.matvec(feats, coef)
-
-        return score
-
     def score(self, model: FixedEffectModel) -> Array:
         """Training-data scores WITHOUT offsets — coordinate-descent score
         algebra sums raw model scores (reference: scoreForCoordinateDescent).
         Mesh pad rows are sliced off so score algebra stays [n]."""
-        s = self._score_fn(model.model.coefficients.means)
+        s = _fixed_score(self.batch.features, model.model.coefficients.means)
         if s.shape[0] != self._n_orig:
             s = s[: self._n_orig]
         return s
@@ -150,36 +152,42 @@ class RandomEffectCoordinate:
 
     @functools.cached_property
     def _solve_fn(self):
-        ds = self.dataset
         obj = self.objective
         opt = self.config.optimizer
         solver_cfg = opt.solver_config()
         opt_type = opt.optimizer_type
 
-        def solve_one(feat_idx, feat_val, labels, offsets, weights, x0, l2, l1):
-            batch = DataBatch(F.SparseFeatures(feat_idx, feat_val),
-                              labels, offsets, weights)
-            hyper = Hyper(l2_weight=l2)
-            vg = lambda c: obj.value_and_gradient(c, batch, hyper)
-            if opt_type == OptimizerType.OWLQN:
-                return owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg).coef
-            if opt_type == OptimizerType.TRON:
-                hv = lambda c, v: obj.hessian_vector(c, v, batch, hyper)
-                return tron.minimize(vg, hv, x0, config=solver_cfg).coef
-            return lbfgs.minimize(vg, x0, config=solver_cfg).coef
+        def build():
+            def solve_one(feat_idx, feat_val, labels, offsets, weights, x0, l2, l1):
+                batch = DataBatch(F.SparseFeatures(feat_idx, feat_val),
+                                  labels, offsets, weights)
+                hyper = Hyper(l2_weight=l2)
+                vg = lambda c: obj.value_and_gradient(c, batch, hyper)
+                if opt_type == OptimizerType.OWLQN:
+                    return owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg).coef
+                if opt_type == OptimizerType.TRON:
+                    hv = lambda c, v: obj.hessian_vector(c, v, batch, hyper)
+                    return tron.minimize(vg, hv, x0, config=solver_cfg).coef
+                return lbfgs.minimize(vg, x0, config=solver_cfg).coef
 
-        @jax.jit
-        def solve_all(residual_flat: Optional[Array], coef0: Array, l2: Array, l1: Array) -> Array:
-            offsets = ds.offsets
-            if residual_flat is not None:
-                # gather residuals by flat row; pad rows index == n -> fill 0
-                res = residual_flat.at[ds.sample_rows].get(mode="fill", fill_value=0.0)
-                offsets = offsets + res
-            return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None))(
-                ds.features.indices, ds.features.values,
-                ds.labels, offsets, ds.weights, coef0, l2, l1)
+            # the dataset enters as a pytree argument, never a closure (a
+            # closed-over array would be baked into the HLO as a constant)
+            @jax.jit
+            def solve_all(ds: RandomEffectDataset, residual_flat: Optional[Array],
+                          coef0: Array, l2: Array, l1: Array) -> Array:
+                offsets = ds.offsets
+                if residual_flat is not None:
+                    # gather residuals by flat row; pad rows index == n -> fill 0
+                    res = residual_flat.at[ds.sample_rows].get(mode="fill", fill_value=0.0)
+                    offsets = offsets + res
+                return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None))(
+                    ds.features.indices, ds.features.values,
+                    ds.labels, offsets, ds.weights, coef0, l2, l1)
 
-        return solve_all
+            return solve_all
+
+        key = ("re_solve", self.task, solver_cache_key(opt))
+        return jitcache.get_or_build(key, build)
 
     def update_model(
         self, prev: Optional[RandomEffectModel], residual_scores: Optional[Array]
@@ -192,7 +200,7 @@ class RandomEffectCoordinate:
         lam = self.config.regularization_weight
         l2 = jnp.asarray(self.config.regularization.l2_weight(lam), dtype)
         l1 = jnp.asarray(self.config.regularization.l1_weight(lam), dtype)
-        coefs = self._solve_fn(residual_scores, coef0, l2, l1)
+        coefs = self._solve_fn(self.dataset, residual_scores, coef0, l2, l1)
         # publish the model at the vocabulary's true entity count; mesh
         # padding stays an internal detail of this coordinate
         coefs = coefs[: self._num_entities_orig]
@@ -216,29 +224,35 @@ class RandomEffectCoordinate:
 
     @functools.cached_property
     def _score_fn(self):
-        ds = self.dataset
         n = self.n
 
-        @jax.jit
-        def score(coef_block: Array) -> Array:
-            # active: per-entity margins, scattered to flat rows
-            margins = jnp.sum(
-                ds.features.values
-                * jax.vmap(lambda c, i: c[i])(coef_block, ds.features.indices),
-                axis=-1,
-            )
-            flat = jnp.zeros((n,), coef_block.dtype)
-            flat = flat.at[ds.sample_rows.ravel()].add(
-                margins.ravel(), mode="drop")
-            # passive: gather entity coef rows (out-of-range entity -> 0)
-            pcoef = coef_block.at[ds.passive_entity].get(mode="fill", fill_value=0.0)
-            pmargin = jnp.sum(ds.passive_features.values
-                              * jnp.take_along_axis(pcoef, ds.passive_features.indices, axis=1),
-                              axis=-1)
-            flat = flat.at[ds.passive_rows].add(pmargin, mode="drop")
-            return flat
+        def build():
+            return jax.jit(_re_score_builder(n))
 
-        return score
+        return jitcache.get_or_build(("re_score", n), build)
 
     def score(self, model: RandomEffectModel) -> Array:
-        return self._score_fn(self._pad_entity_rows(model.coefficients))
+        return self._score_fn(self.dataset,
+                              self._pad_entity_rows(model.coefficients))
+
+
+def _re_score_builder(n: int):
+    def score(ds: RandomEffectDataset, coef_block: Array) -> Array:
+        # active: per-entity margins, scattered to flat rows
+        margins = jnp.sum(
+            ds.features.values
+            * jax.vmap(lambda c, i: c[i])(coef_block, ds.features.indices),
+            axis=-1,
+        )
+        flat = jnp.zeros((n,), coef_block.dtype)
+        flat = flat.at[ds.sample_rows.ravel()].add(
+            margins.ravel(), mode="drop")
+        # passive: gather entity coef rows (out-of-range entity -> 0)
+        pcoef = coef_block.at[ds.passive_entity].get(mode="fill", fill_value=0.0)
+        pmargin = jnp.sum(ds.passive_features.values
+                          * jnp.take_along_axis(pcoef, ds.passive_features.indices, axis=1),
+                          axis=-1)
+        flat = flat.at[ds.passive_rows].add(pmargin, mode="drop")
+        return flat
+
+    return score

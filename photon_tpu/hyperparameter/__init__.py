@@ -1,0 +1,40 @@
+"""Hyperparameter search: Sobol random search, GP Bayesian optimization,
+slice-sampled kernel posteriors, acquisition criteria, estimator glue.
+
+Replaces the reference's photon-lib hyperparameter/ package (+ the
+photon-api tuner factory and photon-client estimator glue).
+"""
+
+from photon_tpu.hyperparameter.criteria import ConfidenceBound, ExpectedImprovement
+from photon_tpu.hyperparameter.gp import (
+    GaussianProcessEstimator,
+    GaussianProcessModel,
+)
+from photon_tpu.hyperparameter.kernels import RBF, Matern52, StationaryKernel
+from photon_tpu.hyperparameter.rescaling import (
+    LOG_TRANSFORM,
+    SQRT_TRANSFORM,
+    scale_backward,
+    scale_forward,
+    transform_backward,
+    transform_forward,
+)
+from photon_tpu.hyperparameter.search import GaussianProcessSearch, RandomSearch
+from photon_tpu.hyperparameter.slice_sampler import SliceSampler
+from photon_tpu.hyperparameter.tuner import (
+    GameEstimatorEvaluationFunction,
+    HyperparameterTuningMode,
+    TuningRange,
+    run_hyperparameter_tuning,
+)
+
+__all__ = [
+    "ConfidenceBound", "ExpectedImprovement",
+    "GaussianProcessEstimator", "GaussianProcessModel",
+    "RBF", "Matern52", "StationaryKernel",
+    "LOG_TRANSFORM", "SQRT_TRANSFORM",
+    "scale_forward", "scale_backward", "transform_forward", "transform_backward",
+    "GaussianProcessSearch", "RandomSearch", "SliceSampler",
+    "GameEstimatorEvaluationFunction", "HyperparameterTuningMode",
+    "TuningRange", "run_hyperparameter_tuning",
+]

@@ -1,0 +1,37 @@
+"""Acquisition criteria for Bayesian optimization.
+
+Reference: photon-lib hyperparameter/criteria/ExpectedImprovement.scala
+(PBO eqs. 1-2, maximized to minimize the target) and ConfidenceBound
+.scala (lower confidence bound mean - k*std, minimized).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+from scipy.stats import norm as _norm
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpectedImprovement:
+    """EI below the incumbent best (we minimize the evaluation value)."""
+
+    best_evaluation: float
+    is_max_opt: bool = True  # maximize EI
+
+    def __call__(self, means: np.ndarray, variances: np.ndarray) -> np.ndarray:
+        std = np.sqrt(np.maximum(variances, 1e-18))
+        gamma = -(means - self.best_evaluation) / std
+        return std * (gamma * _norm.cdf(gamma) + _norm.pdf(gamma))
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfidenceBound:
+    """Lower confidence bound, minimized."""
+
+    exploration_factor: float = 2.0
+    is_max_opt: bool = False
+
+    def __call__(self, means: np.ndarray, variances: np.ndarray) -> np.ndarray:
+        return means - self.exploration_factor * np.sqrt(np.maximum(variances, 0))

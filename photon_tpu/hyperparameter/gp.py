@@ -1,0 +1,134 @@
+"""Gaussian-process estimator/model with slice-sampled kernel posteriors.
+
+Reference: photon-lib hyperparameter/estimators/GaussianProcessEstimator
+.scala (fit = burn-in + Monte-Carlo kernel-parameter samples via slice
+sampling, amplitude/noise sampled jointly along a random direction,
+length scales dimension-wise), GaussianProcessModel.scala (precomputed
+Cholesky/alpha per sampled kernel; posterior mean/variance per GPML
+algorithm 2.1 lines 4-6, averaged over kernel samples),
+PredictionTransformation.scala.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_tpu.hyperparameter.kernels import (
+    DEFAULT_NOISE,
+    Matern52,
+    StationaryKernel,
+)
+from photon_tpu.hyperparameter.slice_sampler import SliceSampler
+
+# transformation(means, variances) -> acquisition values
+PredictionTransformation = Callable[[np.ndarray, np.ndarray], np.ndarray]
+
+
+class GaussianProcessModel:
+    """Posterior over sampled kernels (reference: GaussianProcessModel.scala)."""
+
+    def __init__(self, x_train: np.ndarray, y_train: np.ndarray, y_mean: float,
+                 kernels: Sequence[StationaryKernel],
+                 transformation: Optional[PredictionTransformation] = None):
+        assert x_train.ndim == 2 and len(x_train) == len(y_train)
+        self.x_train = x_train
+        self.y_train = y_train
+        self.y_mean = y_mean
+        self.transformation = transformation
+        self._factors: List[Tuple[StationaryKernel, np.ndarray, np.ndarray]] = []
+        for k in kernels:
+            chol, alpha = k.posterior_factors(x_train, y_train)
+            self._factors.append((k, chol, alpha))
+
+    def _predict_one(self, x: np.ndarray, kernel, chol, alpha
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+        ktrans = kernel.cross(self.x_train, x)          # [train, m]
+        mean = ktrans.T @ alpha + self.y_mean           # GPML 2.1 l.4
+        v = np.linalg.solve(chol, ktrans)               # l.5
+        kx = kernel.gram(x)                              # l.6
+        var = np.diag(kx - v.T @ v)
+        return mean, var
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Posterior (mean, variance), averaged over kernel samples."""
+        means, variances = zip(*(self._predict_one(x, *f) for f in self._factors))
+        return np.mean(means, axis=0), np.mean(variances, axis=0)
+
+    def predict_transformed(self, x: np.ndarray) -> np.ndarray:
+        """Acquisition values, averaged over kernel samples."""
+        outs = []
+        for f in self._factors:
+            mean, var = self._predict_one(x, *f)
+            outs.append(self.transformation(mean, var)
+                        if self.transformation else mean)
+        return np.mean(outs, axis=0)
+
+
+class GaussianProcessEstimator:
+    """Reference: GaussianProcessEstimator.scala."""
+
+    def __init__(self,
+                 kernel: StationaryKernel = Matern52(),
+                 normalize_labels: bool = False,
+                 noisy_target: bool = False,
+                 transformation: Optional[PredictionTransformation] = None,
+                 num_burn_in_samples: int = 100,
+                 num_samples: int = 10,
+                 seed: int = 0):
+        self.kernel = kernel
+        self.normalize_labels = normalize_labels
+        self.noisy_target = noisy_target
+        self.transformation = transformation
+        self.num_burn_in_samples = num_burn_in_samples
+        self.num_samples = num_samples
+        self.rng = np.random.default_rng(seed)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> GaussianProcessModel:
+        x = np.asarray(x, float)
+        y = np.asarray(y, float)
+        y_mean = 0.0
+        if self.normalize_labels:
+            y_mean = float(np.mean(y))
+            y = y - y_mean
+        kernels = self._estimate_kernel_params(x, y)
+        return GaussianProcessModel(x, y, y_mean, kernels, self.transformation)
+
+    # -- kernel-hyperparameter posterior sampling ----------------------------
+
+    def _estimate_kernel_params(self, x, y) -> List[StationaryKernel]:
+        theta = self.kernel.initial_for(x, y).params
+        for _ in range(self.num_burn_in_samples):
+            theta = self._sample_next(theta, x, y)
+        samples = []
+        for _ in range(self.num_samples):
+            theta = self._sample_next(theta, x, y)
+            samples.append(self.kernel.with_params(theta))
+        return samples
+
+    def _sample_next(self, theta: np.ndarray, x, y) -> np.ndarray:
+        """Amplitude(+noise) along a random direction, then length scales
+        dimension-wise — sampled separately because of their interplay
+        (reference: GaussianProcessEstimator.sampleNext)."""
+        sampler = SliceSampler(rng=self.rng)
+        amp_noise, ls = theta[:2], theta[2:]
+
+        if self.noisy_target:
+            amp_noise = sampler.draw(
+                amp_noise,
+                lambda an: self.kernel.with_params(
+                    np.concatenate([an, ls])).log_likelihood(x, y))
+        else:
+            amp = sampler.draw(
+                amp_noise[:1],
+                lambda a: self.kernel.with_params(
+                    np.concatenate([a, [DEFAULT_NOISE], ls])).log_likelihood(x, y))
+            amp_noise = np.concatenate([amp, [DEFAULT_NOISE]])
+
+        ls = sampler.draw_dimension_wise(
+            ls,
+            lambda l: self.kernel.with_params(
+                np.concatenate([amp_noise, l])).log_likelihood(x, y))
+        return np.concatenate([amp_noise, ls])

@@ -1,0 +1,129 @@
+"""Stationary GP covariance kernels with marginal likelihood + priors.
+
+Reference: photon-lib hyperparameter/estimators/kernels/StationaryKernel
+.scala (pairwise distances over length-scaled inputs, GPML-2.1 log
+marginal likelihood with lognormal amplitude prior, horseshoe noise
+prior, tophat length-scale prior), RBF.scala:70 (exp(-d/2)),
+Matern52.scala:82 ((1 + sqrt(5d) + 5d/3) exp(-sqrt(5d))), Kernel.scala.
+
+Host-side math: GP fits see tens of observations, so this is numpy on
+the driver — the TPU is for the training jobs the search launches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+DEFAULT_NOISE = 1e-4
+
+
+def _pairwise_sq_dists(x1: np.ndarray, x2: Optional[np.ndarray] = None) -> np.ndarray:
+    if x2 is None:
+        x2 = x1
+    d = x1[:, None, :] - x2[None, :, :]
+    return np.sum(d * d, axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class StationaryKernel:
+    """amplitude * f(pairwise dists of x / lengthscale) + noise * I."""
+
+    amplitude: float = 1.0
+    noise: float = DEFAULT_NOISE
+    length_scale: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.ones(1))
+
+    # priors (reference: StationaryKernel.scala)
+    amplitude_scale: float = 1.0     # lognormal
+    noise_scale: float = 0.1         # horseshoe
+    length_scale_max: float = 2.0    # tophat
+
+    def _from_sq_dists(self, d: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def _ls(self, dim: int) -> np.ndarray:
+        ls = np.asarray(self.length_scale, float).ravel()
+        if ls.size == 1:
+            return np.full(dim, ls[0])
+        assert ls.size == dim, f"length scale dim {ls.size} != {dim}"
+        return ls
+
+    def gram(self, x: np.ndarray) -> np.ndarray:
+        ls = self._ls(x.shape[1])
+        d = _pairwise_sq_dists(x / ls)
+        return self.amplitude * self._from_sq_dists(d) + \
+            self.noise * np.eye(x.shape[0])
+
+    def cross(self, x1: np.ndarray, x2: np.ndarray) -> np.ndarray:
+        ls = self._ls(x1.shape[1])
+        d = _pairwise_sq_dists(x1 / ls, x2 / ls)
+        return self.amplitude * self._from_sq_dists(d)
+
+    # -- parameter vector (amplitude, noise, *length_scale) ------------------
+
+    @property
+    def params(self) -> np.ndarray:
+        return np.concatenate([[self.amplitude, self.noise],
+                               np.atleast_1d(self.length_scale)])
+
+    def with_params(self, theta: np.ndarray) -> "StationaryKernel":
+        return dataclasses.replace(
+            self, amplitude=float(theta[0]), noise=float(theta[1]),
+            length_scale=np.asarray(theta[2:], float))
+
+    def initial_for(self, x: np.ndarray, y: np.ndarray) -> "StationaryKernel":
+        """Initial kernel from data (reference: amplitude = stddev(y))."""
+        amp = float(np.std(y, ddof=1)) if len(y) > 1 else 1.0
+        return dataclasses.replace(self, amplitude=amp or 1.0,
+                                   length_scale=np.ones(x.shape[1]))
+
+    # -- GPML 2.1 ------------------------------------------------------------
+
+    def log_likelihood(self, x: np.ndarray, y: np.ndarray) -> float:
+        ls = np.atleast_1d(np.asarray(self.length_scale, float))
+        if self.amplitude < 0.0 or self.noise < 0.0 or np.any(ls < 0.0):
+            return -np.inf
+        if np.any(ls > self.length_scale_max):  # tophat prior
+            return -np.inf
+        k = self.gram(x)
+        try:
+            chol = np.linalg.cholesky(k)
+        except np.linalg.LinAlgError:
+            return -np.inf
+        alpha = np.linalg.solve(chol.T, np.linalg.solve(chol, y))
+        ll = (-0.5 * float(y @ alpha)
+              - float(np.sum(np.log(np.diag(chol))))
+              - 0.5 * len(y) * np.log(2 * np.pi))
+        # lognormal amplitude prior + horseshoe noise prior
+        if self.amplitude > 0:
+            ll += -0.5 * np.log(np.sqrt(self.amplitude / self.amplitude_scale)) ** 2
+        if self.noise > 0:
+            ll += np.log(np.log1p((self.noise_scale / self.noise) ** 2))
+        return ll
+
+    def posterior_factors(self, x: np.ndarray, y: np.ndarray
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+        """(cholesky L, alpha) for posterior prediction (GPML 2.1 l.2-3)."""
+        chol = np.linalg.cholesky(self.gram(x))
+        alpha = np.linalg.solve(chol.T, np.linalg.solve(chol, y))
+        return chol, alpha
+
+
+@dataclasses.dataclass(frozen=True)
+class RBF(StationaryKernel):
+    """Squared-exponential (reference: RBF.scala:70)."""
+
+    def _from_sq_dists(self, d: np.ndarray) -> np.ndarray:
+        return np.exp(-0.5 * d)
+
+
+@dataclasses.dataclass(frozen=True)
+class Matern52(StationaryKernel):
+    """Matern nu=5/2 (reference: Matern52.scala:82)."""
+
+    def _from_sq_dists(self, d: np.ndarray) -> np.ndarray:
+        f = np.sqrt(5.0 * d)
+        return (1.0 + f + 5.0 * d / 3.0) * np.exp(-f)

@@ -1,0 +1,63 @@
+"""Hyperparameter-space transforms: [0,1] unit cube <-> natural ranges.
+
+Reference: photon-lib hyperparameter/VectorRescaling.scala — LOG (base
+10) / SQRT per-index forward and backward transforms, and linear scaling
+into/out of [0,1] with a +1 range adjustment for discrete indices.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Set, Tuple
+
+import numpy as np
+
+LOG_TRANSFORM = "LOG"
+SQRT_TRANSFORM = "SQRT"
+
+DoubleRange = Tuple[float, float]
+
+
+def transform_forward(vector: np.ndarray,
+                      transforms: Dict[int, str]) -> np.ndarray:
+    out = np.array(vector, float)
+    for idx, t in transforms.items():
+        if t == LOG_TRANSFORM:
+            out[idx] = np.log10(out[idx])
+        elif t == SQRT_TRANSFORM:
+            out[idx] = np.sqrt(out[idx])
+        else:
+            raise ValueError(f"unknown transformation {t!r}")
+    return out
+
+
+def transform_backward(vector: np.ndarray,
+                       transforms: Dict[int, str]) -> np.ndarray:
+    out = np.array(vector, float)
+    for idx, t in transforms.items():
+        if t == LOG_TRANSFORM:
+            out[idx] = 10.0 ** out[idx]
+        elif t == SQRT_TRANSFORM:
+            out[idx] = out[idx] ** 2
+        else:
+            raise ValueError(f"unknown transformation {t!r}")
+    return out
+
+
+def _range_arrays(ranges: Sequence[DoubleRange], discrete: Set[int]):
+    start = np.asarray([r[0] for r in ranges], float)
+    end = np.asarray([r[1] for r in ranges], float)
+    adj = np.asarray([1.0 if i in discrete else 0.0
+                      for i in range(len(ranges))])
+    return start, end, adj
+
+
+def scale_forward(vector: np.ndarray, ranges: Sequence[DoubleRange],
+                  discrete: Set[int] = frozenset()) -> np.ndarray:
+    start, end, adj = _range_arrays(ranges, set(discrete))
+    return (np.asarray(vector, float) - start) / (end - start + adj)
+
+
+def scale_backward(vector: np.ndarray, ranges: Sequence[DoubleRange],
+                   discrete: Set[int] = frozenset()) -> np.ndarray:
+    start, end, adj = _range_arrays(ranges, set(discrete))
+    return np.asarray(vector, float) * (end - start + adj) + start

@@ -1,0 +1,160 @@
+"""Hyperparameter search: Sobol random search + GP Bayesian optimization.
+
+Reference: photon-lib hyperparameter/search/RandomSearch.scala (Sobol
+low-discrepancy draws in [0,1]^d, optional per-index discretization,
+findWithPriors / findWithPriorObservations / find protocol) and
+GaussianProcessSearch.scala (EI over a Sobol candidate pool, observation
+and prior-observation accumulation, mean-centered evals, fallback to
+random draws until observations exceed the parameter count).
+
+The evaluation function MINIMIZES its value (negate bigger-is-better
+metrics in the glue — reference convention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from scipy.stats import qmc
+
+from photon_tpu.hyperparameter.criteria import ExpectedImprovement
+from photon_tpu.hyperparameter.gp import GaussianProcessEstimator
+from photon_tpu.hyperparameter.kernels import Matern52, StationaryKernel
+
+# evaluation: candidate in [0,1]^d -> (value to minimize, fitted artifact)
+EvaluationFunction = Callable[[np.ndarray], Tuple[float, Any]]
+
+Observation = Tuple[np.ndarray, float]
+
+
+class RandomSearch:
+    """Sobol-sequence search (reference: RandomSearch.scala:34)."""
+
+    def __init__(self, num_params: int, evaluation_function: EvaluationFunction,
+                 discrete_params: Optional[Dict[int, int]] = None,
+                 kernel: StationaryKernel = Matern52(),
+                 seed: int = 0):
+        assert num_params > 0
+        self.num_params = num_params
+        self.evaluation_function = evaluation_function
+        self.discrete_params = dict(discrete_params or {})
+        self.kernel = kernel
+        self.seed = seed
+        self._sobol = qmc.Sobol(d=num_params, scramble=True, seed=seed)
+
+    # -- protocol ------------------------------------------------------------
+
+    def find(self, n: int) -> List[Any]:
+        return self.find_with_prior_observations(n, [])
+
+    def find_with_prior_observations(self, n: int,
+                                     prior_observations: Sequence[Observation]
+                                     ) -> List[Any]:
+        assert n > 0
+        candidate = self._discretize(self.draw_candidates(1)[0])
+        value, model = self.evaluation_function(candidate)
+        if n == 1:
+            return [model]
+        return [model] + self.find_with_priors(
+            n - 1, [(candidate, value)], prior_observations)
+
+    def find_with_priors(self, n: int, observations: Sequence[Observation],
+                         prior_observations: Sequence[Observation]) -> List[Any]:
+        assert n > 0 and len(observations) > 0
+        for point, value in observations[:-1]:
+            self._on_observation(point, value)
+        for point, value in prior_observations:
+            self._on_prior_observation(point, value)
+        last_point, last_value = observations[-1]
+        models = []
+        for _ in range(n):
+            candidate = self._discretize(self._next(last_point, last_value))
+            value, model = self.evaluation_function(candidate)
+            models.append(model)
+            last_point, last_value = candidate, value
+        return models
+
+    # -- extension points (GP search overrides) ------------------------------
+
+    def _next(self, last_point: np.ndarray, last_value: float) -> np.ndarray:
+        return self.draw_candidates(1)[0]
+
+    def _on_observation(self, point: np.ndarray, value: float) -> None:
+        pass
+
+    def _on_prior_observation(self, point: np.ndarray, value: float) -> None:
+        pass
+
+    # -- helpers -------------------------------------------------------------
+
+    def draw_candidates(self, n: int) -> np.ndarray:
+        return self._sobol.random(n)
+
+    def _discretize(self, candidate: np.ndarray) -> np.ndarray:
+        out = candidate.copy()
+        for idx, levels in self.discrete_params.items():
+            out[idx] = np.floor(out[idx] * levels) / levels
+        return out
+
+
+class GaussianProcessSearch(RandomSearch):
+    """Bayesian optimization (reference: GaussianProcessSearch.scala:52)."""
+
+    def __init__(self, num_params: int, evaluation_function: EvaluationFunction,
+                 discrete_params: Optional[Dict[int, int]] = None,
+                 kernel: StationaryKernel = Matern52(),
+                 candidate_pool_size: int = 250,
+                 noisy_target: bool = True,
+                 seed: int = 0):
+        super().__init__(num_params, evaluation_function, discrete_params,
+                         kernel, seed)
+        self.candidate_pool_size = candidate_pool_size
+        self.noisy_target = noisy_target
+        self._points: List[np.ndarray] = []
+        self._values: List[float] = []
+        self._best = np.inf
+        self._prior_points: List[np.ndarray] = []
+        self._prior_values: List[float] = []
+        self._prior_best = np.inf
+        self.last_model = None
+
+    def _on_observation(self, point: np.ndarray, value: float) -> None:
+        self._points.append(np.asarray(point, float))
+        self._values.append(float(value))
+        self._best = min(self._best, float(value))
+
+    def _on_prior_observation(self, point: np.ndarray, value: float) -> None:
+        self._prior_points.append(np.asarray(point, float))
+        self._prior_values.append(float(value))
+        self._prior_best = min(self._prior_best, float(value))
+
+    def _next(self, last_point: np.ndarray, last_value: float) -> np.ndarray:
+        self._on_observation(last_point, last_value)
+        # under-determined -> uniform draws until we exceed num_params obs
+        if len(self._points) <= self.num_params:
+            return super()._next(last_point, last_value)
+
+        candidates = self.draw_candidates(self.candidate_pool_size)
+        evals = np.asarray(self._values)
+        current_mean = float(np.mean(evals))
+        overall_best = min(self._prior_best, self._best - current_mean)
+        transformation = ExpectedImprovement(overall_best)
+
+        points = np.vstack(self._points)
+        centered = evals - current_mean
+        if self._prior_points:
+            points = np.vstack([points, np.vstack(self._prior_points)])
+            centered = np.concatenate([centered, self._prior_values])
+
+        estimator = GaussianProcessEstimator(
+            kernel=self.kernel, normalize_labels=False,
+            noisy_target=self.noisy_target, transformation=transformation,
+            seed=self.seed)
+        model = estimator.fit(points, centered)
+        self.last_model = model
+
+        predictions = model.predict_transformed(candidates)
+        idx = (np.argmax(predictions) if transformation.is_max_opt
+               else np.argmin(predictions))
+        return candidates[idx]

@@ -1,0 +1,76 @@
+"""Slice sampling for kernel-hyperparameter posteriors.
+
+Reference: photon-lib hyperparameter/SliceSampler.scala — univariate
+slice sampling along a direction (Neal 2003): draw slice level
+y = log u + logp(x), step out an interval along the direction until it
+brackets the slice, then shrink rejected proposals back toward x.
+``draw`` samples along one random direction; ``draw_dimension_wise``
+cycles axis-aligned directions in shuffled order.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import numpy as np
+
+LogP = Callable[[np.ndarray], float]
+
+
+class SliceSampler:
+
+    def __init__(self, step_size: float = 1.0, max_steps_out: int = 1000,
+                 rng: np.random.Generator | int | None = None):
+        self.step_size = step_size
+        self.max_steps_out = max_steps_out
+        self.rng = (rng if isinstance(rng, np.random.Generator)
+                    else np.random.default_rng(rng))
+
+    def draw(self, x: np.ndarray, logp: LogP) -> np.ndarray:
+        """One sample along a uniformly random direction."""
+        d = self.rng.normal(size=len(x))
+        d = d / np.linalg.norm(d)
+        return self._draw_along(x, logp, d)
+
+    def draw_dimension_wise(self, x: np.ndarray, logp: LogP) -> np.ndarray:
+        """One Gibbs-style sweep: each coordinate direction in random order."""
+        order = self.rng.permutation(len(x))
+        for i in order:
+            e = np.zeros(len(x))
+            e[i] = 1.0
+            x = self._draw_along(x, logp, e)
+        return x
+
+    # -- internals -----------------------------------------------------------
+
+    def _draw_along(self, x: np.ndarray, logp: LogP, direction: np.ndarray
+                    ) -> np.ndarray:
+        y = np.log(self.rng.random()) + logp(x)
+        lower, upper = self._step_out(x, y, logp, direction)
+        # shrink until a proposal lands above the slice
+        for _ in range(1000):
+            new_x = lower + self.rng.random() * (upper - lower)
+            if logp(new_x) > y:
+                return new_x
+            if new_x @ direction < x @ direction:
+                lower = new_x
+            elif new_x @ direction > x @ direction:
+                upper = new_x
+            else:
+                # slice shrank to the current point — keep it
+                return x
+        return x
+
+    def _step_out(self, x: np.ndarray, y: float, logp: LogP,
+                  direction: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        lower = x - direction * self.rng.random() * self.step_size
+        upper = lower + direction * self.step_size
+        steps = 0
+        while logp(lower) > y and steps < self.max_steps_out:
+            lower = lower - direction * self.step_size
+            steps += 1
+        steps = 0
+        while logp(upper) > y and steps < self.max_steps_out:
+            upper = upper + direction * self.step_size
+            steps += 1
+        return lower, upper

@@ -1,0 +1,138 @@
+"""GAME estimator tuning glue + tuner factory.
+
+Reference: photon-client estimators/GameEstimatorEvaluationFunction
+.scala:40 (candidate vector in [0,1]^d <-> per-coordinate regularization
+weights on log10 scale within ranges; apply = retrain + primary
+validation metric), photon-api hyperparameter/tuner/
+HyperparameterTunerFactory.scala:19 (DUMMY vs ATLAS), AtlasTuner.scala:27
+(BAYESIAN -> GaussianProcessSearch, RANDOM -> RandomSearch),
+photon-lib HyperparameterTuningMode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_tpu.hyperparameter.rescaling import (
+    scale_backward,
+    scale_forward,
+)
+from photon_tpu.hyperparameter.search import (
+    GaussianProcessSearch,
+    Observation,
+    RandomSearch,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class HyperparameterTuningMode(enum.Enum):
+    BAYESIAN = "BAYESIAN"
+    RANDOM = "RANDOM"
+    NONE = "NONE"
+
+
+@dataclasses.dataclass(frozen=True)
+class TuningRange:
+    """log10 regularization-weight range for one coordinate (reference
+    default 1e-4..1e4, GameHyperparameterDefaults)."""
+
+    min_weight: float = 1e-4
+    max_weight: float = 1e4
+
+    @property
+    def log_range(self) -> Tuple[float, float]:
+        return (np.log10(self.min_weight), np.log10(self.max_weight))
+
+
+class GameEstimatorEvaluationFunction:
+    """Bridge between the search's [0,1]^d vectors and GAME configs.
+
+    ``estimator.fit`` is invoked per candidate with one configuration
+    {coordinate: reg weight}; the value minimized is the primary
+    validation metric (negated when bigger is better).
+    """
+
+    def __init__(self, estimator, df, validation_df,
+                 ranges: Optional[Dict[str, TuningRange]] = None,
+                 initial_model=None):
+        self.estimator = estimator
+        self.df = df
+        self.validation_df = validation_df
+        self.coordinate_ids = list(estimator.coordinate_configs.keys())
+        self.ranges = {cid: (ranges or {}).get(cid, TuningRange())
+                       for cid in self.coordinate_ids}
+        self.initial_model = initial_model
+        self.num_params = len(self.coordinate_ids)
+        self._log_ranges = [self.ranges[cid].log_range
+                            for cid in self.coordinate_ids]
+
+    # -- vector <-> configuration (reference :104-144) -----------------------
+
+    def vector_to_configuration(self, candidate: np.ndarray) -> Dict[str, float]:
+        logw = scale_backward(candidate, self._log_ranges)
+        return {cid: float(10.0 ** w)
+                for cid, w in zip(self.coordinate_ids, logw)}
+
+    def configuration_to_vector(self, config: Dict[str, float]) -> np.ndarray:
+        logw = np.asarray([np.log10(config[cid]) for cid in self.coordinate_ids])
+        return scale_forward(logw, self._log_ranges)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def __call__(self, candidate: np.ndarray):
+        config = self.vector_to_configuration(candidate)
+        results = self.estimator.fit(
+            self.df, validation_df=self.validation_df,
+            configurations=[config], initial_model=self.initial_model)
+        result = results[-1]
+        value = self._value_of(result)
+        logger.info("tuning candidate %s -> %s", config, value)
+        return value, result
+
+    def _value_of(self, result) -> float:
+        primary = self.estimator.evaluators[0]
+        v = result.evaluation[primary.value]
+        return -v if primary.bigger_is_better else v
+
+    def convert_observations(self, results: Sequence) -> List[Observation]:
+        """Past GameResults -> (vector, value) observations for warm-started
+        search (reference: EvaluationFunction.convertObservations)."""
+        out = []
+        for r in results:
+            weights = {cid: ccfg.optimization.regularization_weight
+                       for cid, ccfg in r.config.items()}
+            out.append((self.configuration_to_vector(weights),
+                        self._value_of(r)))
+        return out
+
+
+def run_hyperparameter_tuning(
+    estimator,
+    df,
+    validation_df,
+    n_iterations: int,
+    mode: HyperparameterTuningMode = HyperparameterTuningMode.BAYESIAN,
+    ranges: Optional[Dict[str, TuningRange]] = None,
+    prior_results: Sequence = (),
+    seed: int = 0,
+) -> List:
+    """Tune per-coordinate reg weights; returns the candidate GameResults
+    (reference: GameTrainingDriver.runHyperparameterTuning :559 +
+    AtlasTuner routing)."""
+    if mode == HyperparameterTuningMode.NONE or n_iterations <= 0:
+        return []
+    fn = GameEstimatorEvaluationFunction(estimator, df, validation_df,
+                                         ranges=ranges)
+    search_cls = (GaussianProcessSearch
+                  if mode == HyperparameterTuningMode.BAYESIAN else RandomSearch)
+    search = search_cls(fn.num_params, fn, seed=seed)
+    priors = fn.convert_observations(prior_results)
+    if priors:
+        return search.find_with_prior_observations(n_iterations, priors)
+    return search.find(n_iterations)

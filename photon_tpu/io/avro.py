@@ -52,6 +52,27 @@ class _Names:
     def __init__(self):
         self.types: Dict[str, dict] = {}
 
+    def register_all(self, schema: Any, enclosing_ns: Optional[str] = None) -> None:
+        """Eagerly register every named type in a schema tree, so by-name
+        references resolve even when the defining field's data is empty."""
+        if isinstance(schema, str):
+            return
+        if isinstance(schema, list):
+            for s in schema:
+                self.register_all(s, enclosing_ns)
+            return
+        t = schema.get("type")
+        if t in ("record", "enum", "fixed"):
+            self.types[_full_name(schema, enclosing_ns)] = schema
+        if t == "record":
+            ns = schema.get("namespace", enclosing_ns)
+            for f in schema["fields"]:
+                self.register_all(f["type"], ns)
+        elif t == "array":
+            self.register_all(schema["items"], enclosing_ns)
+        elif t == "map":
+            self.register_all(schema["values"], enclosing_ns)
+
     def resolve(self, schema: Any, enclosing_ns: Optional[str] = None) -> Any:
         """Return the concrete schema for ``schema``, registering named types."""
         if isinstance(schema, str):
@@ -240,7 +261,10 @@ def _union_branch(schema_list: list, datum: Any, names: _Names, ns) -> int:
             if isinstance(datum, int) and not isinstance(datum, bool) \
                     and bt in ("int", "long"):
                 return i
-            if isinstance(datum, float) and bt in ("float", "double"):
+            # ints promote to float/double (Avro numeric promotion) when no
+            # integral branch exists
+            if isinstance(datum, (int, float)) and not isinstance(datum, bool) \
+                    and bt in ("float", "double"):
                 return i
             if isinstance(datum, str) and bt in ("string", "enum"):
                 return i
@@ -343,6 +367,7 @@ class AvroFileReader:
         codec = self._meta.get(b"avro.codec", self._meta.get("avro.codec", b"null"))
         self.codec = codec.decode() if isinstance(codec, bytes) else codec
         self._names = _Names()
+        self._names.register_all(self.schema)
 
     def __iter__(self) -> Iterator[Any]:
         dec = self._body
@@ -388,6 +413,7 @@ def write_avro(path: str, schema: Any, records: Iterable[Any],
                codec: str = "deflate", sync_interval: int = 4000) -> None:
     """Write records to one Avro object-container file."""
     names = _Names()
+    names.register_all(schema)
     sync = os.urandom(SYNC_SIZE)
     with open(path, "wb") as f:
         f.write(MAGIC)

@@ -133,7 +133,7 @@ def records_to_game_dataframe(
                 val.append(v)
             if cfg.has_intercept:
                 j = imap.get_index(INTERCEPT_KEY)
-                if j >= 0:
+                if j >= 0 and j not in seen:  # data may carry its own intercept
                     idx.append(j)
                     val.append(1.0)
             rows[sid][i] = (np.asarray(idx, np.int32), np.asarray(val))

@@ -312,30 +312,38 @@ def load_game_model(
                 entities.append((str(rec["modelId"]), rec["means"],
                                  rec.get("variances"),
                                  rec.get("modelClass") or ""))
-            # dense block: entity row per record order, local slots = each
-            # entity's own nonzero support (the IndexMapProjector role)
+            # dense block: entity row per record order, local slots = the
+            # union of the means + variances supports (the IndexMapProjector
+            # role; means and variances are independent vectors on disk)
             vocab.build(re_type, [e[0] for e in entities])
             E = len(entities)
-            k_max = max((len(e[1]) for e in entities), default=1) or 1
-            coef = np.zeros((E, k_max), dtype)
-            var_block = np.zeros((E, k_max), dtype)
+            per_entity: List[Dict[int, Tuple[float, float]]] = []
             have_var = False
-            proj = np.full((E, k_max), -1, np.int32)
             rec_task = task
-            for e, (re_id, means, variances, cls) in enumerate(entities):
+            for re_id, means, variances, cls in entities:
                 rec_task = _TASK_FOR_CLASS.get(cls, task)
-                var_map = {}
+                slots: Dict[int, Tuple[float, float]] = {}
+                for r in means:
+                    g = imap.index_of(str(r["name"]), str(r["term"]))
+                    if g >= 0:
+                        slots[g] = (float(r["value"]), 0.0)
                 if variances:
                     have_var = True
-                    var_map = {(str(r["name"]), str(r["term"])): r["value"]
-                               for r in variances}
-                for s, r in enumerate(means):
-                    g = imap.index_of(str(r["name"]), str(r["term"]))
-                    if g < 0:
-                        continue
+                    for r in variances:
+                        g = imap.index_of(str(r["name"]), str(r["term"]))
+                        if g >= 0:
+                            mean_v = slots.get(g, (0.0, 0.0))[0]
+                            slots[g] = (mean_v, float(r["value"]))
+                per_entity.append(slots)
+            k_max = max((len(s) for s in per_entity), default=1) or 1
+            coef = np.zeros((E, k_max), dtype)
+            var_block = np.zeros((E, k_max), dtype)
+            proj = np.full((E, k_max), -1, np.int32)
+            for e, slots in enumerate(per_entity):
+                for s, (g, (m, v)) in enumerate(sorted(slots.items())):
                     proj[e, s] = g
-                    coef[e, s] = r["value"]
-                    var_block[e, s] = var_map.get((str(r["name"]), str(r["term"])), 0.0)
+                    coef[e, s] = m
+                    var_block[e, s] = v
             models[cid] = RandomEffectModel(
                 coefficients=jnp.asarray(coef),
                 random_effect_type=re_type,

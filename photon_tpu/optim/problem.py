@@ -35,8 +35,22 @@ from photon_tpu.ops.normalization import NormalizationContext, no_normalization
 from photon_tpu.optim import lbfgs, owlqn, tron
 from photon_tpu.optim.base import SolverConfig, SolverResult
 from photon_tpu.types import OptimizerType, TaskType, VarianceComputationType
+from photon_tpu.utils import jitcache
 
 Array = jax.Array
+
+
+def solver_cache_key(opt: "OptimizerConfig") -> tuple:
+    """Everything in an OptimizerConfig that shapes a solver's trace."""
+    return (opt.optimizer_type, opt.max_iterations, opt.tolerance,
+            opt.num_corrections, opt.max_cg_iterations,
+            jitcache.array_token(opt.lower_bounds),
+            jitcache.array_token(opt.upper_bounds))
+
+
+def norm_cache_key(norm) -> tuple:
+    return (jitcache.array_token(norm.factors),
+            jitcache.array_token(norm.shifts))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,17 +113,24 @@ class GlmOptimizationProblem:
         solver_cfg = opt.solver_config()
         obj = self.objective
 
-        def solve(x0: Array, batch: DataBatch, l2: Array, l1: Array) -> SolverResult:
-            hyper = Hyper(l2_weight=l2)
-            vg = lambda c: obj.value_and_gradient(c, batch, hyper)
-            if opt.optimizer_type == OptimizerType.OWLQN:
-                return owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
-            if opt.optimizer_type == OptimizerType.TRON:
-                hv = lambda c, v: obj.hessian_vector(c, v, batch, hyper)
-                return tron.minimize(vg, hv, x0, config=solver_cfg)
-            return lbfgs.minimize(vg, x0, config=solver_cfg)
+        def build():
+            def solve(x0: Array, batch: DataBatch, l2: Array, l1: Array) -> SolverResult:
+                hyper = Hyper(l2_weight=l2)
+                vg = lambda c: obj.value_and_gradient(c, batch, hyper)
+                if opt.optimizer_type == OptimizerType.OWLQN:
+                    return owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
+                if opt.optimizer_type == OptimizerType.TRON:
+                    hv = lambda c, v: obj.hessian_vector(c, v, batch, hyper)
+                    return tron.minimize(vg, hv, x0, config=solver_cfg)
+                return lbfgs.minimize(vg, x0, config=solver_cfg)
 
-        return jax.jit(solve)
+            return jax.jit(solve)
+
+        # share the compiled solve across problem instances with identical
+        # trace-shaping state (re-fits, sweep candidates, fresh estimators)
+        key = ("glm_solve", self.task, solver_cache_key(opt),
+               norm_cache_key(self.objective.norm))
+        return jitcache.get_or_build(key, build)
 
     def run(
         self,
@@ -150,21 +171,25 @@ class GlmOptimizationProblem:
     def _variance_fns(self):
         obj = self.objective
 
-        @jax.jit
-        def simple(coef: Array, batch: DataBatch, l2: Array) -> Array:
-            d = obj.hessian_diagonal(coef, batch, Hyper(l2_weight=l2))
-            return 1.0 / jnp.maximum(d, jnp.finfo(d.dtype).tiny)
+        def build():
+            @jax.jit
+            def simple(coef: Array, batch: DataBatch, l2: Array) -> Array:
+                d = obj.hessian_diagonal(coef, batch, Hyper(l2_weight=l2))
+                return 1.0 / jnp.maximum(d, jnp.finfo(d.dtype).tiny)
 
-        @jax.jit
-        def full(coef: Array, batch: DataBatch, l2: Array) -> Array:
-            h = obj.hessian_matrix(coef, batch, Hyper(l2_weight=l2))
-            # diag(H^-1) via Cholesky (reference: util/Linalg Cholesky solves)
-            eye = jnp.eye(h.shape[0], dtype=h.dtype)
-            chol = jax.scipy.linalg.cho_factor(h)
-            hinv = jax.scipy.linalg.cho_solve(chol, eye)
-            return jnp.diag(hinv)
+            @jax.jit
+            def full(coef: Array, batch: DataBatch, l2: Array) -> Array:
+                h = obj.hessian_matrix(coef, batch, Hyper(l2_weight=l2))
+                # diag(H^-1) via Cholesky (reference: util/Linalg Cholesky solves)
+                eye = jnp.eye(h.shape[0], dtype=h.dtype)
+                chol = jax.scipy.linalg.cho_factor(h)
+                hinv = jax.scipy.linalg.cho_solve(chol, eye)
+                return jnp.diag(hinv)
 
-        return simple, full
+            return simple, full
+
+        key = ("glm_variance", self.task, norm_cache_key(self.objective.norm))
+        return jitcache.get_or_build(key, build)
 
     def compute_variances(
         self,

@@ -1,0 +1,41 @@
+"""Cross-instance sharing of jit-compiled solver programs.
+
+`jax.jit` caches compiled executables per *function object*. Estimator /
+coordinate / problem instances build their jitted solves as closures, so
+every new instance (a re-fit, a hyperparameter-sweep candidate, a fresh
+estimator on new data of the same shape) would re-trace and re-compile
+programs that are byte-identical. The reference has the same concern in
+Spark clothing — closures shipped per job, re-broadcast per iteration —
+and the TPU answer is: key the compiled program by everything that shapes
+its trace (task, solver constants, identity of any arrays baked in via
+closure), and share it process-wide.
+
+Array-valued key parts are keyed by ``id``; the cached closure keeps the
+array alive, so an id cannot be re-used while its cache entry exists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+_CACHE: Dict[tuple, Callable] = {}
+
+
+def array_token(a) -> Optional[Tuple[str, int]]:
+    """Stable hashable stand-in for an (optional) array closure capture."""
+    return None if a is None else ("arr", id(a))
+
+
+def get_or_build(key: tuple, builder: Callable[[], Callable]) -> Callable:
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = _CACHE[key] = builder()
+    return fn
+
+
+def cache_size() -> int:
+    return len(_CACHE)
+
+
+def clear() -> None:
+    _CACHE.clear()

@@ -1,0 +1,261 @@
+"""Hyperparameter-search tests.
+
+Mirrors the reference's photon-lib hyperparameter test coverage: kernel
+math, slice-sampler distribution sanity, GP posterior vs analytic
+results, EI/CB acquisition, rescaling round-trips, and the headline
+check — GP search beats random search on a synthetic landscape
+(VERDICT round-1 item 6).
+"""
+
+import numpy as np
+import pytest
+
+from photon_tpu.hyperparameter import (
+    ConfidenceBound,
+    ExpectedImprovement,
+    GaussianProcessEstimator,
+    GaussianProcessSearch,
+    Matern52,
+    RBF,
+    RandomSearch,
+    SliceSampler,
+    scale_backward,
+    scale_forward,
+    transform_backward,
+    transform_forward,
+)
+
+
+# -- kernels -----------------------------------------------------------------
+
+
+def test_rbf_gram_matches_manual():
+    x = np.asarray([[0.0, 0.0], [1.0, 0.0], [0.0, 2.0]])
+    k = RBF(amplitude=2.0, noise=0.1, length_scale=np.asarray([1.0, 2.0]))
+    g = k.gram(x)
+    # diag = amplitude + noise
+    np.testing.assert_allclose(np.diag(g), 2.1)
+    # off-diag (0,1): squared dist = 1 -> 2 * exp(-0.5)
+    assert g[0, 1] == pytest.approx(2.0 * np.exp(-0.5))
+    # (0,2): scaled dist = (2/2)^2 = 1
+    assert g[0, 2] == pytest.approx(2.0 * np.exp(-0.5))
+    assert np.allclose(g, g.T)
+
+
+def test_matern52_limits():
+    x = np.asarray([[0.0], [0.0]])
+    k = Matern52(amplitude=1.0, noise=0.0)
+    g = k.gram(x)
+    np.testing.assert_allclose(g, 1.0)  # zero distance -> amplitude
+    # monotone decreasing in distance
+    d = np.linspace(0, 3, 50)[:, None]
+    vals = k.cross(np.zeros((1, 1)), d)[0]
+    assert np.all(np.diff(vals) <= 1e-12)
+
+
+def test_kernel_loglik_rejects_out_of_prior():
+    x = np.random.default_rng(0).normal(size=(5, 2))
+    y = np.random.default_rng(1).normal(size=5)
+    assert Matern52(amplitude=-1.0).log_likelihood(x, y) == -np.inf
+    assert Matern52(length_scale=np.asarray([5.0])).log_likelihood(x, y) == -np.inf
+    k = Matern52(length_scale=np.ones(2))
+    assert np.isfinite(k.log_likelihood(x, y))
+
+
+def test_kernel_loglik_prefers_true_lengthscale():
+    """Likelihood at the generating kernel beats a badly mis-scaled one."""
+    rng = np.random.default_rng(2)
+    x = rng.uniform(size=(40, 1))
+    true = RBF(amplitude=1.0, noise=1e-3, length_scale=np.asarray([0.3]))
+    k = true.gram(x)
+    y = np.linalg.cholesky(k) @ rng.normal(size=40)
+    good = RBF(amplitude=1.0, noise=1e-3, length_scale=np.asarray([0.3]))
+    bad = RBF(amplitude=1.0, noise=1e-3, length_scale=np.asarray([1.9]))
+    assert good.log_likelihood(x, y) > bad.log_likelihood(x, y)
+
+
+# -- slice sampler -----------------------------------------------------------
+
+
+def test_slice_sampler_standard_normal_moments():
+    logp = lambda v: float(-0.5 * v @ v)
+    s = SliceSampler(rng=3)
+    x = np.zeros(1)
+    samples = []
+    for _ in range(600):
+        x = s.draw(x, logp)
+        samples.append(x[0])
+    samples = np.asarray(samples[100:])
+    assert abs(samples.mean()) < 0.25
+    assert abs(samples.std() - 1.0) < 0.25
+
+
+def test_slice_sampler_dimension_wise():
+    logp = lambda v: float(-0.5 * v @ v)
+    s = SliceSampler(rng=4)
+    x = np.asarray([3.0, -3.0])
+    for _ in range(50):
+        x = s.draw_dimension_wise(x, logp)
+    assert np.all(np.abs(x) < 4.0)
+
+
+# -- GP posterior ------------------------------------------------------------
+
+
+def test_gp_posterior_interpolates_noiselessly():
+    """With tiny noise, the posterior mean passes through the data and
+    variance collapses at the training points (GPML 2.1)."""
+    x = np.asarray([[0.1], [0.5], [0.9]])
+    y = np.asarray([1.0, -1.0, 0.5])
+    est = GaussianProcessEstimator(kernel=RBF(), noisy_target=False,
+                                   num_burn_in_samples=30, num_samples=5, seed=0)
+    model = est.fit(x, y)
+    mean, var = model.predict(x)
+    np.testing.assert_allclose(mean, y, atol=5e-2)
+    assert np.all(var < 5e-2)
+
+
+def test_gp_beats_random_on_synthetic_landscape():
+    """VERDICT item 6 'done' check: GP tuning finds a better minimum than
+    Sobol random search on a smooth 2-d bowl with the same budget."""
+    target = lambda v: float((v[0] - 0.3) ** 2 + (v[1] - 0.7) ** 2)
+
+    def make_fn(log):
+        def fn(candidate):
+            val = target(candidate)
+            log.append(val)
+            return val, dict(candidate=candidate, value=val)
+        return fn
+
+    budget = 18
+    rand_log, gp_log = [], []
+    RandomSearch(2, make_fn(rand_log), seed=7).find(budget)
+    GaussianProcessSearch(2, make_fn(gp_log), seed=7).find(budget)
+    assert len(rand_log) == len(gp_log) == budget
+    # GP exploits: its best value should be at least as good, and its
+    # later candidates concentrate near the optimum
+    assert min(gp_log) <= min(rand_log) + 1e-6
+    assert np.mean(gp_log[10:]) < np.mean(rand_log[10:])
+
+
+# -- acquisition -------------------------------------------------------------
+
+
+def test_expected_improvement_properties():
+    ei = ExpectedImprovement(best_evaluation=0.0)
+    means = np.asarray([-1.0, 0.0, 1.0])
+    var = np.ones(3)
+    vals = ei(means, var)
+    # lower predicted mean -> more expected improvement
+    assert vals[0] > vals[1] > vals[2]
+    assert np.all(vals >= 0)
+    # zero variance at the incumbent -> zero EI
+    assert ei(np.asarray([0.0]), np.asarray([0.0]))[0] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_confidence_bound():
+    cb = ConfidenceBound(exploration_factor=2.0)
+    vals = cb(np.asarray([1.0, 1.0]), np.asarray([0.0, 4.0]))
+    np.testing.assert_allclose(vals, [1.0, -3.0])
+
+
+# -- rescaling ---------------------------------------------------------------
+
+
+def test_transform_roundtrip():
+    v = np.asarray([100.0, 16.0, 3.0])
+    t = {0: "LOG", 1: "SQRT"}
+    fwd = transform_forward(v, t)
+    np.testing.assert_allclose(fwd, [2.0, 4.0, 3.0])
+    np.testing.assert_allclose(transform_backward(fwd, t), v)
+
+
+def test_scale_roundtrip_with_discrete():
+    ranges = [(0.0, 10.0), (-4.0, 4.0)]
+    v = np.asarray([2.5, 0.0])
+    s = scale_forward(v, ranges)
+    np.testing.assert_allclose(s, [0.25, 0.5])
+    np.testing.assert_allclose(scale_backward(s, ranges), v)
+    # discrete index widens the range by 1
+    s2 = scale_forward(np.asarray([10.0, 0.0]), ranges, discrete={0})
+    assert s2[0] == pytest.approx(10.0 / 11.0)
+
+
+# -- estimator glue ----------------------------------------------------------
+
+
+def test_game_tuning_glue_vector_roundtrip():
+    from photon_tpu.hyperparameter import (
+        GameEstimatorEvaluationFunction,
+        TuningRange,
+    )
+
+    class FakeEstimator:
+        coordinate_configs = {"a": None, "b": None}
+        evaluators = []
+
+    fn = GameEstimatorEvaluationFunction.__new__(GameEstimatorEvaluationFunction)
+    fn.coordinate_ids = ["a", "b"]
+    fn.ranges = {"a": TuningRange(1e-4, 1e4), "b": TuningRange(1e-2, 1e2)}
+    fn._log_ranges = [fn.ranges[c].log_range for c in fn.coordinate_ids]
+    config = fn.vector_to_configuration(np.asarray([0.5, 0.75]))
+    assert config["a"] == pytest.approx(1.0)
+    assert config["b"] == pytest.approx(10.0)
+    back = fn.configuration_to_vector(config)
+    np.testing.assert_allclose(back, [0.5, 0.75], atol=1e-12)
+
+
+def test_game_tuning_end_to_end():
+    """Tune a 1-coordinate GAME logistic model's reg weight by GP search."""
+    import jax.numpy as jnp
+
+    from photon_tpu.estimators.game_estimator import (
+        CoordinateConfiguration,
+        FixedEffectDataConfiguration,
+        GameEstimator,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+    from photon_tpu.hyperparameter import (
+        HyperparameterTuningMode,
+        TuningRange,
+        run_hyperparameter_tuning,
+    )
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    n, d = 400, 8
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) < 1 / (1 + np.exp(-X @ w))).astype(float)
+    Xv = rng.normal(size=(n, d))
+    yv = (rng.random(n) < 1 / (1 + np.exp(-Xv @ w))).astype(float)
+
+    def frame(X_, y_):
+        return GameDataFrame(num_samples=len(y_), response=y_,
+                             feature_shards={"g": FeatureShard(X_, d)})
+
+    est = GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {"fixed": CoordinateConfiguration(
+            FixedEffectDataConfiguration("g"),
+            GLMOptimizationConfiguration(
+                OptimizerConfig(max_iterations=50, tolerance=1e-6),
+                L2Regularization, 1.0))})
+
+    results = run_hyperparameter_tuning(
+        est, frame(X, y), frame(Xv, yv), n_iterations=4,
+        mode=HyperparameterTuningMode.BAYESIAN,
+        ranges={"fixed": TuningRange(1e-3, 1e3)}, seed=0)
+    assert len(results) == 4
+    aucs = [r.evaluation["AUC"] for r in results]
+    assert max(aucs) > 0.75
+    # each candidate used a distinct reg weight within range
+    weights = [r.config["fixed"].optimization.regularization_weight
+               for r in results]
+    assert len(set(np.round(weights, 6))) > 1
+    assert all(1e-3 <= w_ <= 1e3 for w_ in weights)

@@ -285,3 +285,64 @@ def test_scores_writer(tmp_path):
     _, recs = read_avro(p)
     assert recs[0]["predictionScore"] == pytest.approx(0.1)
     assert recs[1]["uid"] == "b"
+
+
+def test_avro_empty_array_with_named_type_reference(tmp_path):
+    """Named types referenced by name must resolve even when the defining
+    field's data is empty (review finding: lazy registration crash)."""
+    rec = {"modelId": "m", "modelClass": None, "means": [],
+           "variances": [{"name": "f", "term": "", "value": 0.5}],
+           "lossFunction": None}
+    p = str(tmp_path / "m.avro")
+    write_avro(p, BAYESIAN_LINEAR_MODEL_AVRO, [rec])
+    _, back = read_avro(p)
+    assert back[0]["variances"][0]["value"] == 0.5
+
+
+def test_avro_int_promotes_to_double(tmp_path):
+    recs = [{"uid": None, "label": 1, "features": [],
+             "metadataMap": None, "weight": 2, "offset": None}]
+    p = str(tmp_path / "promote.avro")
+    write_avro(p, TRAINING_EXAMPLE_AVRO, recs)
+    _, back = read_avro(p)
+    assert back[0]["label"] == 1.0 and back[0]["weight"] == 2.0
+
+
+def test_reader_does_not_duplicate_existing_intercept():
+    recs = [{"response": 1.0,
+             "features": [{"name": "(INTERCEPT)", "term": "", "value": 1.0},
+                          {"name": "x", "term": "", "value": 2.0}]}]
+    shards = {"g": FeatureShardConfiguration.of("features")}
+    imaps = build_index_maps(recs, shards)
+    df = records_to_game_dataframe(recs, shards, imaps)
+    idx, val = df.feature_shards["g"].rows[0]
+    assert len(idx) == len(set(idx.tolist())) == 2
+
+
+def test_variance_only_features_survive_roundtrip(tmp_path):
+    """Variances are written with threshold 0 while means use the sparsity
+    threshold; variance-only slots must survive a save/load round trip."""
+    import jax.numpy as jnp
+    im_u = IndexMap.from_keys([feature_key("u", str(j)) for j in range(3)])
+    vocab = EntityVocabulary()
+    vocab.build("userId", ["e0"])
+    proj = np.asarray([[0, 1, 2]], np.int32)
+    coef = jnp.asarray([[0.5, 1e-9, 0.25]])   # slot 1 below threshold
+    var = jnp.asarray([[0.1, 0.2, 0.3]])
+    re = RandomEffectModel(coef, "userId", "u_shard",
+                           TaskType.LOGISTIC_REGRESSION, variances=var)
+    model = GameModel({"per_user": re})
+    out = str(tmp_path / "m")
+    save_game_model(out, model, {"u_shard": im_u}, vocab=vocab,
+                    projections={"per_user": proj},
+                    sparsity_threshold=1e-4)
+    loaded = load_game_model(out, {"u_shard": im_u})
+    lre = loaded.model["per_user"]
+    lproj = loaded.projections["per_user"]
+    got = {int(lproj[0, s]): (float(np.asarray(lre.coefficients)[0, s]),
+                              float(np.asarray(lre.variances)[0, s]))
+           for s in range(lproj.shape[1]) if lproj[0, s] >= 0}
+    assert got[0] == (pytest.approx(0.5), pytest.approx(0.1))
+    # mean fell below threshold but its variance survives
+    assert got[1] == (pytest.approx(0.0), pytest.approx(0.2))
+    assert got[2] == (pytest.approx(0.25), pytest.approx(0.3))
