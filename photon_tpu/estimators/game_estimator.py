@@ -27,6 +27,11 @@ from photon_tpu.evaluation.evaluators import (
     default_evaluator_for_task,
     evaluate,
 )
+from photon_tpu.evaluation.multi import (
+    EvaluationSuite,
+    EvaluatorSpec,
+    parse_evaluator,
+)
 from photon_tpu.game.coordinate import FixedEffectCoordinate, RandomEffectCoordinate
 from photon_tpu.game.dataset import EntityVocabulary, GameDataFrame
 from photon_tpu.game.descent import (
@@ -94,6 +99,7 @@ class GameEstimator:
         locked_coordinates: Sequence[str] = (),
         dtype=jnp.float32,
         mesh=None,
+        variance_computation_type=None,
     ):
         """``mesh``: a `jax.sharding.Mesh` — fixed-effect batches are
         sample-sharded and random-effect entity blocks entity-sharded over
@@ -102,11 +108,18 @@ class GameEstimator:
         self.coordinate_configs = coordinate_configs
         self.update_sequence = update_sequence or list(coordinate_configs.keys())
         self.num_iterations = num_iterations
-        self.evaluators = list(validation_evaluators) if validation_evaluators \
-            else [default_evaluator_for_task(task)]
+        # evaluator names accept the reference's grouped syntax too:
+        # "AUC", "RMSE", "PRECISION@5", "AUC:userId", "PRECISION@1:queryId"
+        self.evaluators: List[EvaluatorSpec] = (
+            [parse_evaluator(e) for e in validation_evaluators]
+            if validation_evaluators
+            else [EvaluatorSpec(default_evaluator_for_task(task))])
         self.locked = frozenset(locked_coordinates)
         self.dtype = dtype
         self.mesh = mesh
+        from photon_tpu.types import VarianceComputationType
+        self.variance_computation_type = (
+            variance_computation_type or VarianceComputationType.NONE)
 
     # -- dataset / coordinate preparation ----------------------------------
 
@@ -122,14 +135,16 @@ class GameEstimator:
                 coordinates[cid] = RandomEffectCoordinate(
                     ds, df.num_samples, cfg.data.random_effect_type,
                     cfg.data.feature_shard_id, self.task, cfg.optimization,
-                    mesh=self.mesh)
+                    mesh=self.mesh,
+                    variance_type=self.variance_computation_type)
             else:
                 shard_id = cfg.data.feature_shard_id
                 batch = df.fixed_effect_batch(shard_id, dtype=np.dtype(self.dtype).type)
                 key = jax.random.PRNGKey(sampling_seed + i)
                 coordinates[cid] = FixedEffectCoordinate(
                     batch, df.feature_shards[shard_id].dim, shard_id, self.task,
-                    cfg.optimization, sampling_key=key, mesh=self.mesh)
+                    cfg.optimization, sampling_key=key, mesh=self.mesh,
+                    variance_type=self.variance_computation_type)
         return coordinates, re_datasets
 
     def _build_scorer(self, df: GameDataFrame, vocab: EntityVocabulary,
@@ -144,14 +159,14 @@ class GameEstimator:
         return scorer
 
     def _validation_fn(self, scorer: GameScorer, df: GameDataFrame):
-        labels = jnp.asarray(df.response, self.dtype)
-        weights = None if df.weights is None else jnp.asarray(df.weights, self.dtype)
-        offsets = None if df.offsets is None else jnp.asarray(df.offsets, self.dtype)
+        suite = EvaluationSuite(self.evaluators, df.response,
+                                offsets=df.offsets, weights=df.weights,
+                                id_tags=df.id_tags, dtype=self.dtype)
 
         def fn(model: GameModel) -> Dict[str, float]:
-            scores = scorer.score(model, offsets=offsets)
-            return {ev.value: float(evaluate(ev, scores, labels, weights))
-                    for ev in self.evaluators}
+            # offsets are applied inside the suite
+            scores = scorer.score(model, offsets=None)
+            return suite.evaluate(scores).evaluations
 
         return fn
 
@@ -256,9 +271,10 @@ class GameTransformer:
         return scorer.score(self.model, offsets=offsets)
 
     def evaluate(self, df: GameDataFrame,
-                 evaluators: Optional[Sequence[EvaluatorType]] = None) -> Dict[str, float]:
+                 evaluators: Optional[Sequence] = None) -> Dict[str, float]:
         scores = self.transform(df)
-        labels = jnp.asarray(df.response, self.estimator.dtype)
-        weights = None if df.weights is None else jnp.asarray(df.weights, self.estimator.dtype)
         evs = list(evaluators) if evaluators else self.estimator.evaluators
-        return {ev.value: float(evaluate(ev, scores, labels, weights)) for ev in evs}
+        # transform() already adds frame offsets to the scores
+        suite = EvaluationSuite(evs, df.response, weights=df.weights,
+                                id_tags=df.id_tags, dtype=self.estimator.dtype)
+        return suite.evaluate(scores).evaluations

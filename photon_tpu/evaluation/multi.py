@@ -1,0 +1,268 @@
+"""Grouped (multi) evaluators as segment ops + the evaluation suite.
+
+Reference: photon-lib evaluation/MultiEvaluator.scala:36 (join scores
+with an id tag, groupByKey, local metric per group, drop non-finite,
+unweighted mean across groups), MultiEvaluatorType.scala:52 ("AUC:idTag",
+"PRECISION@k:idTag" names, ':' splitter), photon-api evaluation/
+AreaUnderROCCurveMultiEvaluator.scala, PrecisionAtKMultiEvaluator,
+EvaluationSuite.scala:33 (cached (label, offset, weight), score join,
+primary evaluator), EvaluationResults.
+
+TPU re-design: the groupByKey shuffle becomes ONE lexsort by (group,
+score) plus segment cumsums — every per-group metric evaluates in a
+single jitted pass with no ragged structure. Group ids are dense ints
+built on the host from the id-tag strings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import re
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.evaluation.evaluators import (
+    EVALUATORS,
+    EvaluatorType,
+    evaluate as evaluate_single,
+)
+
+Array = jax.Array
+
+ID_SPLITTER = ":"  # reference: MultiEvaluatorType.shardedEvaluatorIdNameSplitter
+_PRECISION_RE = re.compile(r"(?i)PRECISION@(\d+)")
+
+
+# ---------------------------------------------------------------------------
+# evaluator specs: "AUC", "RMSE", "PRECISION@5", "AUC:userId", "PRECISION@1:qid"
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EvaluatorSpec:
+    """Parsed evaluator name (single or grouped-by-id-tag)."""
+
+    base: EvaluatorType
+    k: Optional[int] = None          # for PRECISION@k
+    id_tag: Optional[str] = None     # grouped when set
+
+    @property
+    def is_multi(self) -> bool:
+        return self.id_tag is not None
+
+    @property
+    def name(self) -> str:
+        base = f"PRECISION@{self.k}" if self.k is not None else self.base.value
+        return f"{base}{ID_SPLITTER}{self.id_tag}" if self.id_tag else base
+
+    @property
+    def bigger_is_better(self) -> bool:
+        return True if self.k is not None else self.base.bigger_is_better
+
+    def better_than(self, a: float, b: float) -> bool:
+        return a > b if self.bigger_is_better else a < b
+
+
+def parse_evaluator(name: Union[str, EvaluatorType, EvaluatorSpec]) -> EvaluatorSpec:
+    """Reference: EvaluatorType/MultiEvaluatorType name parsing."""
+    if isinstance(name, EvaluatorSpec):
+        return name
+    if isinstance(name, EvaluatorType):
+        return EvaluatorSpec(name)
+    base, _, id_tag = str(name).partition(ID_SPLITTER)
+    m = _PRECISION_RE.fullmatch(base.strip())
+    if m:
+        return EvaluatorSpec(EvaluatorType.AUC, k=int(m.group(1)),
+                             id_tag=id_tag.strip() or None)
+    return EvaluatorSpec(EvaluatorType(base.strip().upper()),
+                         id_tag=id_tag.strip() or None)
+
+
+# ---------------------------------------------------------------------------
+# segment machinery
+# ---------------------------------------------------------------------------
+
+
+def build_group_index(ids: Sequence[str]) -> Tuple[np.ndarray, List[str]]:
+    """Host-side: id-tag strings -> dense group ints + group names."""
+    mapping: Dict[str, int] = {}
+    names: List[str] = []
+    out = np.empty(len(ids), np.int32)
+    for i, s in enumerate(ids):
+        g = mapping.get(s)
+        if g is None:
+            g = len(names)
+            mapping[s] = g
+            names.append(s)
+        out[i] = g
+    return out, names
+
+
+def _segment_layout(groups_sorted: Array, keys_sorted: Array):
+    """(segment starts, tie-run starts, tie-run ends) over sorted arrays."""
+    n = groups_sorted.shape[0]
+    idx = jnp.arange(n)
+    seg_new = jnp.concatenate([jnp.ones(1, bool),
+                               groups_sorted[1:] != groups_sorted[:-1]])
+    run_new = seg_new | jnp.concatenate([jnp.ones(1, bool),
+                                         keys_sorted[1:] != keys_sorted[:-1]])
+    run_start = jax.lax.cummax(jnp.where(run_new, idx, 0))
+    run_last = jnp.concatenate([run_new[1:], jnp.ones(1, bool)])
+    run_end = jnp.flip(jax.lax.cummin(jnp.where(run_last, idx, n - 1)[::-1]))
+    return seg_new, run_start, run_end
+
+
+def _csum_at(cs: Array, j: Array) -> Array:
+    """Inclusive cumsum evaluated at index j, with C(-1) = 0."""
+    return jnp.where(j >= 0, cs[jnp.maximum(j, 0)], 0.0)
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _grouped_auc_values(scores, labels, weights, groups, num_groups: int):
+    """Per-group weighted tie-corrected AUC + validity mask — one lexsort +
+    segment cumsums (replaces the reference's groupByKey + local sorts)."""
+    order = jnp.lexsort((scores, groups))
+    s, g = scores[order], groups[order]
+    y = labels[order] > 0.5
+    w = weights[order]
+
+    seg_new, run_start, run_end = _segment_layout(g, s)
+    idx = jnp.arange(s.shape[0])
+    seg_start = jax.lax.cummax(jnp.where(seg_new, idx, 0))
+
+    neg_w = jnp.where(y, 0.0, w)
+    cneg = jnp.cumsum(neg_w)
+    # negatives strictly below this tie run, within the group
+    below = _csum_at(cneg, run_start - 1) - _csum_at(cneg, seg_start - 1)
+    eq = _csum_at(cneg, run_end) - _csum_at(cneg, run_start - 1)
+
+    pos_w = jnp.where(y, w, 0.0)
+    num = jax.ops.segment_sum(pos_w * (below + 0.5 * eq), g,
+                              num_segments=num_groups)
+    w_pos = jax.ops.segment_sum(pos_w, g, num_segments=num_groups)
+    w_neg = jax.ops.segment_sum(neg_w, g, num_segments=num_groups)
+    valid = (w_pos > 0) & (w_neg > 0)
+    auc_g = num / jnp.maximum(w_pos * w_neg, jnp.finfo(s.dtype).tiny)
+    return auc_g, valid
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5))
+def _grouped_precision_at_k_values(k: int, scores, labels, weights, groups,
+                                   num_groups: int):
+    """Per-group precision@k: rank within group by descending score; only
+    positive-weight rows rank (pads carry weight 0)."""
+    masked = jnp.where(weights > 0, scores, -jnp.inf)
+    order = jnp.lexsort((-masked, groups))
+    g = groups[order]
+    y = labels[order] > 0.5
+    w = weights[order]
+
+    idx = jnp.arange(g.shape[0])
+    seg_new = jnp.concatenate([jnp.ones(1, bool), g[1:] != g[:-1]])
+    seg_start = jax.lax.cummax(jnp.where(seg_new, idx, 0))
+    pos_in_group = idx - seg_start
+
+    hit = (pos_in_group < k) & y & (w > 0)
+    hits = jax.ops.segment_sum(hit.astype(scores.dtype), g,
+                               num_segments=num_groups)
+    count = jax.ops.segment_sum((w > 0).astype(scores.dtype), g,
+                                num_segments=num_groups)
+    return hits / k, count > 0
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _grouped_rmse_values(scores, labels, weights, groups, num_groups: int):
+    se = jax.ops.segment_sum(weights * (scores - labels) ** 2, groups,
+                             num_segments=num_groups)
+    wsum = jax.ops.segment_sum(weights, groups, num_segments=num_groups)
+    return jnp.sqrt(se / jnp.maximum(wsum, 1e-30)), wsum > 0
+
+
+def _masked_mean(values: Array, valid: Array) -> Array:
+    """Unweighted mean over valid groups, dropping non-finite results
+    (reference: MultiEvaluator filters !isInfinite && !isNaN then mean)."""
+    ok = valid & jnp.isfinite(values)
+    return jnp.sum(jnp.where(ok, values, 0.0)) / jnp.maximum(
+        jnp.sum(ok), 1)
+
+
+def evaluate_multi(spec: EvaluatorSpec, scores: Array, labels: Array,
+                   weights: Optional[Array], groups: Array,
+                   num_groups: int) -> Array:
+    w = jnp.ones_like(scores) if weights is None else weights
+    if spec.k is not None:
+        vals, valid = _grouped_precision_at_k_values(
+            spec.k, scores, labels, w, groups, num_groups)
+    elif spec.base == EvaluatorType.AUC:
+        vals, valid = _grouped_auc_values(scores, labels, w, groups, num_groups)
+    elif spec.base == EvaluatorType.RMSE:
+        vals, valid = _grouped_rmse_values(scores, labels, w, groups, num_groups)
+    else:
+        raise ValueError(f"unsupported grouped evaluator: {spec.name}")
+    return _masked_mean(vals, valid)
+
+
+# ---------------------------------------------------------------------------
+# evaluation suite
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EvaluationResults:
+    """Reference: EvaluationResults(evaluations, primaryEvaluator)."""
+
+    evaluations: Dict[str, float]
+    primary: str
+
+    @property
+    def primary_value(self) -> float:
+        return self.evaluations[self.primary]
+
+
+class EvaluationSuite:
+    """Precomputed (labels, offsets, weights, group indexes) for a frame;
+    every `evaluate(scores)` call is then one jitted pass per evaluator
+    (reference: EvaluationSuite.scala:33)."""
+
+    def __init__(self, evaluators: Sequence[Union[str, EvaluatorType, EvaluatorSpec]],
+                 labels: np.ndarray,
+                 offsets: Optional[np.ndarray] = None,
+                 weights: Optional[np.ndarray] = None,
+                 id_tags: Optional[Dict[str, Sequence[str]]] = None,
+                 dtype=jnp.float32):
+        self.specs = [parse_evaluator(e) for e in evaluators]
+        if not self.specs:
+            raise ValueError("evaluator set cannot be empty")
+        self.primary = self.specs[0]
+        self.labels = jnp.asarray(labels, dtype)
+        self.offsets = None if offsets is None else jnp.asarray(offsets, dtype)
+        self.weights = None if weights is None else jnp.asarray(weights, dtype)
+        self._groups: Dict[str, Tuple[Array, int]] = {}
+        for spec in self.specs:
+            if spec.is_multi:
+                if id_tags is None or spec.id_tag not in id_tags:
+                    raise KeyError(
+                        f"evaluator {spec.name} needs id tag {spec.id_tag!r}")
+                if spec.id_tag not in self._groups:
+                    gi, names = build_group_index(id_tags[spec.id_tag])
+                    self._groups[spec.id_tag] = (jnp.asarray(gi), len(names))
+
+    def evaluate(self, scores: Array) -> EvaluationResults:
+        s = scores if self.offsets is None else scores + self.offsets
+        out = {}
+        for spec in self.specs:
+            if spec.is_multi:
+                groups, num_groups = self._groups[spec.id_tag]
+                v = evaluate_multi(spec, s, self.labels, self.weights,
+                                   groups, num_groups)
+            elif spec.k is not None:
+                from photon_tpu.evaluation.evaluators import precision_at_k
+                v = precision_at_k(spec.k, s, self.labels, self.weights)
+            else:
+                v = evaluate_single(spec.base, s, self.labels, self.weights)
+            out[spec.name] = float(v)
+        return EvaluationResults(out, self.primary.name)

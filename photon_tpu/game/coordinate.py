@@ -64,8 +64,12 @@ class FixedEffectCoordinate:
         norm=None,
         sampling_key: Optional[jax.Array] = None,
         mesh=None,
+        variance_type=None,
     ):
         from photon_tpu.ops.normalization import no_normalization
+        from photon_tpu.types import VarianceComputationType
+
+        self.variance_type = variance_type or VarianceComputationType.NONE
 
         self._n_orig = batch.num_samples
         if mesh is not None:
@@ -107,6 +111,16 @@ class FixedEffectCoordinate:
             # read the weight from the coordinate's (possibly sweep-updated)
             # config, not the problem's construction-time copy
             regularization_weight=self.config.regularization_weight)
+        from photon_tpu.types import VarianceComputationType
+        if self.variance_type != VarianceComputationType.NONE:
+            # reference: DistributedOptimizationProblem.run computes
+            # variances on the same (residual-injected) data as the solve
+            var = self.problem.compute_variances(
+                batch, model.coefficients.means, self.variance_type,
+                regularization_weight=self.config.regularization_weight)
+            if var is not None:
+                model = GeneralizedLinearModel(
+                    Coefficients(model.coefficients.means, var), model.task)
         return FixedEffectModel(model, self.feature_shard_id)
 
     def score(self, model: FixedEffectModel) -> Array:
@@ -132,7 +146,11 @@ class RandomEffectCoordinate:
         task: TaskType,
         config: GLMOptimizationConfiguration = GLMOptimizationConfiguration(),
         mesh=None,
+        variance_type=None,
     ):
+        from photon_tpu.types import VarianceComputationType
+
+        self.variance_type = variance_type or VarianceComputationType.NONE
         self._num_entities_orig = dataset.num_entities
         if mesh is not None:
             from photon_tpu.parallel import mesh as M
@@ -201,6 +219,13 @@ class RandomEffectCoordinate:
         l2 = jnp.asarray(self.config.regularization.l2_weight(lam), dtype)
         l1 = jnp.asarray(self.config.regularization.l1_weight(lam), dtype)
         coefs = self._solve_fn(self.dataset, residual_scores, coef0, l2, l1)
+        variances = None
+        from photon_tpu.types import VarianceComputationType
+        if (self.variance_type != VarianceComputationType.NONE
+                and self.objective.loss.has_hessian):
+            variances = self._variance_fn(self.dataset, residual_scores,
+                                          coefs, l2)
+            variances = variances[: self._num_entities_orig]
         # publish the model at the vocabulary's true entity count; mesh
         # padding stays an internal detail of this coordinate
         coefs = coefs[: self._num_entities_orig]
@@ -209,8 +234,51 @@ class RandomEffectCoordinate:
             random_effect_type=self.random_effect_type,
             feature_shard_id=self.feature_shard_id,
             task=self.task,
-            variances=None,
+            variances=variances,
         )
+
+    @functools.cached_property
+    def _variance_fn(self):
+        """vmapped per-entity coefficient variances: SIMPLE = 1/diag(H),
+        FULL = diag(H^-1) via Cholesky — H is each entity's [K, K] Hessian
+        (reference: DistributedOptimizationProblem.computeVariances :82-100
+        applied per entity; Bayesian output of RandomEffectModel)."""
+        from photon_tpu.types import VarianceComputationType
+
+        obj = self.objective
+        vtype = self.variance_type
+
+        def build():
+            def one(feat_idx, feat_val, labels, offsets, weights, coef, l2):
+                batch = DataBatch(F.SparseFeatures(feat_idx, feat_val),
+                                  labels, offsets, weights)
+                hyper = Hyper(l2_weight=l2)
+                has_data = jnp.sum(weights) > 0
+                if vtype == VarianceComputationType.SIMPLE:
+                    d = obj.hessian_diagonal(coef, batch, hyper)
+                    var = 1.0 / jnp.maximum(d, jnp.finfo(d.dtype).tiny)
+                else:
+                    h = obj.hessian_matrix(coef, batch, hyper)
+                    eye = jnp.eye(h.shape[0], dtype=h.dtype)
+                    chol = jax.scipy.linalg.cho_factor(h)
+                    var = jnp.diag(jax.scipy.linalg.cho_solve(chol, eye))
+                return jnp.where(has_data, var, 0.0)
+
+            @jax.jit
+            def variance_all(ds: RandomEffectDataset, residual_flat,
+                             coef_block, l2):
+                offsets = ds.offsets
+                if residual_flat is not None:
+                    res = residual_flat.at[ds.sample_rows].get(
+                        mode="fill", fill_value=0.0)
+                    offsets = offsets + res
+                return jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0, None))(
+                    ds.features.indices, ds.features.values,
+                    ds.labels, offsets, ds.weights, coef_block, l2)
+
+            return variance_all
+
+        return jitcache.get_or_build(("re_variance", self.task, vtype), build)
 
     def _pad_entity_rows(self, coef_block: Array) -> Array:
         """Match a model's entity rows to this coordinate's (possibly

@@ -97,7 +97,7 @@ class GameEstimatorEvaluationFunction:
 
     def _value_of(self, result) -> float:
         primary = self.estimator.evaluators[0]
-        v = result.evaluation[primary.value]
+        v = result.evaluation[primary.name]
         return -v if primary.bigger_is_better else v
 
     def convert_observations(self, results: Sequence) -> List[Observation]:

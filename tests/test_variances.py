@@ -1,0 +1,149 @@
+"""Coefficient-variance tests vs dense numpy oracles.
+
+Reference semantics: SIMPLE = 1/diag(H), FULL = diag(H^-1)
+(DistributedOptimizationProblem.scala:82-100); variances flow into the
+Bayesian model output (BayesianLinearModelAvro) for both fixed and
+random effects, and round-trip through model IO (VERDICT item 7).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from photon_tpu.estimators.game_estimator import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    GameEstimator,
+)
+from photon_tpu.function.objective import L2Regularization
+from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+from photon_tpu.optim.problem import (
+    GLMOptimizationConfiguration,
+    OptimizerConfig,
+)
+from photon_tpu.types import TaskType, VarianceComputationType
+
+
+def _logistic_hessian(X, w, coef, l2):
+    """Dense oracle: H = X^T diag(w sigma (1-sigma)) X + l2 I."""
+    m = X @ coef
+    s = 1.0 / (1.0 + np.exp(-m))
+    d = w * s * (1 - s)
+    return X.T @ (d[:, None] * X) + l2 * np.eye(X.shape[1])
+
+
+def _glmix_frame(seed=0, n=300, d=6, users=8, d_user=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    Xu = rng.normal(size=(n, d_user))
+    u = rng.integers(0, users, size=n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ rng.normal(size=d))))).astype(float)
+    rows_u = [(np.arange(d_user, dtype=np.int32), Xu[i]) for i in range(n)]
+    df = GameDataFrame(
+        num_samples=n, response=y,
+        feature_shards={"g": FeatureShard(X, d),
+                        "u": FeatureShard(rows_u, d_user)},
+        id_tags={"userId": [f"u{i}" for i in u]})
+    return df, X, Xu, u, y
+
+
+@pytest.mark.parametrize("vtype,oracle", [
+    (VarianceComputationType.SIMPLE,
+     lambda H: 1.0 / np.diag(H)),
+    (VarianceComputationType.FULL,
+     lambda H: np.diag(np.linalg.inv(H))),
+])
+def test_fixed_effect_variances_match_dense_oracle(vtype, oracle):
+    df, X, _, _, y = _glmix_frame()
+    lam = 0.5
+    est = GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {"fixed": CoordinateConfiguration(
+            FixedEffectDataConfiguration("g"),
+            GLMOptimizationConfiguration(
+                OptimizerConfig(max_iterations=100, tolerance=1e-9),
+                L2Regularization, lam))},
+        variance_computation_type=vtype, dtype=jnp.float64)
+    res = est.fit(df)
+    coefs = res[-1].model["fixed"].model.coefficients
+    assert coefs.variances is not None
+    H = _logistic_hessian(X, np.ones(len(y)), np.asarray(coefs.means), lam)
+    np.testing.assert_allclose(np.asarray(coefs.variances), oracle(H),
+                               rtol=1e-5)
+
+
+def test_random_effect_variances_match_per_entity_oracle():
+    df, X, Xu, u, y = _glmix_frame()
+    lam = 1.0
+    est = GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {"per_user": CoordinateConfiguration(
+            RandomEffectDataConfiguration("userId", "u"),
+            GLMOptimizationConfiguration(
+                OptimizerConfig(max_iterations=100, tolerance=1e-9),
+                L2Regularization, lam))},
+        variance_computation_type=VarianceComputationType.SIMPLE,
+        dtype=jnp.float64)
+    res = est.fit(df)
+    re = res[-1].model["per_user"]
+    assert re.variances is not None
+    proj = np.asarray(est._re_datasets["per_user"].projection)
+    names = est._vocab.names("userId")  # entity row order is first-seen
+    for e in range(re.num_entities):
+        mask = u == int(names[e][1:])
+        # entity-local columns in projected order
+        cols = [c for c in proj[e] if c >= 0]
+        Xe = Xu[mask][:, cols]
+        coef_e = np.asarray(re.coefficients[e])[: len(cols)]
+        He = _logistic_hessian(Xe, np.ones(mask.sum()), coef_e, lam)
+        np.testing.assert_allclose(np.asarray(re.variances[e])[: len(cols)],
+                                   1.0 / np.diag(He), rtol=1e-5)
+
+
+def test_variances_roundtrip_through_model_io(tmp_path):
+    from photon_tpu.io import IndexMap, feature_key, load_game_model, save_game_model
+
+    df, X, Xu, u, y = _glmix_frame()
+    est = GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {"fixed": CoordinateConfiguration(
+            FixedEffectDataConfiguration("g"),
+            GLMOptimizationConfiguration(
+                OptimizerConfig(max_iterations=60, tolerance=1e-8),
+                L2Regularization, 1.0)),
+         "per_user": CoordinateConfiguration(
+            RandomEffectDataConfiguration("userId", "u"),
+            GLMOptimizationConfiguration(
+                OptimizerConfig(max_iterations=60, tolerance=1e-8),
+                L2Regularization, 1.0))},
+        variance_computation_type=VarianceComputationType.SIMPLE,
+        dtype=jnp.float64)
+    res = est.fit(df)
+    model = res[-1].model
+    imaps = {"g": IndexMap.from_keys([feature_key("g", str(j)) for j in range(6)]),
+             "u": IndexMap.from_keys([feature_key("u", str(j)) for j in range(3)])}
+    out = str(tmp_path / "m")
+    save_game_model(out, model, imaps, vocab=est._vocab,
+                    projections={cid: np.asarray(ds.projection)
+                                 for cid, ds in est._re_datasets.items()},
+                    sparsity_threshold=0.0)
+    loaded = load_game_model(out, imaps, dtype=np.float64)
+
+    fe_var = np.asarray(model["fixed"].model.coefficients.variances)
+    lfe_var = np.asarray(loaded.model["fixed"].model.coefficients.variances)
+    np.testing.assert_allclose(lfe_var, fe_var, rtol=1e-12)
+
+    lre = loaded.model["per_user"]
+    assert lre.variances is not None
+    # compare per-entity variance by global column
+    proj = np.asarray(est._re_datasets["per_user"].projection)
+    lproj = loaded.projections["per_user"]
+    re = model["per_user"]
+    for e in range(re.num_entities):
+        want = {int(proj[e, s]): float(np.asarray(re.variances)[e, s])
+                for s in range(proj.shape[1]) if proj[e, s] >= 0}
+        got = {int(lproj[e, s]): float(np.asarray(lre.variances)[e, s])
+               for s in range(lproj.shape[1]) if lproj[e, s] >= 0}
+        for col, v in want.items():
+            assert got.get(col, 0.0) == pytest.approx(v, rel=1e-9)
