@@ -189,18 +189,26 @@ class RandomEffectCoordinate:
                 return lbfgs.minimize(vg, x0, config=solver_cfg).coef
 
             # the dataset enters as a pytree argument, never a closure (a
-            # closed-over array would be baked into the HLO as a constant)
+            # closed-over array would be baked into the HLO as a constant);
+            # the Python loop over size buckets unrolls into one program
             @jax.jit
             def solve_all(ds: RandomEffectDataset, residual_flat: Optional[Array],
                           coef0: Array, l2: Array, l1: Array) -> Array:
-                offsets = ds.offsets
-                if residual_flat is not None:
-                    # gather residuals by flat row; pad rows index == n -> fill 0
-                    res = residual_flat.at[ds.sample_rows].get(mode="fill", fill_value=0.0)
-                    offsets = offsets + res
-                return jax.vmap(solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None))(
-                    ds.features.indices, ds.features.values,
-                    ds.labels, offsets, ds.weights, coef0, l2, l1)
+                out = coef0  # entities with no active data keep warm start
+                for blk in ds.blocks:
+                    offsets = blk.offsets
+                    if residual_flat is not None:
+                        # gather residuals by flat row; pad rows -> fill 0
+                        res = residual_flat.at[blk.sample_rows].get(
+                            mode="fill", fill_value=0.0)
+                        offsets = offsets + res
+                    x0 = coef0.at[blk.entity_rows].get(mode="fill", fill_value=0.0)
+                    solved = jax.vmap(solve_one,
+                                      in_axes=(0, 0, 0, 0, 0, 0, None, None))(
+                        blk.features.indices, blk.features.values,
+                        blk.labels, offsets, blk.weights, x0, l2, l1)
+                    out = out.at[blk.entity_rows].set(solved, mode="drop")
+                return out
 
             return solve_all
 
@@ -211,7 +219,8 @@ class RandomEffectCoordinate:
         self, prev: Optional[RandomEffectModel], residual_scores: Optional[Array]
     ) -> RandomEffectModel:
         ds = self.dataset
-        dtype = ds.labels.dtype
+        dtype = (prev.coefficients.dtype if prev is not None
+                 else (ds.blocks[0].labels.dtype if ds.blocks else jnp.float32))
         coef0 = (prev.coefficients if prev is not None
                  else jnp.zeros((ds.num_entities, ds.projected_dim), dtype))
         coef0 = self._pad_entity_rows(coef0)
@@ -267,14 +276,20 @@ class RandomEffectCoordinate:
             @jax.jit
             def variance_all(ds: RandomEffectDataset, residual_flat,
                              coef_block, l2):
-                offsets = ds.offsets
-                if residual_flat is not None:
-                    res = residual_flat.at[ds.sample_rows].get(
+                out = jnp.zeros_like(coef_block)
+                for blk in ds.blocks:
+                    offsets = blk.offsets
+                    if residual_flat is not None:
+                        res = residual_flat.at[blk.sample_rows].get(
+                            mode="fill", fill_value=0.0)
+                        offsets = offsets + res
+                    coefs_b = coef_block.at[blk.entity_rows].get(
                         mode="fill", fill_value=0.0)
-                    offsets = offsets + res
-                return jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0, None))(
-                    ds.features.indices, ds.features.values,
-                    ds.labels, offsets, ds.weights, coef_block, l2)
+                    var_b = jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0, None))(
+                        blk.features.indices, blk.features.values,
+                        blk.labels, offsets, blk.weights, coefs_b, l2)
+                    out = out.at[blk.entity_rows].set(var_b, mode="drop")
+                return out
 
             return variance_all
 
@@ -306,15 +321,17 @@ class RandomEffectCoordinate:
 
 def _re_score_builder(n: int):
     def score(ds: RandomEffectDataset, coef_block: Array) -> Array:
-        # active: per-entity margins, scattered to flat rows
-        margins = jnp.sum(
-            ds.features.values
-            * jax.vmap(lambda c, i: c[i])(coef_block, ds.features.indices),
-            axis=-1,
-        )
         flat = jnp.zeros((n,), coef_block.dtype)
-        flat = flat.at[ds.sample_rows.ravel()].add(
-            margins.ravel(), mode="drop")
+        # active blocks: per-entity margins, scattered to flat rows
+        for blk in ds.blocks:
+            rows = coef_block.at[blk.entity_rows].get(mode="fill", fill_value=0.0)
+            margins = jnp.sum(
+                blk.features.values
+                * jax.vmap(lambda c, i: c[i])(rows, blk.features.indices),
+                axis=-1,
+            )
+            flat = flat.at[blk.sample_rows.ravel()].add(
+                margins.ravel(), mode="drop")
         # passive: gather entity coef rows (out-of-range entity -> 0)
         pcoef = coef_block.at[ds.passive_entity].get(mode="fill", fill_value=0.0)
         pmargin = jnp.sum(ds.passive_features.values

@@ -1,4 +1,4 @@
-"""Random-effect datasets: entity-blocked, padded, projected.
+"""Random-effect datasets: entity-blocked, size-bucketed, projected.
 
 Reference: photon-api data/RandomEffectDataset.scala (activeData grouped
 per-entity :46-55; build pipeline :207-340 — bounded groupBy via
@@ -9,20 +9,21 @@ data/RandomEffectDataConfiguration (:68), projector/IndexMapProjectorRDD
 .scala:19,24,156 (per-entity compact reindex of observed features),
 data/MinHeapWithFixedCapacity.scala:29.
 
-TPU re-design: the groupByKey shuffle becomes ingest-time numpy grouping;
-per-entity index-map projection becomes a static [E, D_loc] gather table;
-active data is ONE padded block ([E, S] samples, ELL features in local
-slots) sharded over the mesh's entity axis; passive (score-only) samples
-are a flat gather-scored array. Reservoir capping orders samples by
-splitmix64(uid) — deterministic under recomputation exactly like the
-reference's byteswap64 trick, without needing it for fault tolerance
-(pure functions recompute identically anyway).
+TPU re-design: the groupByKey shuffle becomes fully-vectorized numpy
+grouping over a CSR view of the shard (no per-sample Python loops);
+entities are bucketed by power-of-two active-sample count into a few
+padded ELL blocks — a MovieLens-style power-law entity distribution no
+longer pays S_max padding for every entity (SURVEY §7 risk (a)).
+Per-entity index-map projection is a static [E, D_loc] gather table;
+passive (score-only) samples are a flat gather-scored array. Reservoir
+capping orders samples by splitmix64(uid) — deterministic under
+recomputation exactly like the reference's byteswap64 trick.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, NamedTuple, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,33 +48,56 @@ class RandomEffectDataConfiguration:
     keep_passive_data: bool = True
 
 
-class RandomEffectDataset(NamedTuple):
-    """Device-resident entity blocks (all pads carry weight 0)."""
+class EntityBlock(NamedTuple):
+    """One size bucket of entities, padded to [E_b, S_b] / [E_b, S_b, K_b].
+    All pads carry weight 0; ``entity_rows`` maps block rows to global
+    entity rows (out-of-range = pad row)."""
 
-    # active block
-    features: F.SparseFeatures        # indices/values [E, S, K] in LOCAL slots
-    labels: Array                     # [E, S]
-    offsets: Array                    # [E, S]
-    weights: Array                    # [E, S] (0 on pads)
-    sample_rows: Array                # [E, S] int32 row in flat frame (n on pads)
-    # passive (score-only) samples
-    passive_features: F.SparseFeatures  # [P, K] local slots
-    passive_entity: Array               # [P] int32 entity row (E on pads)
-    passive_rows: Array                 # [P] int32 flat row (n on pads)
-    # projection table: local slot -> global feature index (-1 unused)
-    projection: Array                 # [E, D_loc] int32
+    features: F.SparseFeatures        # indices/values [E_b, S_b, K_b] LOCAL slots
+    labels: Array                     # [E_b, S_b]
+    offsets: Array                    # [E_b, S_b]
+    weights: Array                    # [E_b, S_b] (0 on pads)
+    sample_rows: Array                # [E_b, S_b] int32 row in flat frame (n on pads)
+    entity_rows: Array                # [E_b] int32 global entity row
 
     @property
-    def num_entities(self) -> int:
+    def num_rows(self) -> int:
         return self.labels.shape[0]
 
     @property
     def max_samples(self) -> int:
         return self.labels.shape[1]
 
+
+class RandomEffectDataset(NamedTuple):
+    """Device-resident bucketed entity blocks + passive split + projection."""
+
+    blocks: Tuple[EntityBlock, ...]
+    # passive (score-only) samples, in LOCAL slots
+    passive_features: F.SparseFeatures  # [P, K]
+    passive_entity: Array               # [P] int32 global entity row (E on pads)
+    passive_rows: Array                 # [P] int32 flat row (n on pads)
+    # projection table: local slot -> global feature index (-1 unused)
+    projection: Array                 # [E, D_loc] int32
+
+    @property
+    def num_entities(self) -> int:
+        return self.projection.shape[0]
+
+    @property
+    def max_samples(self) -> int:
+        return max((b.max_samples for b in self.blocks), default=0)
+
     @property
     def projected_dim(self) -> int:
         return self.projection.shape[1]
+
+    def padding_waste(self) -> float:
+        """(padded cells) / (real cells) over sample slots — the bucketing
+        quality metric (SURVEY §7 risk (a))."""
+        padded = sum(b.labels.size for b in self.blocks)
+        real = sum(int(jnp.sum(b.weights > 0)) for b in self.blocks)
+        return padded / max(real, 1)
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:
@@ -85,33 +109,22 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
     return z ^ (z >> np.uint64(31))
 
 
-def _pearson_scores(rows, labels, dim) -> np.ndarray:
-    """|Pearson corr| per observed global feature within one entity
-    (reference: LocalDataset.computePearsonCorrelationScore :122).
-    Constant features get score ~0 except the intercept-like all-constant
-    column, which the reference keeps (score 1)."""
-    n = len(rows)
-    sums = np.zeros(dim)
-    sq_sums = np.zeros(dim)
-    xy = np.zeros(dim)
-    seen = np.zeros(dim, bool)
-    ly = labels - labels.mean()
-    for i, (idx, val) in enumerate(rows):
-        sums[idx] += val
-        sq_sums[idx] += val * val
-        xy[idx] += val * ly[i]
-        seen[idx] = True
-    mean = sums / n
-    var = sq_sums / n - mean * mean
-    label_sd = labels.std()
-    with np.errstate(invalid="ignore", divide="ignore"):
-        corr = np.abs(xy / n) / np.sqrt(np.maximum(var, 0)) / max(label_sd, 1e-12)
-    corr[~np.isfinite(corr)] = 0.0
-    # constant nonzero column across all samples (intercept) -> keep
-    is_const = seen & (var <= 1e-12) & (np.abs(mean) > 0)
-    corr[is_const] = 1.0
-    corr[~seen] = -1.0
-    return corr
+def _csr_of(rows) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """List[(idx, val)] -> (indptr [n+1], cols, vals)."""
+    nnz = np.fromiter((len(r[0]) for r in rows), np.int64, len(rows))
+    indptr = np.concatenate([[0], np.cumsum(nnz)])
+    if len(rows):
+        cols = np.concatenate([np.asarray(r[0], np.int64) for r in rows])
+        vals = np.concatenate([np.asarray(r[1], np.float64) for r in rows])
+    else:
+        cols = np.zeros(0, np.int64)
+        vals = np.zeros(0)
+    return indptr, cols, vals
+
+
+def _bucket_of(sizes: np.ndarray) -> np.ndarray:
+    """Power-of-two size bucket id (sizes >= 1)."""
+    return np.ceil(np.log2(np.maximum(sizes, 1))).astype(np.int64)
 
 
 def build_random_effect_dataset(
@@ -121,137 +134,235 @@ def build_random_effect_dataset(
     dtype=np.float32,
     scores_offsets: Optional[np.ndarray] = None,
 ) -> RandomEffectDataset:
-    """Ingest-time grouping/capping/projection (the reference's whole
-    RandomEffectDataset build pipeline, minus the shuffles)."""
+    """Fully-vectorized ingest: grouping, deterministic reservoir capping,
+    Pearson feature selection, per-entity projection, bucketed ELL fill,
+    passive split — no per-sample Python loops."""
     re_type = config.random_effect_type
     shard = df.feature_shards[config.feature_shard_id]
     assert not shard.is_dense, "random-effect shards use sparse rows"
-    rows = shard.rows
     n = df.num_samples
+    D = shard.dim
 
-    entity_idx = vocab.build(re_type, df.id_tags[re_type])
-    base_offsets = df.offsets if df.offsets is not None else np.zeros(n)
-    if scores_offsets is not None:
-        base_offsets = base_offsets + scores_offsets
-    weights = df.weights if df.weights is not None else np.ones(n)
-
-    # group sample row-ids per entity
-    order = np.argsort(entity_idx, kind="stable")
-    groups: Dict[int, np.ndarray] = {}
-    sorted_e = entity_idx[order]
-    bounds = np.searchsorted(sorted_e, np.arange(vocab.size(re_type) + 1))
-    for e in range(vocab.size(re_type)):
-        groups[e] = order[bounds[e]:bounds[e + 1]]
-
+    entity_idx = vocab.build(re_type, df.id_tags[re_type]).astype(np.int64)
     E = vocab.size(re_type)
-    active: Dict[int, np.ndarray] = {}
-    passive: List[Tuple[int, int]] = []  # (entity, row)
-    lower = config.active_data_lower_bound
-    upper = config.active_data_upper_bound
-    for e in range(E):
-        g = groups[e]
-        if lower is not None and len(g) < lower:
-            # below lower bound: all samples become passive (score-only);
-            # the entity keeps a zero model (reference drops the entity
-            # from training, RandomEffectDataset.scala:319-340)
-            passive.extend((e, int(r)) for r in g)
-            active[e] = g[:0]
-            continue
-        if upper is not None and len(g) > upper:
-            keys = _splitmix64(g.astype(np.uint64))
-            keep = g[np.argsort(keys, kind="stable")[:upper]]
-            kept_set = set(keep.tolist())
-            active[e] = keep
-            if config.keep_passive_data:
-                passive.extend((e, int(r)) for r in g if int(r) not in kept_set)
-        else:
-            active[e] = g
-
-    # per-entity feature selection + local projection
-    projections: List[np.ndarray] = []
-    local_maps: List[Dict[int, int]] = []
-    d_loc_max = 1
-    for e in range(E):
-        g = active[e]
-        observed: Dict[int, None] = {}
-        for r in g:
-            for j in rows[r][0]:
-                observed.setdefault(int(j), None)
-        obs = np.asarray(list(observed.keys()), np.int64)
-        ratio = config.features_to_samples_ratio
-        if ratio is not None and len(g) > 0 and len(obs) > ratio * len(g):
-            k = max(int(ratio * len(g)), 1)
-            scores = _pearson_scores([rows[r] for r in g],
-                                     np.asarray(df.response, np.float64)[g],
-                                     shard.dim)
-            top = np.argsort(-scores[obs], kind="stable")[:k]
-            obs = obs[np.sort(top)]
-        lm = {int(j): s for s, j in enumerate(obs)}
-        local_maps.append(lm)
-        projections.append(obs)
-        d_loc_max = max(d_loc_max, len(obs))
-
-    S = max((len(active[e]) for e in range(E)), default=1) or 1
-    K = min(shard.max_nnz(), d_loc_max) or 1
-
-    feat_idx = np.zeros((E, S, K), np.int32)
-    feat_val = np.zeros((E, S, K), dtype)
-    labels_b = np.zeros((E, S), dtype)
-    offsets_b = np.zeros((E, S), dtype)
-    weights_b = np.zeros((E, S), dtype)
-    rows_b = np.full((E, S), n, np.int32)
+    base_offsets = np.zeros(n) if df.offsets is None else np.asarray(df.offsets, np.float64)
+    if scores_offsets is not None:
+        base_offsets = base_offsets + np.asarray(scores_offsets, np.float64)
+    weights = np.ones(n) if df.weights is None else np.asarray(df.weights, np.float64)
     resp = np.asarray(df.response, np.float64)
 
-    for e in range(E):
-        lm = local_maps[e]
-        for s, r in enumerate(active[e]):
-            idx, val = rows[r]
-            kk = 0
-            for j, v in zip(idx, val):
-                slot = lm.get(int(j))
-                if slot is not None:
-                    feat_idx[e, s, kk] = slot
-                    feat_val[e, s, kk] = v
-                    kk += 1
-            labels_b[e, s] = resp[r]
-            offsets_b[e, s] = base_offsets[r]
-            weights_b[e, s] = weights[r]
-            rows_b[e, s] = r
+    indptr, cols, vals = _csr_of(shard.rows)
+    nnz = np.diff(indptr)
 
-    proj = np.full((E, d_loc_max), -1, np.int32)
-    for e in range(E):
-        proj[e, : len(projections[e])] = projections[e]
+    # -- deterministic ordering within entities + active/passive split -------
+    counts = np.bincount(entity_idx, minlength=E)
+    keys = _splitmix64(np.arange(n, dtype=np.uint64))
+    order = np.lexsort((keys, entity_idx))           # by (entity, hash)
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    pos = np.arange(n) - np.repeat(starts[:-1], counts)  # rank within entity
 
-    # passive block
-    P = max(len(passive), 1)
-    p_idx = np.zeros((P, K), np.int32)
-    p_val = np.zeros((P, K), dtype)
+    e_sorted = entity_idx[order]
+    active_sorted = np.ones(n, bool)
+    if config.active_data_lower_bound is not None:
+        active_sorted &= counts[e_sorted] >= config.active_data_lower_bound
+    if config.active_data_upper_bound is not None:
+        active_sorted &= pos < config.active_data_upper_bound
+    passive_sorted = ~active_sorted
+    if config.active_data_upper_bound is not None and not config.keep_passive_data:
+        # over-cap samples are dropped entirely; below-lower-bound samples
+        # stay passive (they are scored, just never trained on)
+        over_cap = pos >= config.active_data_upper_bound
+        if config.active_data_lower_bound is not None:
+            over_cap &= counts[e_sorted] >= config.active_data_lower_bound
+        passive_sorted &= ~over_cap
+
+    active = np.zeros(n, bool)
+    active[order] = active_sorted
+    passive = np.zeros(n, bool)
+    passive[order] = passive_sorted
+    act_counts = np.bincount(entity_idx[active], minlength=E)
+
+    # -- observed (entity, feature) pairs over ACTIVE data -------------------
+    s_nz = np.repeat(np.arange(n), nnz)              # sample id per nonzero
+    keep_nz = active[s_nz]
+    e_nz = entity_idx[s_nz]
+    pair = e_nz * D + cols                            # int64 composite key
+    uniq = np.unique(pair[keep_nz]) if keep_nz.any() else np.zeros(0, np.int64)
+
+    # -- optional Pearson feature selection (reference: LocalDataset:122) ----
+    if config.features_to_samples_ratio is not None and len(uniq):
+        ratio = config.features_to_samples_ratio
+        k_per_entity = np.maximum((ratio * act_counts).astype(np.int64), 1)
+        scores = _pearson_scores_vectorized(
+            uniq, pair, keep_nz, vals, s_nz, entity_idx, resp, weights,
+            active, E, D)
+        u_e = uniq // D
+        sel_order = np.lexsort((-scores, u_e))
+        u_starts = np.searchsorted(u_e[sel_order], np.arange(E))
+        sel_pos = np.arange(len(uniq)) - u_starts[u_e[sel_order]]
+        need_cap = k_per_entity[u_e[sel_order]]
+        keep_pair = np.zeros(len(uniq), bool)
+        keep_pair[sel_order[sel_pos < need_cap]] = True
+        # entities whose feature count is within bound keep everything
+        feat_counts = np.bincount(u_e, minlength=E)
+        within = feat_counts[u_e] <= np.maximum(
+            (ratio * act_counts[u_e]).astype(np.int64), 1)
+        keep_pair |= within
+        uniq = uniq[keep_pair]
+
+    # -- projection table ----------------------------------------------------
+    u_e = uniq // D
+    u_f = uniq % D
+    d_loc_per_entity = np.bincount(u_e, minlength=E) if len(uniq) else np.zeros(E, np.int64)
+    D_loc = max(int(d_loc_per_entity.max()) if E else 1, 1)
+    u_starts = np.searchsorted(u_e, np.arange(E + 1))
+    slot_of_pair = np.arange(len(uniq)) - u_starts[u_e]
+    projection = np.full((E, D_loc), -1, np.int32)
+    if len(uniq):
+        projection[u_e, slot_of_pair] = u_f.astype(np.int32)
+
+    # -- per-nonzero local slots (kept nonzeros only) ------------------------
+    rank = np.searchsorted(uniq, pair) if len(uniq) else np.zeros(len(pair), np.int64)
+    rank = np.minimum(rank, max(len(uniq) - 1, 0))
+    kept_nz_mask = np.zeros(len(pair), bool)
+    if len(uniq):
+        kept_nz_mask = uniq[rank] == pair
+    slot_nz = slot_of_pair[rank] if len(uniq) else np.zeros(len(pair), np.int64)
+
+    # position of each kept nonzero within its sample
+    def _slot_positions(mask: np.ndarray) -> np.ndarray:
+        if not len(pair):
+            return np.zeros(0, np.int64)
+        kept_i = mask.astype(np.int64)
+        c = np.cumsum(kept_i)
+        excl = c - kept_i
+        # indptr may equal total_nnz for trailing empty rows; those repeat
+        # zero times, so clamp the index to keep the gather in range
+        base = np.repeat(excl[np.minimum(indptr[:-1], len(excl) - 1)], nnz)
+        return excl - base
+
+    # -- bucketed active blocks ---------------------------------------------
+    has_active = act_counts > 0
+    bucket_id = np.where(has_active, _bucket_of(act_counts), -1)
+    blocks: List[EntityBlock] = []
+
+    # active samples sorted by (entity, hash) and within cap
+    act_idx_sorted = order[active_sorted]             # flat rows, grouped
+    act_pos = pos[active_sorted]                      # rank within entity
+    act_entity = entity_idx[act_idx_sorted]
+
+    k_nz_pos_all = _slot_positions(kept_nz_mask & active[s_nz])
+
+    for b in np.unique(bucket_id[bucket_id >= 0]):
+        ents = np.flatnonzero(bucket_id == b)         # global entity rows
+        E_b = len(ents)
+        S_b = int(act_counts[ents].max())
+        # block row per global entity
+        row_of_entity = np.full(E, -1, np.int64)
+        row_of_entity[ents] = np.arange(E_b)
+
+        in_b = row_of_entity[act_entity] >= 0
+        rows_flat = act_idx_sorted[in_b]              # flat sample rows
+        r_idx = row_of_entity[act_entity[in_b]]
+        c_idx = act_pos[in_b]
+
+        labels_b = np.zeros((E_b, S_b), dtype)
+        offsets_b = np.zeros((E_b, S_b), dtype)
+        weights_b = np.zeros((E_b, S_b), dtype)
+        rows_b = np.full((E_b, S_b), n, np.int32)
+        labels_b[r_idx, c_idx] = resp[rows_flat]
+        offsets_b[r_idx, c_idx] = base_offsets[rows_flat]
+        weights_b[r_idx, c_idx] = weights[rows_flat]
+        rows_b[r_idx, c_idx] = rows_flat
+
+        # ELL features: nonzeros of this bucket's active samples
+        nz_mask = kept_nz_mask & active[s_nz] & (row_of_entity[e_nz] >= 0)
+        nz_sample = s_nz[nz_mask]
+        nz_r = row_of_entity[e_nz[nz_mask]]
+        # column of the sample within the block
+        pos_of_sample = np.full(n, -1, np.int64)
+        pos_of_sample[act_idx_sorted[in_b]] = c_idx
+        nz_c = pos_of_sample[nz_sample]
+        nz_k = k_nz_pos_all[nz_mask]
+        K_b = max(int(nz_k.max()) + 1 if len(nz_k) else 1, 1)
+
+        f_idx = np.zeros((E_b, S_b, K_b), np.int32)
+        f_val = np.zeros((E_b, S_b, K_b), dtype)
+        f_idx[nz_r, nz_c, nz_k] = slot_nz[nz_mask].astype(np.int32)
+        f_val[nz_r, nz_c, nz_k] = vals[nz_mask]
+
+        blocks.append(EntityBlock(
+            features=F.SparseFeatures(jnp.asarray(f_idx), jnp.asarray(f_val)),
+            labels=jnp.asarray(labels_b),
+            offsets=jnp.asarray(offsets_b),
+            weights=jnp.asarray(weights_b),
+            sample_rows=jnp.asarray(rows_b),
+            entity_rows=jnp.asarray(ents.astype(np.int32)),
+        ))
+
+    # -- passive block (projected through each entity's local map) -----------
+    pas_rows = np.flatnonzero(passive)
+    P = max(len(pas_rows), 1)
+    pas_nz_mask = kept_nz_mask & passive[s_nz]
+    pas_k = _slot_positions(pas_nz_mask)
+    K_p = max(int(pas_k[pas_nz_mask].max()) + 1 if pas_nz_mask.any() else 1, 1)
+    p_idx = np.zeros((P, K_p), np.int32)
+    p_val = np.zeros((P, K_p), dtype)
     p_entity = np.full(P, E, np.int32)
     p_rows = np.full(P, n, np.int32)
-    for p, (e, r) in enumerate(passive):
-        lm = local_maps[e]
-        idx, val = rows[r]
-        kk = 0
-        for j, v in zip(idx, val):
-            slot = lm.get(int(j))
-            if slot is not None and kk < K:
-                p_idx[p, kk] = slot
-                p_val[p, kk] = v
-                kk += 1
-        p_entity[p] = e
-        p_rows[p] = r
+    if len(pas_rows):
+        row_rank = np.full(n, -1, np.int64)
+        row_rank[pas_rows] = np.arange(len(pas_rows))
+        p_entity[: len(pas_rows)] = entity_idx[pas_rows]
+        p_rows[: len(pas_rows)] = pas_rows
+        sel = pas_nz_mask
+        p_idx[row_rank[s_nz[sel]], pas_k[sel]] = slot_nz[sel].astype(np.int32)
+        p_val[row_rank[s_nz[sel]], pas_k[sel]] = vals[sel]
 
     return RandomEffectDataset(
-        features=F.SparseFeatures(jnp.asarray(feat_idx), jnp.asarray(feat_val)),
-        labels=jnp.asarray(labels_b),
-        offsets=jnp.asarray(offsets_b),
-        weights=jnp.asarray(weights_b),
-        sample_rows=jnp.asarray(rows_b),
+        blocks=tuple(blocks),
         passive_features=F.SparseFeatures(jnp.asarray(p_idx), jnp.asarray(p_val)),
         passive_entity=jnp.asarray(p_entity),
         passive_rows=jnp.asarray(p_rows),
-        projection=jnp.asarray(proj),
+        projection=jnp.asarray(projection),
     )
+
+
+def _pearson_scores_vectorized(uniq, pair, keep_nz, vals, s_nz, entity_idx,
+                               resp, weights, active, E, D) -> np.ndarray:
+    """|Pearson corr(feature, label)| per observed (entity, feature) pair
+    over active samples (reference: LocalDataset.computePearsonCorrelation
+    Score :122; constant nonzero columns — intercepts — score 1)."""
+    act_counts = np.bincount(entity_idx[active], minlength=E).astype(np.float64)
+    # per-entity label stats over active samples
+    lab_sum = np.bincount(entity_idx[active], weights=resp[active], minlength=E)
+    lab_sq = np.bincount(entity_idx[active], weights=resp[active] ** 2, minlength=E)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        lab_mean = lab_sum / act_counts
+        lab_var = lab_sq / act_counts - lab_mean ** 2
+    lab_sd = np.sqrt(np.maximum(lab_var, 0))
+
+    m = keep_nz
+    rank = np.searchsorted(uniq, pair[m])
+    v = vals[m]
+    y = resp[s_nz[m]]
+    nfeat = len(uniq)
+    sums = np.bincount(rank, weights=v, minlength=nfeat)
+    sqs = np.bincount(rank, weights=v * v, minlength=nfeat)
+    u_e = uniq // D
+    ly = y - lab_mean[u_e[rank]]
+    xy = np.bincount(rank, weights=v * ly, minlength=nfeat)
+
+    cnt = act_counts[u_e]
+    with np.errstate(invalid="ignore", divide="ignore"):
+        mean = sums / cnt
+        var = sqs / cnt - mean ** 2
+        corr = np.abs(xy / cnt) / np.sqrt(np.maximum(var, 0)) / np.maximum(
+            lab_sd[u_e], 1e-12)
+    corr[~np.isfinite(corr)] = 0.0
+    is_const = (var <= 1e-12) & (np.abs(mean) > 0)
+    corr[is_const] = 1.0
+    return corr
 
 
 def project_for_scoring(
@@ -264,36 +375,53 @@ def project_for_scoring(
     """Project an evaluation frame into each sample's entity-local feature
     space (reference: IndexMapProjector applied to scoring data). Unseen
     entities -> entity index E (out of range => zero score); unmapped
-    features are dropped."""
+    features are dropped. Fully vectorized."""
     shard = df.feature_shards[config.feature_shard_id]
-    rows = shard.rows
     n = df.num_samples
-    entity_idx = vocab.lookup(config.random_effect_type, df.id_tags[config.random_effect_type])
-    E, d_loc = projection.shape
-
-    local_maps: List[Dict[int, int]] = []
+    D = shard.dim
     proj_np = np.asarray(projection)
-    for e in range(E):
-        lm = {int(j): s for s, j in enumerate(proj_np[e]) if j >= 0}
-        local_maps.append(lm)
+    E, d_loc = proj_np.shape
 
-    K = min(shard.max_nnz() or 1, d_loc)
+    entity_idx = vocab.lookup(config.random_effect_type,
+                              df.id_tags[config.random_effect_type]).astype(np.int64)
+    ent_out = np.where(entity_idx < 0, E, entity_idx).astype(np.int32)
+
+    # (entity, feature) -> slot lookup table, rebuilt from the projection
+    valid = proj_np >= 0
+    pe, ps = np.nonzero(valid)
+    pkeys = pe.astype(np.int64) * D + proj_np[pe, ps]
+    # projection rows are slot-ordered by ascending feature id, so pkeys
+    # is sorted within each entity and across entities
+    porder = np.argsort(pkeys, kind="stable")
+    pkeys_sorted = pkeys[porder]
+    pslots_sorted = ps[porder].astype(np.int64)
+
+    indptr, cols, vals = _csr_of(shard.rows)
+    nnz = np.diff(indptr)
+    s_nz = np.repeat(np.arange(n), nnz)
+    e_nz = entity_idx[s_nz]
+    in_vocab = e_nz >= 0
+    key_nz = np.where(in_vocab, e_nz, 0) * D + cols
+    rank = np.searchsorted(pkeys_sorted, key_nz)
+    rank = np.minimum(rank, max(len(pkeys_sorted) - 1, 0))
+    kept = in_vocab & (len(pkeys_sorted) > 0)
+    if len(pkeys_sorted):
+        kept &= pkeys_sorted[rank] == key_nz
+    slot_nz = pslots_sorted[rank] if len(pkeys_sorted) else np.zeros(len(cols), np.int64)
+
+    if len(cols):
+        kept_i = kept.astype(np.int64)
+        c = np.cumsum(kept_i)
+        excl = c - kept_i
+        base = np.repeat(excl[np.minimum(indptr[:-1], len(excl) - 1)], nnz)
+        k_pos = excl - base
+    else:
+        k_pos = np.zeros(0, np.int64)
+
+    K = max(int(k_pos[kept].max()) + 1 if kept.any() else 1, 1)
     out_idx = np.zeros((n, K), np.int32)
     out_val = np.zeros((n, K), dtype)
-    ent = np.empty(n, np.int32)
-    for i in range(n):
-        e = int(entity_idx[i])
-        ent[i] = e if e >= 0 else E
-        if e < 0:
-            continue
-        lm = local_maps[e]
-        idx, val = rows[i]
-        kk = 0
-        for j, v in zip(idx, val):
-            slot = lm.get(int(j))
-            if slot is not None and kk < K:
-                out_idx[i, kk] = slot
-                out_val[i, kk] = v
-                kk += 1
+    out_idx[s_nz[kept], k_pos[kept]] = slot_nz[kept].astype(np.int32)
+    out_val[s_nz[kept], k_pos[kept]] = vals[kept]
     return (F.SparseFeatures(jnp.asarray(out_idx), jnp.asarray(out_val)),
-            jnp.asarray(ent))
+            jnp.asarray(ent_out))

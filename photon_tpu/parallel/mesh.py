@@ -132,40 +132,57 @@ def replicate(params, mesh: Mesh):
 # -- entity-block padding + placement (random-effect path) -------------------
 
 def pad_entities(ds, multiple: int, num_flat_samples: Optional[int] = None):
-    """Pad a RandomEffectDataset's entity dim (and passive rows) so both
-    shard evenly; pad entities have zero-weight samples and scatter rows at
-    the drop sentinel ``num_flat_samples`` (the documented 'n on pads'
-    invariant of RandomEffectDataset.sample_rows)."""
-    from photon_tpu.game.random_effect import RandomEffectDataset
+    """Pad each entity block's row dim (and the passive rows) of a
+    RandomEffectDataset so all shard evenly; pad rows carry zero weights,
+    out-of-range entity rows, and scatter rows at the drop sentinel
+    ``num_flat_samples`` (the 'n on pads' invariant of sample_rows)."""
+    from photon_tpu.game.random_effect import EntityBlock, RandomEffectDataset
 
     E = ds.num_entities
-    E_pad = pad_to_multiple(E, multiple)
     Ppas = ds.passive_entity.shape[0]
     P_pad = pad_to_multiple(Ppas, multiple)
-    if E_pad == E and P_pad == Ppas:
-        return ds
 
     def pad0(a, rows, fill=0):
         widths = [(0, rows)] + [(0, 0)] * (a.ndim - 1)
         return jnp.pad(a, widths, constant_values=fill)
 
-    eE, eP = E_pad - E, P_pad - Ppas
-    # sample_rows is n on build-time pads, so max is a safe drop sentinel
-    # only when pads exist; max+1 keeps pads inert when every block is full
-    n_sentinel = (num_flat_samples if num_flat_samples is not None
-                  else int(jnp.max(ds.sample_rows)) + 1 if ds.sample_rows.size else 0)
+    def sentinel(rows_arr):
+        if num_flat_samples is not None:
+            return num_flat_samples
+        # max is safe only when build-time pads (== n) exist; max+1 always is
+        return int(jnp.max(rows_arr)) + 1 if rows_arr.size else 0
+
+    blocks = []
+    changed = P_pad != Ppas
+    for blk in ds.blocks:
+        E_b = blk.num_rows
+        E_b_pad = pad_to_multiple(E_b, multiple)
+        if E_b_pad == E_b:
+            blocks.append(blk)
+            continue
+        changed = True
+        e = E_b_pad - E_b
+        blocks.append(EntityBlock(
+            features=F.SparseFeatures(pad0(blk.features.indices, e),
+                                      pad0(blk.features.values, e)),
+            labels=pad0(blk.labels, e),
+            offsets=pad0(blk.offsets, e),
+            weights=pad0(blk.weights, e),
+            sample_rows=pad0(blk.sample_rows, e, fill=sentinel(blk.sample_rows)),
+            entity_rows=pad0(blk.entity_rows, e, fill=E),  # out of range -> drop
+        ))
+    if not changed:
+        return ds
+
+    eP = P_pad - Ppas
     return RandomEffectDataset(
-        features=F.SparseFeatures(pad0(ds.features.indices, eE),
-                                  pad0(ds.features.values, eE)),
-        labels=pad0(ds.labels, eE),
-        offsets=pad0(ds.offsets, eE),
-        weights=pad0(ds.weights, eE),
-        sample_rows=pad0(ds.sample_rows, eE, fill=n_sentinel),
+        blocks=tuple(blocks),
         passive_features=F.SparseFeatures(pad0(ds.passive_features.indices, eP),
                                           pad0(ds.passive_features.values, eP)),
-        passive_entity=pad0(ds.passive_entity, eP, fill=E_pad),
-        passive_rows=pad0(ds.passive_rows, eP, fill=n_sentinel),
-        projection=pad0(ds.projection, eE, fill=-1),
+        passive_entity=pad0(ds.passive_entity, eP, fill=E),
+        passive_rows=pad0(ds.passive_rows, eP,
+                          fill=sentinel(ds.passive_rows)),
+        projection=ds.projection,
     )
 
 
@@ -186,7 +203,16 @@ def shard_entity_blocks(ds, mesh: Mesh, axis: Optional[str] = None,
         spec = P(axis, *([None] * (a.ndim - 1)))
         return jax.device_put(a, NamedSharding(mesh, spec))
 
-    return jax.tree.map(put, ds)
+    blocks = tuple(jax.tree.map(put, b) for b in ds.blocks)
+    return type(ds)(
+        blocks=blocks,
+        passive_features=jax.tree.map(put, ds.passive_features),
+        passive_entity=put(ds.passive_entity),
+        passive_rows=put(ds.passive_rows),
+        # the projection's entity dim is not padded — replicate it (it is
+        # only consulted on the host and for scoring-frame projection)
+        projection=jax.device_put(ds.projection, replicated(mesh)),
+    )
 
 
 # -- feature-dimension (model-parallel) sharding -----------------------------

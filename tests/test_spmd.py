@@ -133,8 +133,10 @@ def test_entity_sharded_blocks_cover_all_devices(glmix, devices8):  # noqa: F811
     coord = RandomEffectCoordinate(ds, train.num_samples, "userId",
                                    "user_feats", TaskType.LOGISTIC_REGRESSION,
                                    mesh=mesh)
-    sharding = coord.dataset.labels.sharding
-    assert len(sharding.device_set) == 8, "entity blocks not spread over mesh"
+    assert coord.dataset.blocks, "expected at least one entity block"
+    for blk in coord.dataset.blocks:
+        sharding = blk.labels.sharding
+        assert len(sharding.device_set) == 8, "entity block not spread over mesh"
 
 
 def test_model_parallel_margins_allreduce(rng, devices8):
