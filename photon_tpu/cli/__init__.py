@@ -1,0 +1,18 @@
+"""Driver / CLI layer: the photon-client replacement.
+
+Entry points:
+  python -m photon_tpu.cli.train          GAME training (GameTrainingDriver)
+  python -m photon_tpu.cli.score          GAME batch scoring (GameScoringDriver)
+  python -m photon_tpu.cli.legacy         legacy single-GLM driver (Driver)
+  python -m photon_tpu.cli.feature_index  feature index build (FeatureIndexingDriver)
+"""
+
+from photon_tpu.cli.config import (
+    expand_sweep,
+    parse_coordinate_config,
+    parse_feature_shard_config,
+    parse_kv_args,
+)
+
+__all__ = ["expand_sweep", "parse_coordinate_config",
+           "parse_feature_shard_config", "parse_kv_args"]

@@ -1,0 +1,147 @@
+"""CLI configuration mini-DSL: feature shards + coordinates.
+
+Reference: photon-client io/scopt/ScoptParserHelpers.scala:33 — key=value
+lists with ',' between pairs, '|' for secondary lists, '-' for ranges:
+
+  --feature-shard-configuration name=global,feature.bags=features|userF,intercept=true
+  --coordinate-configuration name=user,random.effect.type=userId,\
+      feature.shard=userShard,optimizer=LBFGS,tolerance=1e-6,max.iter=50,\
+      regularization=L2,reg.weights=0.1|1|10,active.data.lower.bound=5
+
+plus io/CoordinateConfiguration.scala:57-139 (a reg-weight list expands
+into one GameOptimizationConfiguration per weight) and
+io/FeatureShardConfiguration.scala:23.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from photon_tpu.estimators.game_estimator import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+)
+from photon_tpu.function.objective import (
+    L1Regularization,
+    L2Regularization,
+    NoRegularization,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+from photon_tpu.io.data_io import FeatureShardConfiguration
+from photon_tpu.optim.problem import (
+    GLMOptimizationConfiguration,
+    OptimizerConfig,
+)
+from photon_tpu.types import OptimizerType
+
+KV_DELIMITER = "="
+LIST_DELIMITER = ","
+SECONDARY_LIST_DELIMITER = "|"
+
+
+def parse_kv_args(text: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for pair in text.split(LIST_DELIMITER):
+        pair = pair.strip()
+        if not pair:
+            continue
+        k, sep, v = pair.partition(KV_DELIMITER)
+        if not sep:
+            raise ValueError(f"expected key{KV_DELIMITER}value, got {pair!r}")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def parse_feature_shard_config(text: str) -> Tuple[str, FeatureShardConfiguration]:
+    """'name=global,feature.bags=a|b,intercept=true' -> (name, config)."""
+    args = parse_kv_args(text)
+    name = args.pop("name")
+    bags = tuple(args.pop("feature.bags").split(SECONDARY_LIST_DELIMITER))
+    intercept = args.pop("intercept", "true").lower() in ("true", "1", "yes")
+    if args:
+        raise ValueError(f"unknown feature-shard args: {sorted(args)}")
+    return name, FeatureShardConfiguration(bags, intercept)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParsedCoordinate:
+    """One coordinate plus its reg-weight sweep (reference:
+    CoordinateConfiguration.expandOptimizationConfigurations)."""
+
+    name: str
+    configuration: CoordinateConfiguration
+    reg_weights: Tuple[float, ...]  # sweep; first weight is in configuration
+
+
+def _regularization(args: Dict[str, str]) -> RegularizationContext:
+    reg = args.pop("regularization", "NONE").upper()
+    if reg == "NONE":
+        return NoRegularization
+    if reg == "L1":
+        return L1Regularization
+    if reg == "L2":
+        return L2Regularization
+    if reg == "ELASTIC_NET":
+        alpha = float(args.pop("reg.alpha", 0.5))
+        return RegularizationContext(RegularizationType.ELASTIC_NET, alpha)
+    raise ValueError(f"unknown regularization {reg!r}")
+
+
+def parse_coordinate_config(text: str) -> ParsedCoordinate:
+    args = parse_kv_args(text)
+    name = args.pop("name")
+    shard = args.pop("feature.shard")
+    args.pop("min.partitions", None)  # Spark partitioning knob: no analog
+
+    re_type = args.pop("random.effect.type", None)
+    if re_type is not None:
+        def popi(key):
+            v = args.pop(key, None)
+            return None if v is None else int(float(v))
+        data = RandomEffectDataConfiguration(
+            random_effect_type=re_type,
+            feature_shard_id=shard,
+            active_data_lower_bound=popi("active.data.lower.bound"),
+            active_data_upper_bound=popi("active.data.upper.bound"),
+            features_to_samples_ratio=(
+                None if "features.to.samples.ratio" not in args
+                else float(args.pop("features.to.samples.ratio"))),
+        )
+        args.pop("passive.data.bound", None)
+    else:
+        data = FixedEffectDataConfiguration(shard)
+
+    opt_type = OptimizerType(args.pop("optimizer").upper())
+    max_iter = int(args.pop("max.iter"))
+    tolerance = float(args.pop("tolerance"))
+    reg_context = _regularization(args)
+    weights_text = args.pop("reg.weights", None)
+    reg_weights = tuple(float(w) for w in
+                        weights_text.split(SECONDARY_LIST_DELIMITER)) \
+        if weights_text else (0.0,)
+    down_sampling = float(args.pop("down.sampling.rate", 1.0))
+    if args:
+        raise ValueError(f"unknown coordinate args for {name!r}: {sorted(args)}")
+
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(optimizer_type=opt_type,
+                                  max_iterations=max_iter,
+                                  tolerance=tolerance),
+        regularization=reg_context,
+        regularization_weight=reg_weights[0],
+        down_sampling_rate=down_sampling,
+    )
+    return ParsedCoordinate(name, CoordinateConfiguration(data, opt), reg_weights)
+
+
+def expand_sweep(parsed: Sequence[ParsedCoordinate]) -> List[Dict[str, float]]:
+    """All permutations of per-coordinate reg weights — one model trains
+    per combination (reference: GameTrainingDriver.prepareGameOptConfigs
+    cartesian product)."""
+    sweeps: List[Dict[str, float]] = [{}]
+    for p in parsed:
+        sweeps = [{**s, p.name: w} for s in sweeps for w in p.reg_weights]
+    return sweeps

@@ -1,0 +1,138 @@
+"""Offline feature-index build + name-term feature bag extraction.
+
+Reference: photon-client index/FeatureIndexingDriver.scala:41 (run :167,
+main :297 — extract NameAndTerm per feature bag, partition by hash,
+build one PalDB store per partition) and data/avro/
+NameAndTermFeatureBagsDriver.scala:32 (run :143 — distinct feature
+name-terms per bag written as text).
+
+The PalDB stores become mmap-able binary index partitions
+(io/index_store.py) readable by Python and the native C++ reader.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Dict, List, Optional, Set
+
+from photon_tpu.cli.config import parse_feature_shard_config
+from photon_tpu.io import avro as avro_io
+from photon_tpu.io.data_io import (
+    FeatureShardConfiguration,
+    _record_keys,
+    read_records,
+)
+from photon_tpu.io.index_map import INTERCEPT_KEY
+from photon_tpu.io.index_store import PartitionedIndexMap, write_partitioned_index
+from photon_tpu.utils.timing import Timed
+
+logger = logging.getLogger("photon_tpu.index")
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_tpu.feature_index",
+        description="Build partitioned feature index stores from Avro data")
+    p.add_argument("--input-data-directories", nargs="+", required=True)
+    p.add_argument("--root-output-directory", required=True)
+    p.add_argument("--feature-shard-configuration", action="append",
+                   required=True, dest="feature_shards")
+    p.add_argument("--num-partitions", type=int, default=1)
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def collect_shard_keys(records, shard_configs: Dict[str, FeatureShardConfiguration]
+                       ) -> Dict[str, Set[str]]:
+    keys: Dict[str, Set[str]] = {sid: set() for sid in shard_configs}
+    for rec in records:
+        for sid, cfg in shard_configs.items():
+            for k, _ in _record_keys(rec, cfg.feature_bags):
+                keys[sid].add(k)
+    for sid, cfg in shard_configs.items():
+        if cfg.has_intercept:
+            keys[sid].add(INTERCEPT_KEY)
+    return keys
+
+
+def run(args: argparse.Namespace) -> Dict[str, int]:
+    logging.basicConfig(level=args.log_level)
+    shard_configs = dict(parse_feature_shard_config(s)
+                         for s in args.feature_shards)
+    with Timed("read data", logger):
+        records = read_records(args.input_data_directories)
+    with Timed("collect feature keys", logger):
+        keys = collect_shard_keys(records, shard_configs)
+    dims: Dict[str, int] = {}
+    for sid, shard_keys in keys.items():
+        with Timed(f"write index partitions [{sid}]", logger):
+            dims[sid] = write_partitioned_index(
+                args.root_output_directory, sid, shard_keys,
+                num_partitions=args.num_partitions)
+        logger.info("shard %s: %d features, %d partitions", sid, dims[sid],
+                    args.num_partitions)
+    return dims
+
+
+def load_index_maps(directory: str, shard_ids) -> Dict[str, "IndexMap"]:
+    """Load built partitions back as plain IndexMaps (the per-executor
+    PalDBIndexMapLoader role)."""
+    out = {}
+    for sid in shard_ids:
+        pim = PartitionedIndexMap(directory, sid)
+        try:
+            out[sid] = pim.to_index_map()
+        finally:
+            pim.close()
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    run(build_arg_parser().parse_args(argv))
+
+
+# ---------------------------------------------------------------------------
+# name-term feature bags (reference: NameAndTermFeatureBagsDriver)
+# ---------------------------------------------------------------------------
+
+
+def build_bags_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_tpu.name_term_bags",
+        description="Extract distinct (name, term) pairs per feature bag")
+    p.add_argument("--input-data-directories", nargs="+", required=True)
+    p.add_argument("--root-output-directory", required=True)
+    p.add_argument("--feature-bag-keys", nargs="+", required=True)
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def run_bags(args: argparse.Namespace) -> Dict[str, int]:
+    logging.basicConfig(level=args.log_level)
+    records: List[dict] = []
+    for d in args.input_data_directories:
+        records.extend(avro_io.iter_avro_dir(d))
+    os.makedirs(args.root_output_directory, exist_ok=True)
+    counts = {}
+    for bag in args.feature_bag_keys:
+        pairs = set()
+        for rec in records:
+            for f in rec.get(bag) or ():
+                pairs.add((str(f["name"]), str(f["term"])))
+        out = os.path.join(args.root_output_directory, bag)
+        with open(out, "w") as fh:
+            for name, term in sorted(pairs):
+                fh.write(f"{name}\t{term}\n")
+        counts[bag] = len(pairs)
+        logger.info("bag %s: %d distinct name-terms -> %s", bag, len(pairs), out)
+    return counts
+
+
+def bags_main(argv: Optional[List[str]] = None) -> None:
+    run_bags(build_bags_arg_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,261 @@
+"""Legacy single-GLM driver: explicit stage machine with a lambda sweep.
+
+Reference: photon-client Driver.scala:59 (run :145) — stages
+INIT -> PREPROCESSED -> TRAINED -> VALIDATED (DriverStage.scala:20,45),
+reg-weight sweep via ModelTraining, per-lambda validation metrics, best
+model selection (ModelSelection.scala:26), coefficient text/Avro output
+(io/deprecated/GLMSuite semantics); feature summary
+(FeatureDataStatistics) and optional normalization.
+
+Input formats: Avro TrainingExampleAvro directories or LibSVM text
+(io/deprecated/LibSVMInputDataFormat).
+"""
+
+from __future__ import annotations
+
+import argparse
+import enum
+import json
+import logging
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.data.ingest import read_libsvm, to_batch
+from photon_tpu.data.stats import compute_feature_stats
+from photon_tpu.data.validators import DataValidationType, validate_dataframe
+from photon_tpu.estimators.model_training import train_generalized_linear_model
+from photon_tpu.evaluation.multi import EvaluationSuite
+from photon_tpu.function.objective import (
+    L1Regularization,
+    L2Regularization,
+    NoRegularization,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+from photon_tpu.io import avro as avro_io
+from photon_tpu.io.data_io import (
+    FeatureShardConfiguration,
+    build_index_maps,
+    records_to_game_dataframe,
+)
+from photon_tpu.io.index_map import IndexMap
+from photon_tpu.io.model_io import _vector_to_ntvs
+from photon_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_AVRO
+from photon_tpu.ops.normalization import (
+    NormalizationType,
+    build_normalization_context,
+    no_normalization,
+)
+from photon_tpu.optim.problem import GLMOptimizationConfiguration, OptimizerConfig
+from photon_tpu.types import OptimizerType, TaskType
+from photon_tpu.utils.timing import Timed, timing_summary
+
+logger = logging.getLogger("photon_tpu.driver")
+
+
+class DriverStage(enum.Enum):
+    """Reference: DriverStage.scala:20,45."""
+
+    INIT = 0
+    PREPROCESSED = 1
+    TRAINED = 2
+    VALIDATED = 3
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_tpu.driver",
+        description="Legacy single-GLM training driver with a lambda sweep")
+    p.add_argument("--training-data-directory", required=True)
+    p.add_argument("--validating-data-directory", default=None)
+    p.add_argument("--output-directory", required=True)
+    p.add_argument("--task", required=True, choices=[t.value for t in TaskType])
+    p.add_argument("--format", default="AVRO", choices=["AVRO", "LIBSVM"])
+    p.add_argument("--feature-dimension", type=int, default=None,
+                   help="LIBSVM only: fixed feature dimension")
+    p.add_argument("--optimizer", default="LBFGS",
+                   choices=[o.value for o in OptimizerType])
+    p.add_argument("--regularization-type", default="L2",
+                   choices=[r.value for r in RegularizationType])
+    p.add_argument("--regularization-weights", default="0.1,1,10,100")
+    p.add_argument("--elastic-net-alpha", type=float, default=0.5)
+    p.add_argument("--max-iterations", type=int, default=50)
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--normalization-type", default="NONE",
+                   choices=[n.value for n in NormalizationType])
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.value for v in DataValidationType])
+    p.add_argument("--intercept", action="store_true", default=True)
+    p.add_argument("--no-intercept", dest="intercept", action="store_false")
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+class LegacyDriver:
+    """Explicit stage machine (reference: Driver.scala)."""
+
+    def __init__(self, args: argparse.Namespace):
+        self.args = args
+        self.stage = DriverStage.INIT
+        self.task = TaskType(args.task)
+        self.index_map: Optional[IndexMap] = None
+        self.models: Dict[float, object] = {}
+        self.metrics: Dict[float, Dict[str, float]] = {}
+        self.best_lambda: Optional[float] = None
+
+    # -- stage INIT -> PREPROCESSED -----------------------------------------
+
+    def preprocess(self):
+        args = self.args
+        with Timed("preprocess", logger):
+            if args.format == "LIBSVM":
+                data = read_libsvm(args.training_data_directory,
+                                   dim=args.feature_dimension,
+                                   add_intercept=args.intercept)
+                self.train_batch = to_batch(data, dtype=np.float64)
+                self.dim = data.dim
+                self.index_map = IndexMap(
+                    {f"f{j}": j for j in range(self.dim)})
+                self.val_batch = None
+                self.val_labels = None
+                self.val_weights = None
+                if args.validating_data_directory:
+                    vdata = read_libsvm(
+                        args.validating_data_directory,
+                        dim=self.dim - (1 if args.intercept else 0),
+                        add_intercept=args.intercept)
+                    self.val_batch = to_batch(vdata, dtype=np.float64).features
+                    self.val_labels = vdata.labels
+            else:
+                shard = {"features": FeatureShardConfiguration.of(
+                    "features", intercept=args.intercept)}
+                records = list(avro_io.iter_avro_dir(args.training_data_directory))
+                imaps = build_index_maps(records, shard)
+                self.index_map = imaps["features"]
+                df = records_to_game_dataframe(records, shard, imaps)
+                validate_dataframe(df, self.task,
+                                   DataValidationType(args.data_validation))
+                self.train_batch = df.fixed_effect_batch("features")
+                self.dim = self.index_map.feature_dimension
+                self.val_batch = None
+                self.val_labels = None
+                self.val_weights = None
+                if args.validating_data_directory:
+                    vrecs = list(avro_io.iter_avro_dir(args.validating_data_directory))
+                    vdf = records_to_game_dataframe(vrecs, shard, imaps)
+                    self.val_batch = vdf.shard_features("features")
+                    self.val_labels = vdf.response
+                    self.val_weights = vdf.weights
+
+            # feature summary (reference: Driver preprocess writes summary)
+            self.summary = compute_feature_stats(self.train_batch.features,
+                                                 self.dim)
+            self.norm = no_normalization()
+            ntype = NormalizationType(args.normalization_type)
+            if ntype != NormalizationType.NONE:
+                icol = (self.dim - 1 if args.intercept else None)
+                self.norm = build_normalization_context(
+                    ntype, self.summary.mean,
+                    self.summary.variance, self.summary.abs_max,
+                    intercept_index=icol)
+        self.stage = DriverStage.PREPROCESSED
+
+    # -- stage PREPROCESSED -> TRAINED --------------------------------------
+
+    def train(self):
+        args = self.args
+        lambdas = [float(s) for s in args.regularization_weights.split(",")]
+        reg = {
+            "NONE": NoRegularization,
+            "L1": L1Regularization,
+            "L2": L2Regularization,
+            "ELASTIC_NET": RegularizationContext(
+                RegularizationType.ELASTIC_NET, args.elastic_net_alpha),
+        }[args.regularization_type]
+        config = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(
+                optimizer_type=OptimizerType(args.optimizer),
+                max_iterations=args.max_iterations,
+                tolerance=args.tolerance),
+            regularization=reg)
+        with Timed(f"train {len(lambdas)} lambdas", logger):
+            models, stats = train_generalized_linear_model(
+                self.task, self.train_batch, self.dim, config,
+                regularization_weights=lambdas, norm=self.norm,
+                dtype=self.train_batch.labels.dtype)
+        self.models = models
+        self.solver_stats = stats
+        self.stage = DriverStage.TRAINED
+
+    # -- stage TRAINED -> VALIDATED -----------------------------------------
+
+    def validate(self):
+        if self.val_batch is None:
+            return
+        from photon_tpu.evaluation.evaluators import default_evaluator_for_task
+        primary = default_evaluator_for_task(self.task)
+        suite = EvaluationSuite([primary], np.asarray(self.val_labels),
+                                weights=self.val_weights)
+        with Timed("validate", logger):
+            for lam, model in self.models.items():
+                scores = model.compute_score(self.val_batch)
+                self.metrics[lam] = suite.evaluate(scores).evaluations
+        # best-model selection (reference: ModelSelection.scala:26)
+        name = primary.value
+        better = (max if primary.bigger_is_better else min)
+        self.best_lambda = better(self.metrics,
+                                  key=lambda lam: self.metrics[lam][name])
+        self.stage = DriverStage.VALIDATED
+
+    # -- persist -------------------------------------------------------------
+
+    def save(self):
+        args = self.args
+        out = args.output_directory
+        os.makedirs(out, exist_ok=True)
+        recs = []
+        for lam, model in self.models.items():
+            recs.append({
+                "modelId": str(lam),
+                "modelClass": None,
+                "means": _vector_to_ntvs(
+                    np.asarray(model.coefficients.means), self.index_map,
+                    sparsity_threshold=0.0),
+                "variances": None,
+                "lossFunction": "",
+            })
+        avro_io.write_avro(os.path.join(out, "models.avro"),
+                           BAYESIAN_LINEAR_MODEL_AVRO, recs)
+        summary = {
+            "task": self.task.value,
+            "lambdas": sorted(self.models.keys()),
+            "metrics": {str(k): v for k, v in self.metrics.items()},
+            "best_lambda": self.best_lambda,
+        }
+        with open(os.path.join(out, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        logger.info("saved %d models to %s", len(recs), out)
+
+    def run(self):
+        self.preprocess()
+        self.train()
+        self.validate()
+        self.save()
+        logger.info(timing_summary())
+        return self
+
+
+def main(argv: Optional[List[str]] = None) -> LegacyDriver:
+    args = build_arg_parser().parse_args(argv)
+    logging.basicConfig(level=args.log_level,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    return LegacyDriver(args).run()
+
+
+if __name__ == "__main__":
+    main()

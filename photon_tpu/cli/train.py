@@ -1,0 +1,255 @@
+"""GAME training driver: ingest -> validate -> fit -> select -> persist.
+
+Reference: photon-client cli/game/training/GameTrainingDriver.scala
+(params :67-155, run :346, main :833): read Avro training/validation
+data, prepare feature maps, sanity-check, compute stats + normalization,
+fit one model per optimization configuration (cartesian sweep), optional
+hyperparameter tuning, select + save models per ModelOutputMode
+(io/ModelOutputMode.scala:20-46).
+
+Usage:
+  python -m photon_tpu.cli.train \\
+    --input-data-directories data/train \\
+    --validation-data-directories data/val \\
+    --root-output-directory out \\
+    --training-task LOGISTIC_REGRESSION \\
+    --feature-shard-configuration name=global,feature.bags=features \\
+    --coordinate-configuration name=fixed,feature.shard=global,\\
+optimizer=LBFGS,tolerance=1e-7,max.iter=50,regularization=L2,reg.weights=1|10 \\
+    --coordinate-update-sequence fixed
+"""
+
+from __future__ import annotations
+
+import argparse
+import enum
+import json
+import logging
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_tpu.cli.config import (
+    ParsedCoordinate,
+    expand_sweep,
+    parse_coordinate_config,
+    parse_feature_shard_config,
+)
+from photon_tpu.data.validators import DataValidationType, validate_dataframe
+from photon_tpu.estimators.game_estimator import GameEstimator
+from photon_tpu.hyperparameter.tuner import (
+    HyperparameterTuningMode,
+    run_hyperparameter_tuning,
+)
+from photon_tpu.io.data_io import (
+    build_index_maps,
+    read_records,
+    records_to_game_dataframe,
+)
+from photon_tpu.io.model_io import save_game_model
+from photon_tpu.types import TaskType, VarianceComputationType
+from photon_tpu.utils.timing import Timed
+
+logger = logging.getLogger("photon_tpu.train")
+
+
+class ModelOutputMode(enum.Enum):
+    """Reference: io/ModelOutputMode.scala:20-46."""
+
+    NONE = "NONE"          # save nothing
+    BEST = "BEST"          # only the best model by validation metric
+    EXPLICIT = "EXPLICIT"  # all explicitly-configured models
+    TUNED = "TUNED"        # only tuned models
+    ALL = "ALL"            # explicit + tuned
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_tpu.train",
+        description="Train a GAME model (fixed + random effects) on TPU")
+    p.add_argument("--input-data-directories", nargs="+", required=True)
+    p.add_argument("--validation-data-directories", nargs="*", default=[])
+    p.add_argument("--root-output-directory", required=True)
+    p.add_argument("--training-task", required=True,
+                   choices=[t.value for t in TaskType])
+    p.add_argument("--feature-shard-configuration", action="append",
+                   required=True, dest="feature_shards")
+    p.add_argument("--coordinate-configuration", action="append",
+                   required=True, dest="coordinates")
+    p.add_argument("--coordinate-update-sequence", required=True,
+                   help="comma-separated coordinate names")
+    p.add_argument("--coordinate-descent-iterations", type=int, default=1)
+    p.add_argument("--validation-evaluators", nargs="*", default=None,
+                   help='e.g. AUC RMSE "AUC:userId" "PRECISION@5:userId"')
+    p.add_argument("--id-tag-columns", nargs="*", default=[],
+                   help="record columns carrying entity ids")
+    p.add_argument("--model-input-directory", default=None,
+                   help="warm-start GAME model directory")
+    p.add_argument("--partial-retrain-locked-coordinates", nargs="*",
+                   default=[])
+    p.add_argument("--output-mode", default="BEST",
+                   choices=[m.value for m in ModelOutputMode])
+    p.add_argument("--variance-computation-type", default="NONE",
+                   choices=[v.value for v in VarianceComputationType])
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.value for v in DataValidationType])
+    p.add_argument("--hyper-parameter-tuning", default="NONE",
+                   choices=[m.value for m in HyperparameterTuningMode])
+    p.add_argument("--hyper-parameter-tuning-iter", type=int, default=0)
+    p.add_argument("--model-sparsity-threshold", type=float, default=1e-4)
+    p.add_argument("--num-devices", type=int, default=0,
+                   help="shard training over this many devices (0 = single)")
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def _id_tags_needed(args, parsed: List[ParsedCoordinate]) -> List[str]:
+    tags = set(args.id_tag_columns)
+    for p in parsed:
+        re_type = getattr(p.configuration.data, "random_effect_type", None)
+        if re_type:
+            tags.add(re_type)
+    for ev in args.validation_evaluators or []:
+        _, _, tag = str(ev).partition(":")
+        if tag:
+            tags.add(tag)
+    return sorted(tags)
+
+
+def run(args: argparse.Namespace) -> List:
+    logging.basicConfig(level=args.log_level,
+                        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    task = TaskType(args.training_task)
+    out_dir = args.root_output_directory
+    os.makedirs(out_dir, exist_ok=True)
+
+    shard_configs = dict(parse_feature_shard_config(s)
+                         for s in args.feature_shards)
+    parsed = [parse_coordinate_config(c) for c in args.coordinates]
+    coordinate_configs = {p.name: p.configuration for p in parsed}
+    update_sequence = [s.strip() for s in
+                       args.coordinate_update_sequence.split(",")]
+    unknown = set(update_sequence) - set(coordinate_configs)
+    if unknown:
+        raise ValueError(f"update sequence references unknown coordinates: {unknown}")
+    id_tags = _id_tags_needed(args, parsed)
+
+    with Timed("read training data", logger):
+        records = read_records(args.input_data_directories)
+        index_maps = build_index_maps(records, shard_configs)
+        df = records_to_game_dataframe(records, shard_configs, index_maps,
+                                       id_tag_columns=id_tags)
+    validation_df = None
+    if args.validation_data_directories:
+        with Timed("read validation data", logger):
+            vrecords = read_records(args.validation_data_directories)
+            validation_df = records_to_game_dataframe(
+                vrecords, shard_configs, index_maps, id_tag_columns=id_tags)
+
+    with Timed("data validation", logger):
+        validate_dataframe(df, task, DataValidationType(args.data_validation))
+
+    mesh = None
+    if args.num_devices:
+        from photon_tpu.parallel import mesh as M
+        mesh = M.create_mesh(args.num_devices)
+
+    initial_model = None
+    if args.model_input_directory:
+        from photon_tpu.io.model_io import load_game_model
+        # pass the LoadedGameModel through — the estimator re-aligns its
+        # random-effect blocks to the fresh ingest's entity/slot layout
+        initial_model = load_game_model(args.model_input_directory, index_maps)
+        logger.info("warm-starting from %s", args.model_input_directory)
+
+    estimator = GameEstimator(
+        task=task,
+        coordinate_configs=coordinate_configs,
+        update_sequence=update_sequence,
+        num_iterations=args.coordinate_descent_iterations,
+        validation_evaluators=args.validation_evaluators,
+        locked_coordinates=args.partial_retrain_locked_coordinates,
+        mesh=mesh,
+        variance_computation_type=VarianceComputationType(
+            args.variance_computation_type),
+    )
+
+    sweeps = expand_sweep(parsed)
+    with Timed(f"train {len(sweeps)} configuration(s)", logger):
+        results = estimator.fit(df, validation_df=validation_df,
+                                configurations=sweeps,
+                                initial_model=initial_model)
+
+    tuned = []
+    mode = HyperparameterTuningMode(args.hyper_parameter_tuning)
+    if mode != HyperparameterTuningMode.NONE:
+        if args.hyper_parameter_tuning_iter <= 0:
+            logger.warning("--hyper-parameter-tuning %s requested but "
+                           "--hyper-parameter-tuning-iter is %d: skipping "
+                           "tuning", mode.value, args.hyper_parameter_tuning_iter)
+        if validation_df is None:
+            logger.warning("--hyper-parameter-tuning %s requested but no "
+                           "--validation-data-directories given: skipping "
+                           "tuning", mode.value)
+    if (mode != HyperparameterTuningMode.NONE
+            and args.hyper_parameter_tuning_iter > 0
+            and validation_df is not None):
+        with Timed("hyperparameter tuning", logger):
+            tuned = run_hyperparameter_tuning(
+                estimator, df, validation_df,
+                n_iterations=args.hyper_parameter_tuning_iter,
+                mode=mode, prior_results=results)
+
+    save_models(args, estimator, results, tuned, index_maps, out_dir)
+    return results + tuned
+
+
+def _best_result(estimator: GameEstimator, results: List):
+    primary = estimator.evaluators[0]
+    scored = [r for r in results if r.evaluation is not None]
+    if not scored:
+        return results[-1]
+    return (max if primary.bigger_is_better else min)(
+        scored, key=lambda r: r.evaluation[primary.name])
+
+
+def save_models(args, estimator, results, tuned, index_maps, out_dir) -> None:
+    mode = ModelOutputMode(args.output_mode)
+    if mode == ModelOutputMode.NONE:
+        return
+    to_save: Dict[str, object] = {}
+    if mode == ModelOutputMode.BEST:
+        to_save["best"] = _best_result(estimator, results + tuned)
+    else:
+        if mode in (ModelOutputMode.EXPLICIT, ModelOutputMode.ALL):
+            for i, r in enumerate(results):
+                to_save[f"models/{i}"] = r
+        if mode in (ModelOutputMode.TUNED, ModelOutputMode.ALL):
+            for i, r in enumerate(tuned):
+                to_save[f"tuned/{i}"] = r
+        to_save["best"] = _best_result(estimator, results + tuned)
+
+    projections = {cid: np.asarray(ds.projection)
+                   for cid, ds in estimator._re_datasets.items()}
+    for rel, result in to_save.items():
+        d = os.path.join(out_dir, rel)
+        with Timed(f"save model {rel}", logger):
+            save_game_model(
+                d, result.model, index_maps,
+                vocab=estimator._vocab, projections=projections,
+                coordinate_configs=result.config,
+                sparsity_threshold=args.model_sparsity_threshold)
+        if result.evaluation is not None:
+            with open(os.path.join(d, "evaluation.json"), "w") as f:
+                json.dump(result.evaluation, f, indent=2)
+    logger.info("saved %d model(s) under %s", len(to_save), out_dir)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    run(build_arg_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,29 +34,37 @@ def read_libsvm(path: str, dim: Optional[int] = None,
                 zero_based: bool = False) -> LibSVMData:
     """Parse LibSVM text. Labels in {-1,1} or {0,1} are mapped to {0,1}.
     If ``add_intercept``, a constant-1 feature is appended at index dim-1."""
+    import os
+    if os.path.isdir(path):
+        files = sorted(os.path.join(path, f) for f in os.listdir(path)
+                       if not f.startswith("."))
+    else:
+        files = [path]
     labels = []
     rows = []
     max_idx = -1
     max_nnz = 0
-    with open(path) as f:
-        for line in f:
-            parts = line.split()
-            if not parts:
-                continue
-            labels.append(float(parts[0]))
-            idx = []
-            val = []
-            for tok in parts[1:]:
-                if tok.startswith("#"):
-                    break
-                i, v = tok.split(":")
-                j = int(i) - (0 if zero_based else 1)
-                idx.append(j)
-                val.append(float(v))
-            if idx:
-                max_idx = max(max_idx, max(idx))
-            rows.append((np.asarray(idx, np.int32), np.asarray(val, np.float64)))
-            max_nnz = max(max_nnz, len(idx))
+    for fp in files:
+        with open(fp) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                idx = []
+                val = []
+                for tok in parts[1:]:
+                    if tok.startswith("#"):
+                        break
+                    i, v = tok.split(":")
+                    j = int(i) - (0 if zero_based else 1)
+                    idx.append(j)
+                    val.append(float(v))
+                if idx:
+                    max_idx = max(max_idx, max(idx))
+                rows.append((np.asarray(idx, np.int32),
+                             np.asarray(val, np.float64)))
+                max_nnz = max(max_nnz, len(idx))
 
     y = np.asarray(labels)
     if set(np.unique(y)) <= {-1.0, 1.0}:

@@ -189,6 +189,13 @@ class GameEstimator:
         weights."""
         vocab = EntityVocabulary()
         coordinates, re_datasets = self._prepare(df, vocab)
+        # a model loaded from disk must be re-packed into this fit's entity
+        # order / projection slots before it can warm-start or lock coords
+        from photon_tpu.io.model_io import LoadedGameModel
+        if isinstance(initial_model, LoadedGameModel):
+            initial_model = initial_model.aligned_to(
+                vocab, {cid: np.asarray(ds.projection)
+                        for cid, ds in re_datasets.items()})
         cd_config = CoordinateDescentConfig(
             update_sequence=self.update_sequence,
             num_iterations=self.num_iterations,

@@ -150,6 +150,17 @@ def records_to_game_dataframe(
     )
 
 
+def read_records(directories: Sequence[str]) -> List[dict]:
+    """Read all Avro records under the given files/directories, erroring
+    clearly when nothing is found (shared by every driver)."""
+    records: List[dict] = []
+    for d in directories:
+        records.extend(avro_io.iter_avro_dir(d))
+    if not records:
+        raise ValueError(f"no Avro records under {list(directories)}")
+    return records
+
+
 def read_game_dataframe(
     path: str,
     shard_configs: Dict[str, FeatureShardConfiguration],

@@ -1,0 +1,174 @@
+"""Binary feature-index store: the PalDB replacement.
+
+Reference: photon-api index/PalDBIndexMap.scala:43 + PalDBIndexMapBuilder
+.scala:27 + PalDBIndexMapLoader — partitioned off-heap key-value stores
+holding feature-name -> index (and index -> name) maps, built offline by
+FeatureIndexingDriver and loaded per-executor without heap pressure.
+
+TPU re-design: one flat memory-mappable file per partition with a sorted
+(hash, key-offset, index) table — lookups are an mmap binary search over
+the hash column, no deserialization of the vocabulary. Partitioning is by
+``hash(key) % num_partitions`` with global indices offset per partition
+(the reference's offset arithmetic, PalDBIndexMap.scala:30-62). The file
+layout is fixed-width little-endian so a native (C++) reader can mmap the
+same files; photon_tpu/native/index_reader.cpp does exactly that, and
+``IndexStore`` uses it via ctypes when built.
+
+Layout:
+  magic  8s   b"PHIXMAP1"
+  n      u64  number of keys
+  table  n * (hash u64, key_off u64, key_len u32, index u32)  sorted by hash
+  blob   concatenated utf-8 key bytes
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from photon_tpu.io.index_map import IndexMap
+
+MAGIC = b"PHIXMAP1"
+_HEADER = struct.Struct("<8sQ")
+_ROW_DTYPE = np.dtype([("hash", "<u8"), ("off", "<u8"),
+                       ("len", "<u4"), ("idx", "<u4")])
+
+
+def _key_hash(key: str) -> int:
+    """FNV-1a 64-bit — trivial to reimplement in the native reader."""
+    h = 0xCBF29CE484222325
+    for b in key.encode("utf-8"):
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def write_index_store(path: str, index_map: IndexMap) -> None:
+    """Write one partition file."""
+    hashed = sorted(((_key_hash(k), k, idx) for k, idx in index_map.items()))
+    key_bytes = [k.encode("utf-8") for _, k, _ in hashed]
+    rows = np.empty(len(hashed), _ROW_DTYPE)
+    off = 0
+    for i, ((h, _, idx), kb) in enumerate(zip(hashed, key_bytes)):
+        rows[i] = (h, off, len(kb), idx)
+        off += len(kb)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_HEADER.pack(MAGIC, len(hashed)))
+        f.write(rows.tobytes())
+        f.write(b"".join(key_bytes))
+
+
+class IndexStore:
+    """mmap-backed read view of one partition file: O(log n) lookups
+    without loading the vocabulary (the PalDB read path)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "rb")
+        self._mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        magic, n = _HEADER.unpack_from(self._mm, 0)
+        if magic != MAGIC:
+            raise ValueError(f"{path}: not an index store (magic={magic!r})")
+        self.num_keys = n
+        table_off = _HEADER.size
+        table_bytes = n * _ROW_DTYPE.itemsize
+        self._rows = np.frombuffer(self._mm, _ROW_DTYPE, n, table_off)
+        self._blob_off = table_off + table_bytes
+
+    def get_index(self, key: str) -> int:
+        kb = key.encode("utf-8")
+        h = _key_hash(key)
+        lo = int(np.searchsorted(self._rows["hash"], np.uint64(h), side="left"))
+        while lo < self.num_keys and int(self._rows["hash"][lo]) == h:
+            off = self._blob_off + int(self._rows["off"][lo])
+            ln = int(self._rows["len"][lo])
+            if self._mm[off:off + ln] == kb:
+                return int(self._rows["idx"][lo])
+            lo += 1
+        return -1
+
+    def items(self) -> Iterable[Tuple[str, int]]:
+        for i in range(self.num_keys):
+            off = self._blob_off + int(self._rows["off"][i])
+            ln = int(self._rows["len"][i])
+            yield self._mm[off:off + ln].decode("utf-8"), int(self._rows["idx"][i])
+
+    def to_index_map(self) -> IndexMap:
+        return IndexMap(dict(self.items()))
+
+    def close(self):
+        self._rows = None  # release the numpy view over the mmap buffer
+        self._mm.close()
+        self._f.close()
+
+
+# ---------------------------------------------------------------------------
+# partitioned stores (the PalDB partition-shard layout)
+# ---------------------------------------------------------------------------
+
+PARTITION_FILE = "index-partition-{shard}-{part:05d}.bin"
+
+
+def write_partitioned_index(out_dir: str, shard_id: str, keys: Iterable[str],
+                            num_partitions: int = 1) -> int:
+    """Build a partitioned index for one feature shard: key -> partition by
+    hash, global index = local rank * num_partitions + partition (stable
+    under partition-parallel builds, like the reference's offset scheme).
+    Returns the feature dimension."""
+    parts: List[List[str]] = [[] for _ in range(num_partitions)]
+    for k in sorted(set(keys)):
+        parts[_key_hash(k) % num_partitions].append(k)
+    dim = 0
+    for p, part_keys in enumerate(parts):
+        m = {k: i * num_partitions + p for i, k in enumerate(part_keys)}
+        write_index_store(
+            os.path.join(out_dir, PARTITION_FILE.format(shard=shard_id, part=p)),
+            IndexMap(m))
+        dim = max(dim, max(m.values()) + 1 if m else 0)
+    return dim
+
+
+class PartitionedIndexMap:
+    """Reader over all partitions of one shard (reference:
+    PalDBIndexMap offset arithmetic across partitions)."""
+
+    def __init__(self, directory: str, shard_id: str):
+        self.stores: List[IndexStore] = []
+        p = 0
+        while True:
+            path = os.path.join(directory,
+                                PARTITION_FILE.format(shard=shard_id, part=p))
+            if not os.path.exists(path):
+                break
+            self.stores.append(IndexStore(path))
+            p += 1
+        if not self.stores:
+            raise FileNotFoundError(
+                f"no index partitions for shard {shard_id!r} in {directory}")
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.stores)
+
+    def get_index(self, key: str) -> int:
+        p = _key_hash(key) % self.num_partitions
+        return self.stores[p].get_index(key)
+
+    def to_index_map(self) -> IndexMap:
+        merged: Dict[str, int] = {}
+        for s in self.stores:
+            merged.update(dict(s.items()))
+        return IndexMap(merged)
+
+    @property
+    def feature_dimension(self) -> int:
+        return max((max((i for _, i in s.items()), default=-1)
+                    for s in self.stores), default=-1) + 1
+
+    def close(self):
+        for s in self.stores:
+            s.close()

@@ -251,6 +251,57 @@ class LoadedGameModel:
     def task(self) -> TaskType:
         return self.model.task
 
+    def aligned_to(self, target_vocab: EntityVocabulary,
+                   target_projections: Dict[str, np.ndarray]) -> GameModel:
+        """Re-pack every random-effect block into a target dataset's entity
+        order and slot layout — required before using a loaded model as a
+        coordinate-descent warm start (the loaded slot order is the saved
+        support, not the new ingest's projection)."""
+        models: Dict[str, object] = {}
+        for cid, m in self.model.models.items():
+            if isinstance(m, RandomEffectModel) and cid not in target_projections:
+                # the new fit does not configure this coordinate; carrying it
+                # verbatim would poison the final model (its block layout has
+                # no dataset, and saving would fail for lack of a projection)
+                continue
+            if not isinstance(m, RandomEffectModel):
+                models[cid] = m
+                continue
+            tgt_proj = np.asarray(target_projections[cid])
+            E_t, K_t = tgt_proj.shape
+            src_proj = self.projections[cid]
+            src_names = self.vocab.names(m.random_effect_type)
+            row_of = {s: i for i, s in enumerate(src_names)}
+            coef_src = np.asarray(m.coefficients)
+            var_src = None if m.variances is None else np.asarray(m.variances)
+            coef = np.zeros((E_t, K_t), coef_src.dtype)
+            var = None if var_src is None else np.zeros((E_t, K_t), var_src.dtype)
+            for e_t, name in enumerate(target_vocab.names(m.random_effect_type)):
+                e_s = row_of.get(name)
+                if e_s is None:
+                    continue
+                by_col = {int(src_proj[e_s, k]): k
+                          for k in range(src_proj.shape[1])
+                          if src_proj[e_s, k] >= 0}
+                for k_t in range(K_t):
+                    g = int(tgt_proj[e_t, k_t])
+                    if g < 0:
+                        continue
+                    k_s = by_col.get(g)
+                    if k_s is None:
+                        continue
+                    coef[e_t, k_t] = coef_src[e_s, k_s]
+                    if var is not None:
+                        var[e_t, k_t] = var_src[e_s, k_s]
+            models[cid] = RandomEffectModel(
+                coefficients=jnp.asarray(coef),
+                random_effect_type=m.random_effect_type,
+                feature_shard_id=m.feature_shard_id,
+                task=m.task,
+                variances=None if var is None else jnp.asarray(var),
+            )
+        return GameModel(models)
+
 
 def load_game_model(
     model_dir: str,

@@ -1,0 +1,74 @@
+"""Wall-clock phase timing.
+
+Reference: photon-lib util/Timed.scala:33-69 — every pipeline phase runs
+inside a `Timed("msg") { ... }` block that logs "msg (duration)"; the
+reference uses it pervasively (GameTrainingDriver.run,
+CoordinateDescent.scala:178-185).
+
+Used as either a context manager or a decorator; durations are also
+recorded in a process-wide registry so drivers can dump a timing summary
+(the Spark-UI stage-view stand-in).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import logging
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+_default_logger = logging.getLogger("photon_tpu.timing")
+
+# (label, seconds) in completion order
+_TIMINGS: List[Tuple[str, float]] = []
+
+
+def timing_records() -> List[Tuple[str, float]]:
+    return list(_TIMINGS)
+
+
+def clear_timings() -> None:
+    _TIMINGS.clear()
+
+
+def timing_summary() -> str:
+    lines = [f"  {label}: {secs:.3f}s" for label, secs in _TIMINGS]
+    return "timing summary:\n" + "\n".join(lines) if lines else "no timings"
+
+
+class Timed(contextlib.AbstractContextManager):
+    """``with Timed("phase", logger): ...`` logs 'phase (1.234 s)'."""
+
+    def __init__(self, label: str, logger: Optional[logging.Logger] = None,
+                 level: int = logging.INFO):
+        self.label = label
+        self.logger = logger or _default_logger
+        self.level = level
+        self.seconds: Optional[float] = None
+
+    def __enter__(self) -> "Timed":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.seconds = time.perf_counter() - self._t0
+        _TIMINGS.append((self.label, self.seconds))
+        status = "" if exc_type is None else " [FAILED]"
+        self.logger.log(self.level, "%s (%.3f s)%s", self.label,
+                        self.seconds, status)
+
+
+def timed(label: Optional[str] = None,
+          logger: Optional[logging.Logger] = None) -> Callable:
+    """Decorator form: ``@timed("phase")``."""
+
+    def wrap(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with Timed(label or fn.__qualname__, logger):
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap

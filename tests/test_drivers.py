@@ -1,0 +1,250 @@
+"""End-to-end driver tests.
+
+Mirrors the reference's GameTrainingDriverIntegTest :52 (runDriver :705
+variants: fixed-only, mixed effects, warm start, output modes, model
+sanity :572) and GameScoringDriverIntegTest — synthetic Avro fixtures
+written by our own writer, full train -> save -> load -> score round
+trips through the CLI entry points.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.io import read_avro, write_avro
+from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+
+
+def _write_game_records(path, n=400, d=8, users=6, seed=0):
+    """TrainingExampleAvro-style records with a per-user bag in metadata."""
+    rng = np.random.default_rng(seed)
+    w = rng.normal(size=d)
+    user_bias = rng.normal(size=users) * 1.5
+    recs = []
+    for i in range(n):
+        x = rng.normal(size=d)
+        u = int(rng.integers(0, users))
+        logit = x @ w + user_bias[u]
+        y = float(rng.random() < 1 / (1 + np.exp(-logit)))
+        recs.append({
+            "uid": f"s{i}",
+            "label": y,
+            "features": [{"name": "f", "term": str(j), "value": float(x[j])}
+                         for j in range(d)],
+            "metadataMap": {"userId": f"user{u}"},
+            "weight": None,
+            "offset": None,
+        })
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    write_avro(path, TRAINING_EXAMPLE_AVRO, recs)
+    return recs
+
+
+FIXED_COORD = ("name=fixed,feature.shard=global,optimizer=LBFGS,"
+               "tolerance=1e-7,max.iter=40,regularization=L2,reg.weights=1")
+USER_COORD = ("name=per_user,random.effect.type=userId,feature.shard=global,"
+              "optimizer=LBFGS,tolerance=1e-6,max.iter=30,"
+              "regularization=L2,reg.weights=10")
+
+
+def test_train_driver_fixed_only(tmp_path):
+    from photon_tpu.cli import train
+
+    data = str(tmp_path / "data" / "train.avro")
+    _write_game_records(data, seed=0)
+    val = str(tmp_path / "data" / "val.avro")
+    _write_game_records(val, seed=1)
+    out = str(tmp_path / "out")
+
+    results = train.run(train.build_arg_parser().parse_args([
+        "--input-data-directories", os.path.dirname(data),
+        "--validation-data-directories", os.path.dirname(val),
+        "--root-output-directory", out,
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--coordinate-configuration", FIXED_COORD,
+        "--coordinate-update-sequence", "fixed",
+    ]))
+    assert len(results) == 1
+    assert results[0].evaluation["AUC"] > 0.75
+    # reference layout on disk (assertModelSane analog)
+    assert os.path.exists(os.path.join(
+        out, "best", "fixed-effect", "fixed", "coefficients", "part-00000.avro"))
+    meta = json.load(open(os.path.join(out, "best", "model-metadata.json")))
+    assert meta["modelType"] == "LOGISTIC_REGRESSION"
+    ev = json.load(open(os.path.join(out, "best", "evaluation.json")))
+    assert ev["AUC"] > 0.75
+
+
+def test_train_driver_mixed_effects_sweep_and_scoring_roundtrip(tmp_path):
+    from photon_tpu.cli import score, train
+
+    data = str(tmp_path / "data" / "train.avro")
+    _write_game_records(data, n=500, seed=2)
+    out = str(tmp_path / "out")
+
+    results = train.run(train.build_arg_parser().parse_args([
+        "--input-data-directories", os.path.dirname(data),
+        "--validation-data-directories", os.path.dirname(data),
+        "--root-output-directory", out,
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--coordinate-configuration",
+        FIXED_COORD.replace("reg.weights=1", "reg.weights=0.1|10"),
+        "--coordinate-configuration", USER_COORD,
+        "--coordinate-update-sequence", "fixed,per_user",
+        "--coordinate-descent-iterations", "2",
+        "--validation-evaluators", "AUC", "AUC:userId",
+        "--output-mode", "ALL",
+    ]))
+    # cartesian sweep: 2 fixed weights x 1 user weight
+    assert len(results) == 2
+    for r in results:
+        assert "AUC:userId" in r.evaluation
+    assert os.path.isdir(os.path.join(out, "models", "0"))
+    assert os.path.isdir(os.path.join(out, "models", "1"))
+    assert os.path.isdir(os.path.join(
+        out, "best", "random-effect", "per_user", "coefficients"))
+
+    # scoring round trip: driver-loaded model reproduces training AUC
+    score_out = str(tmp_path / "scores")
+    scores = score.run(score.build_arg_parser().parse_args([
+        "--input-data-directories", os.path.dirname(data),
+        "--model-input-directory", os.path.join(out, "best"),
+        "--root-output-directory", score_out,
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--evaluators", "AUC", "AUC:userId",
+    ]))
+    assert len(scores) == 500
+    _, recs = read_avro(os.path.join(score_out, "scores", "part-00000.avro"))
+    assert len(recs) == 500
+    assert recs[0]["uid"] == "s0"
+    ev = json.load(open(os.path.join(score_out, "evaluation.json")))
+    best_auc = max(r.evaluation["AUC"] for r in results)
+    # sparsity threshold + f32 round trip cost a little AUC at most
+    assert ev["AUC"] > best_auc - 0.02
+
+
+def test_train_driver_warm_start_partial_retrain(tmp_path):
+    """Reference: partial retraining with locked coordinates
+    (GameTrainingDriverIntegTest.compareModelEvaluation semantics)."""
+    from photon_tpu.cli import train
+
+    data = str(tmp_path / "data" / "train.avro")
+    _write_game_records(data, n=500, seed=3)
+    out1 = str(tmp_path / "out1")
+    out2 = str(tmp_path / "out2")
+
+    base = [
+        "--input-data-directories", os.path.dirname(data),
+        "--validation-data-directories", os.path.dirname(data),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--coordinate-configuration", FIXED_COORD,
+        "--coordinate-configuration", USER_COORD,
+        "--coordinate-update-sequence", "fixed,per_user",
+    ]
+    r1 = train.run(train.build_arg_parser().parse_args(
+        base + ["--root-output-directory", out1]))
+    # retrain only per_user, locking fixed from the saved model
+    r2 = train.run(train.build_arg_parser().parse_args(
+        base + ["--root-output-directory", out2,
+                "--model-input-directory", os.path.join(out1, "best"),
+                "--partial-retrain-locked-coordinates", "fixed"]))
+    auc1 = r1[-1].evaluation["AUC"]
+    auc2 = r2[-1].evaluation["AUC"]
+    assert abs(auc1 - auc2) < 0.02
+
+
+def test_legacy_driver_avro(tmp_path):
+    from photon_tpu.cli import legacy
+
+    data = str(tmp_path / "data" / "train.avro")
+    _write_game_records(data, n=400, seed=4)
+    out = str(tmp_path / "out")
+    driver = legacy.main([
+        "--training-data-directory", os.path.dirname(data),
+        "--validating-data-directory", os.path.dirname(data),
+        "--output-directory", out,
+        "--task", "LOGISTIC_REGRESSION",
+        "--regularization-weights", "0.1,1,10",
+        "--normalization-type", "STANDARDIZATION",
+    ])
+    assert driver.stage.name == "VALIDATED"
+    assert driver.best_lambda in (0.1, 1.0, 10.0)
+    summary = json.load(open(os.path.join(out, "summary.json")))
+    assert summary["best_lambda"] == driver.best_lambda
+    assert all(m["AUC"] > 0.7 for m in summary["metrics"].values())
+    _, models = read_avro(os.path.join(out, "models.avro"))
+    assert len(models) == 3
+
+
+def test_feature_index_driver_roundtrip(tmp_path):
+    from photon_tpu.cli import feature_index
+    from photon_tpu.io.index_store import PartitionedIndexMap
+
+    data = str(tmp_path / "data" / "train.avro")
+    _write_game_records(data, n=50, d=5, seed=5)
+    out = str(tmp_path / "index")
+    dims = feature_index.run(feature_index.build_arg_parser().parse_args([
+        "--input-data-directories", os.path.dirname(data),
+        "--root-output-directory", out,
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--num-partitions", "3",
+    ]))
+    assert dims["global"] == 6  # 5 features + intercept
+    pim = PartitionedIndexMap(out, "global")
+    assert pim.num_partitions == 3
+    assert pim.feature_dimension == 6
+    im = pim.to_index_map()
+    assert len(im) == 6
+    # mmap lookups agree with the merged map
+    for key in im:
+        assert pim.get_index(key) == im.get_index(key)
+    assert pim.get_index("nope") == -1
+    pim.close()
+
+
+def test_name_term_bags_driver(tmp_path):
+    from photon_tpu.cli import feature_index
+
+    data = str(tmp_path / "data" / "train.avro")
+    _write_game_records(data, n=30, d=4, seed=6)
+    out = str(tmp_path / "bags")
+    counts = feature_index.run_bags(
+        feature_index.build_bags_arg_parser().parse_args([
+            "--input-data-directories", os.path.dirname(data),
+            "--root-output-directory", out,
+            "--feature-bag-keys", "features",
+        ]))
+    assert counts["features"] == 4
+    lines = open(os.path.join(out, "features")).read().splitlines()
+    assert len(lines) == 4 and lines[0].startswith("f\t")
+
+
+def test_validators_reject_bad_data(tmp_path):
+    from photon_tpu.data.validators import (
+        DataValidationError,
+        DataValidationType,
+        validate_dataframe,
+    )
+    from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+    from photon_tpu.types import TaskType
+
+    X = np.ones((4, 2))
+    df = GameDataFrame(num_samples=4, response=np.asarray([0.0, 1.0, 2.0, np.nan]),
+                       feature_shards={"g": FeatureShard(X, 2)})
+    with pytest.raises(DataValidationError) as ei:
+        validate_dataframe(df, TaskType.LOGISTIC_REGRESSION)
+    v = ei.value.violations
+    assert "binary labels" in v and "finite labels" in v
+    # poisson rejects negatives
+    df2 = GameDataFrame(num_samples=2, response=np.asarray([-1.0, 2.0]),
+                        feature_shards={"g": FeatureShard(np.ones((2, 2)), 2)})
+    with pytest.raises(DataValidationError):
+        validate_dataframe(df2, TaskType.POISSON_REGRESSION)
+    # disabled mode never raises
+    validate_dataframe(df, TaskType.LOGISTIC_REGRESSION,
+                       DataValidationType.VALIDATE_DISABLED)
