@@ -106,11 +106,14 @@ class FixedEffectCoordinate:
             batch = maybe_downsample(batch, self.task,
                                      self.config.down_sampling_rate, key)
         init = prev.model.coefficients.means if prev is not None else None
-        model, _ = self.problem.run(
+        model, result = self.problem.run(
             batch, initial=init, dim=self.dim, dtype=batch.labels.dtype,
             # read the weight from the coordinate's (possibly sweep-updated)
             # config, not the problem's construction-time copy
             regularization_weight=self.config.regularization_weight)
+        from photon_tpu.optim.tracking import OptimizationStatesTracker
+        self.last_result = result
+        self.last_tracker = OptimizationStatesTracker.from_result(result)
         from photon_tpu.types import VarianceComputationType
         if self.variance_type != VarianceComputationType.NONE:
             # reference: DistributedOptimizationProblem.run computes
@@ -182,19 +185,25 @@ class RandomEffectCoordinate:
                 hyper = Hyper(l2_weight=l2)
                 vg = lambda c: obj.value_and_gradient(c, batch, hyper)
                 if opt_type == OptimizerType.OWLQN:
-                    return owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg).coef
-                if opt_type == OptimizerType.TRON:
+                    r = owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
+                elif opt_type == OptimizerType.TRON:
                     hv = lambda c, v: obj.hessian_vector(c, v, batch, hyper)
-                    return tron.minimize(vg, hv, x0, config=solver_cfg).coef
-                return lbfgs.minimize(vg, x0, config=solver_cfg).coef
+                    r = tron.minimize(vg, hv, x0, config=solver_cfg)
+                else:
+                    r = lbfgs.minimize(vg, x0, config=solver_cfg)
+                return r.coef, r.iterations, r.reason
 
             # the dataset enters as a pytree argument, never a closure (a
             # closed-over array would be baked into the HLO as a constant);
             # the Python loop over size buckets unrolls into one program
             @jax.jit
             def solve_all(ds: RandomEffectDataset, residual_flat: Optional[Array],
-                          coef0: Array, l2: Array, l1: Array) -> Array:
+                          coef0: Array, l2: Array, l1: Array):
                 out = coef0  # entities with no active data keep warm start
+                E = coef0.shape[0]
+                # per-entity solver stats (-1 = entity never trained)
+                iters = jnp.full((E,), -1, jnp.int32)
+                reasons = jnp.full((E,), -1, jnp.int32)
                 for blk in ds.blocks:
                     offsets = blk.offsets
                     if residual_flat is not None:
@@ -203,12 +212,14 @@ class RandomEffectCoordinate:
                             mode="fill", fill_value=0.0)
                         offsets = offsets + res
                     x0 = coef0.at[blk.entity_rows].get(mode="fill", fill_value=0.0)
-                    solved = jax.vmap(solve_one,
-                                      in_axes=(0, 0, 0, 0, 0, 0, None, None))(
+                    solved, it_b, reason_b = jax.vmap(
+                        solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None))(
                         blk.features.indices, blk.features.values,
                         blk.labels, offsets, blk.weights, x0, l2, l1)
                     out = out.at[blk.entity_rows].set(solved, mode="drop")
-                return out
+                    iters = iters.at[blk.entity_rows].set(it_b, mode="drop")
+                    reasons = reasons.at[blk.entity_rows].set(reason_b, mode="drop")
+                return out, iters, reasons
 
             return solve_all
 
@@ -227,7 +238,15 @@ class RandomEffectCoordinate:
         lam = self.config.regularization_weight
         l2 = jnp.asarray(self.config.regularization.l2_weight(lam), dtype)
         l1 = jnp.asarray(self.config.regularization.l1_weight(lam), dtype)
-        coefs = self._solve_fn(self.dataset, residual_scores, coef0, l2, l1)
+        coefs, iters, reasons = self._solve_fn(self.dataset, residual_scores,
+                                               coef0, l2, l1)
+        # per-entity outcome aggregation (RandomEffectOptimizationTracker)
+        import numpy as _np
+        from photon_tpu.optim.tracking import RandomEffectOptimizationTracker
+        e_orig = self._num_entities_orig
+        self.last_tracker = RandomEffectOptimizationTracker(
+            iterations=_np.asarray(iters)[:e_orig],
+            reasons=_np.asarray(reasons)[:e_orig])
         variances = None
         from photon_tpu.types import VarianceComputationType
         if (self.variance_type != VarianceComputationType.NONE

@@ -100,8 +100,14 @@ def run_coordinate_descent(
             partial = full_score - own if own is not None else full_score
             residual = partial if len(config.update_sequence) > 1 else None
 
-            new_model = coord.update_model(models.get(cid), residual)
+            from photon_tpu.utils.timing import Timed
+            with Timed(f"CD iter {it} update {cid}", logger,
+                       level=logging.DEBUG):
+                new_model = coord.update_model(models.get(cid), residual)
             models[cid] = new_model
+            tracker = getattr(coord, "last_tracker", None)
+            if tracker is not None:
+                logger.debug("coord %s solver: %s", cid, tracker.summary())
             new_score = coord.score(new_model)
             full_score = (full_score - own + new_score) if own is not None \
                 else (full_score + new_score)

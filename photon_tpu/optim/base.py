@@ -55,6 +55,9 @@ class SolverConfig:
     # L1 (OWL-QN): per-index weight mask multiplying the l1 weight from hyper;
     # None means regularize every index.
     l1_mask: Optional[jax.Array] = None
+    # Per-iteration (loss, ||g||) ring buffer size; 0 disables tracking
+    # (reference: OptimizationStatesTracker.scala:31 keeps up to 100 states)
+    track_states: int = 0
 
 
 class SolverResult(NamedTuple):
@@ -66,6 +69,30 @@ class SolverResult(NamedTuple):
     iterations: Array          # int32
     reason: Array              # int32 ConvergenceReason
     num_fun_evals: Array       # int32 — objective evaluations (profiling)
+    # ring buffers of the last `track_states` iterations (None when off)
+    loss_history: Optional[Array] = None    # [T]
+    gnorm_history: Optional[Array] = None   # [T]
+
+
+class StateTracking(NamedTuple):
+    """While-loop carry fragment for the per-iteration ring buffer."""
+
+    loss: Array    # [T]
+    gnorm: Array   # [T]
+
+    @staticmethod
+    def init(size: int, dtype) -> Optional["StateTracking"]:
+        if size <= 0:
+            return None
+        nan = jnp.full((size,), jnp.nan, dtype)
+        return StateTracking(loss=nan, gnorm=nan)
+
+    def record(self, it: Array, f: Array, g: Array) -> "StateTracking":
+        slot = it % self.loss.shape[0]
+        return StateTracking(
+            loss=self.loss.at[slot].set(f),
+            gnorm=self.gnorm.at[slot].set(jnp.linalg.norm(g)),
+        )
 
 
 class Tolerances(NamedTuple):

@@ -27,6 +27,7 @@ from photon_tpu.optim.base import (
     ConvergenceReason,
     SolverConfig,
     SolverResult,
+    StateTracking,
     absolute_tolerances,
     convergence_reason,
     project_box,
@@ -50,6 +51,7 @@ class _Carry(NamedTuple):
     reason: Array
     n_evals: Array
     ls_failed: Array   # bool: last line search failed to decrease
+    trk: Optional[StateTracking]  # per-iteration ring buffer (None = off)
 
 
 def two_loop_direction(g, s_hist, y_hist, rho, n_pairs, head, m):
@@ -169,6 +171,7 @@ def minimize(
             it=it, reason=reason,
             n_evals=c.n_evals + ls.num_evals + (1 if has_box else 0),
             ls_failed=~decreased,
+            trk=None if c.trk is None else c.trk.record(c.it, f_kept, g_kept),
         )
 
     init = _Carry(
@@ -185,10 +188,13 @@ def minimize(
         ),
         n_evals=jnp.asarray(1, jnp.int32),
         ls_failed=jnp.asarray(False),
+        trk=StateTracking.init(config.track_states, dtype),
     )
 
     out = lax.while_loop(cond, body, init)
     return SolverResult(
         coef=out.x, value=out.f, gradient=out.g,
         iterations=out.it, reason=out.reason, num_fun_evals=out.n_evals,
+        loss_history=None if out.trk is None else out.trk.loss,
+        gnorm_history=None if out.trk is None else out.trk.gnorm,
     )

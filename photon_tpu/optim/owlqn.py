@@ -12,7 +12,7 @@ penalty.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +20,7 @@ from jax import lax
 
 from photon_tpu.optim.base import (
     ConvergenceReason,
+    StateTracking,
     SolverConfig,
     SolverResult,
     absolute_tolerances,
@@ -55,6 +56,7 @@ class _Carry(NamedTuple):
     it: Array
     reason: Array
     n_evals: Array
+    trk: Optional[StateTracking]  # per-iteration ring buffer (None = off)
 
 
 def minimize(
@@ -148,7 +150,9 @@ def minimize(
         return _Carry(x=x_kept, f=f_kept, g=g_kept, pg=pg_new, f_prev=c.f,
                       s_hist=s_hist, y_hist=y_hist, rho=rho,
                       n_pairs=n_pairs, head=head, it=it, reason=reason,
-                      n_evals=c.n_evals + k)
+                      n_evals=c.n_evals + k,
+                      trk=None if c.trk is None
+                      else c.trk.record(c.it, f_kept, pg_new))
 
     init = _Carry(
         x=x0, f=f0, g=g0, pg=pg0, f_prev=f0,
@@ -162,10 +166,13 @@ def minimize(
             jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32),
         ),
         n_evals=jnp.asarray(1, jnp.int32),
+        trk=StateTracking.init(config.track_states, dtype),
     )
 
     out = lax.while_loop(cond, body, init)
     return SolverResult(
         coef=out.x, value=out.f, gradient=out.pg,
         iterations=out.it, reason=out.reason, num_fun_evals=out.n_evals,
+        loss_history=None if out.trk is None else out.trk.loss,
+        gnorm_history=None if out.trk is None else out.trk.gnorm,
     )

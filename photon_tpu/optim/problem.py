@@ -43,7 +43,7 @@ Array = jax.Array
 def solver_cache_key(opt: "OptimizerConfig") -> tuple:
     """Everything in an OptimizerConfig that shapes a solver's trace."""
     return (opt.optimizer_type, opt.max_iterations, opt.tolerance,
-            opt.num_corrections, opt.max_cg_iterations,
+            opt.num_corrections, opt.max_cg_iterations, opt.track_states,
             jitcache.array_token(opt.lower_bounds),
             jitcache.array_token(opt.upper_bounds))
 
@@ -64,6 +64,8 @@ class OptimizerConfig:
     max_cg_iterations: int = 20
     lower_bounds: Optional[jax.Array] = None
     upper_bounds: Optional[jax.Array] = None
+    # per-iteration (loss, ||g||) ring size; 0 = no tracking
+    track_states: int = 0
 
     def solver_config(self) -> SolverConfig:
         return SolverConfig(
@@ -73,6 +75,7 @@ class OptimizerConfig:
             max_cg_iterations=self.max_cg_iterations,
             lower_bounds=self.lower_bounds,
             upper_bounds=self.upper_bounds,
+            track_states=self.track_states,
         )
 
 

@@ -1,0 +1,87 @@
+"""Host-side views over solver state tracking.
+
+Reference: photon-lib optimization/OptimizationStatesTracker.scala:31
+(ring buffer of up to 100 (coefficients, loss, ||g||, time) states with a
+convergence reason) and photon-api optimization/
+RandomEffectOptimizationTracker.scala (aggregates per-entity trackers
+into count/convergence-reason summaries logged after each coordinate
+update, CoordinateDescent.scala:242-249).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from photon_tpu.optim.base import ConvergenceReason, SolverResult
+
+
+@dataclasses.dataclass
+class OptimizationStatesTracker:
+    """Ordered per-iteration (loss, ||g||) trajectory for one solve."""
+
+    losses: np.ndarray      # [k] in iteration order
+    gnorms: np.ndarray      # [k]
+    iterations: int
+    reason: ConvergenceReason
+
+    @staticmethod
+    def from_result(result: SolverResult) -> Optional["OptimizationStatesTracker"]:
+        if result.loss_history is None:
+            return None
+        loss = np.asarray(result.loss_history)
+        gn = np.asarray(result.gnorm_history)
+        it = int(result.iterations)
+        size = loss.shape[0]
+        if it <= size:
+            order = np.arange(it)
+        else:  # un-rotate the ring buffer
+            order = np.arange(it - size, it) % size
+        losses, gnorms = loss[order], gn[order]
+        valid = np.isfinite(losses)
+        return OptimizationStatesTracker(
+            losses=losses[valid], gnorms=gnorms[valid],
+            iterations=it,
+            reason=ConvergenceReason(int(result.reason)))
+
+    def summary(self) -> str:
+        if not len(self.losses):
+            return f"converged at start ({self.reason.name})"
+        return (f"{self.iterations} iters, loss {self.losses[0]:.6g} -> "
+                f"{self.losses[-1]:.6g}, ||g|| {self.gnorms[-1]:.3g}, "
+                f"{self.reason.name}")
+
+
+@dataclasses.dataclass
+class RandomEffectOptimizationTracker:
+    """Aggregate of per-entity solver outcomes for one coordinate update."""
+
+    iterations: np.ndarray   # [E] int
+    reasons: np.ndarray      # [E] int (ConvergenceReason)
+
+    @property
+    def num_entities(self) -> int:
+        return len(self.iterations)
+
+    def reason_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in ConvergenceReason:
+            c = int(np.sum(self.reasons == int(r)))
+            if c:
+                out[r.name] = c
+        return out
+
+    def iteration_stats(self) -> Tuple[float, int, int]:
+        """(mean, min, max) iterations across entities."""
+        if not len(self.iterations):
+            return 0.0, 0, 0
+        return (float(np.mean(self.iterations)),
+                int(np.min(self.iterations)), int(np.max(self.iterations)))
+
+    def summary(self) -> str:
+        mean_it, lo, hi = self.iteration_stats()
+        return (f"{self.num_entities} entities, iterations "
+                f"mean {mean_it:.1f} [{lo}, {hi}], reasons "
+                f"{self.reason_counts()}")

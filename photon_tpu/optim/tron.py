@@ -14,7 +14,7 @@ reference's extra treeAggregate per CG step becomes an extra XLA matvec.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +22,7 @@ from jax import lax
 
 from photon_tpu.optim.base import (
     ConvergenceReason,
+    StateTracking,
     SolverConfig,
     SolverResult,
     absolute_tolerances,
@@ -103,6 +104,7 @@ class _Carry(NamedTuple):
     failures: Array
     reason: Array
     n_evals: Array
+    trk: "Optional[StateTracking]"  # per-iteration ring buffer (None = off)
 
 
 def minimize(
@@ -171,7 +173,9 @@ def minimize(
 
         return _Carry(x=x_new, f=f_new, g=g_new, f_prev=c.f, delta=delta,
                       it=it, failures=failures, reason=reason,
-                      n_evals=c.n_evals + 1)
+                      n_evals=c.n_evals + 1,
+                      trk=None if c.trk is None
+                      else c.trk.record(c.it, f_new, g_new))
 
     init = _Carry(
         x=x0, f=f0, g=g0, f_prev=f0,
@@ -184,10 +188,13 @@ def minimize(
             jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32),
         ),
         n_evals=jnp.asarray(1, jnp.int32),
+        trk=StateTracking.init(config.track_states, dtype),
     )
 
     out = lax.while_loop(cond, body, init)
     return SolverResult(
         coef=out.x, value=out.f, gradient=out.g,
         iterations=out.it, reason=out.reason, num_fun_evals=out.n_evals,
+        loss_history=None if out.trk is None else out.trk.loss,
+        gnorm_history=None if out.trk is None else out.trk.gnorm,
     )

@@ -1,0 +1,27 @@
+"""Utilities: timing, jit compile cache, events, durable logging.
+
+Replaces the reference's photon-lib util layer (Timed, PhotonLogger) and
+photon-client event system.
+"""
+
+from photon_tpu.utils.events import (
+    CollectingListener,
+    Event,
+    EventEmitter,
+    EventListener,
+    emitter,
+    optimization_log_event,
+    setup_event,
+    training_finish_event,
+    training_start_event,
+)
+from photon_tpu.utils.photon_logger import PhotonLogger, parse_level
+from photon_tpu.utils.timing import Timed, timed, timing_records, timing_summary
+
+__all__ = [
+    "Event", "EventEmitter", "EventListener", "CollectingListener", "emitter",
+    "setup_event", "training_start_event", "training_finish_event",
+    "optimization_log_event",
+    "PhotonLogger", "parse_level",
+    "Timed", "timed", "timing_records", "timing_summary",
+]

@@ -1,0 +1,96 @@
+"""Structured training events for external monitoring.
+
+Reference: photon-client event/Event.scala:27-60 (PhotonSetupEvent,
+TrainingStartEvent/FinishEvent, PhotonOptimizationLogEvent),
+event/EventEmitter.scala:9 (listener registry guarded by a lock,
+registration by class name from the CLI — Driver.scala:62-73).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """Base event: name + timestamp + payload."""
+
+    name: str
+    timestamp: float = dataclasses.field(default_factory=time.time)
+    payload: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def setup_event(**payload) -> Event:
+    return Event("PhotonSetupEvent", payload=payload)
+
+
+def training_start_event(**payload) -> Event:
+    return Event("TrainingStartEvent", payload=payload)
+
+
+def training_finish_event(**payload) -> Event:
+    return Event("TrainingFinishEvent", payload=payload)
+
+
+def optimization_log_event(**payload) -> Event:
+    return Event("PhotonOptimizationLogEvent", payload=payload)
+
+
+class EventListener:
+    """Override ``on_event``; ``close`` runs at emitter shutdown."""
+
+    def on_event(self, event: Event) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class EventEmitter:
+    """Thread-safe listener registry + dispatch (EventEmitter.scala:14-37)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._listeners: List[EventListener] = []
+
+    def register(self, listener: EventListener) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+
+    def register_by_class_name(self, class_name: str) -> None:
+        """Reference: listeners registered by fully-qualified class name
+        from the CLI (Driver.scala:62-73)."""
+        module, _, cls = class_name.rpartition(".")
+        listener_cls = getattr(importlib.import_module(module), cls)
+        self.register(listener_cls())
+
+    def emit(self, event: Event) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for l in listeners:
+            l.on_event(event)
+
+    def close(self) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+            self._listeners.clear()
+        for l in listeners:
+            l.close()
+
+
+class CollectingListener(EventListener):
+    """Test/debug listener that records every event."""
+
+    def __init__(self):
+        self.events: List[Event] = []
+
+    def on_event(self, event: Event) -> None:
+        self.events.append(event)
+
+
+# default process-wide emitter (drivers emit here)
+emitter = EventEmitter()

@@ -1,0 +1,80 @@
+"""Durable driver logging: a leveled logger that also writes to a file.
+
+Reference: photon-lib util/PhotonLogger.scala:28 — an SLF4J-style logger
+buffering to a local temp file and flushing to an HDFS path, so the
+driver log survives the cluster; log level settable from the CLI
+(GameDriver.scala:106).
+
+Here: a standard-library logger wired with a file handler under the
+job's output directory (the durable store), plus helpers to set levels
+by name and flush handlers.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+_LEVELS = {
+    "TRACE": logging.DEBUG,
+    "DEBUG": logging.DEBUG,
+    "INFO": logging.INFO,
+    "WARN": logging.WARNING,
+    "WARNING": logging.WARNING,
+    "ERROR": logging.ERROR,
+}
+
+_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+
+
+def parse_level(name: str) -> int:
+    try:
+        return _LEVELS[name.upper()]
+    except KeyError:
+        raise ValueError(f"unknown log level {name!r} "
+                         f"(one of {sorted(_LEVELS)})") from None
+
+
+class PhotonLogger:
+    """File + console logger for one driver run."""
+
+    def __init__(self, output_dir: str, name: str = "photon_tpu",
+                 level: str = "INFO", filename: str = "driver.log"):
+        os.makedirs(output_dir, exist_ok=True)
+        self.path = os.path.join(output_dir, filename)
+        self.logger = logging.getLogger(name)
+        self.logger.setLevel(parse_level(level))
+        self._handler = logging.FileHandler(self.path)
+        self._handler.setFormatter(logging.Formatter(_FORMAT))
+        self.logger.addHandler(self._handler)
+
+    def set_level(self, level: str) -> None:
+        self.logger.setLevel(parse_level(level))
+
+    # pass-throughs
+    def debug(self, *a, **k):
+        self.logger.debug(*a, **k)
+
+    def info(self, *a, **k):
+        self.logger.info(*a, **k)
+
+    def warning(self, *a, **k):
+        self.logger.warning(*a, **k)
+
+    def error(self, *a, **k):
+        self.logger.error(*a, **k)
+
+    def flush(self) -> None:
+        self._handler.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self.logger.removeHandler(self._handler)
+        self._handler.close()
+
+    def __enter__(self) -> "PhotonLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,173 @@
+"""Observability tests: state tracking, trackers, timing, events, logger.
+
+Reference coverage model: OptimizationStatesTrackerTest (ring buffer
+semantics), RandomEffectOptimizationTracker summaries, Timed blocks,
+EventEmitter listener dispatch.
+"""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from photon_tpu.optim import lbfgs, tron
+from photon_tpu.optim.base import ConvergenceReason, SolverConfig
+from photon_tpu.optim.tracking import (
+    OptimizationStatesTracker,
+    RandomEffectOptimizationTracker,
+)
+
+
+def _quadratic(center):
+    def vg(x):
+        d = x - center
+        return 0.5 * jnp.dot(d, d), d
+    return vg
+
+
+def test_lbfgs_tracks_states():
+    center = jnp.asarray(np.arange(1.0, 6.0))
+    res = lbfgs.minimize(_quadratic(center), jnp.zeros(5),
+                         config=SolverConfig(max_iterations=50,
+                                             tolerance=1e-10,
+                                             track_states=100))
+    trk = OptimizationStatesTracker.from_result(res)
+    assert trk is not None
+    assert trk.iterations == int(res.iterations)
+    assert len(trk.losses) == trk.iterations
+    # losses strictly decrease for a quadratic under L-BFGS
+    assert np.all(np.diff(trk.losses) <= 1e-12)
+    assert trk.losses[-1] == pytest.approx(float(res.value))
+    assert "iters" in trk.summary()
+
+
+def test_tracking_ring_buffer_wraps():
+    """More iterations than slots: the tracker un-rotates the ring."""
+    center = jnp.asarray(np.linspace(-2, 2, 30))
+
+    def slow_vg(x):  # gradient descent-ish progress via tiny curvature mix
+        d = x - center
+        return 0.5 * jnp.dot(d, d) + 1e-4 * jnp.sum(jnp.cos(x)), \
+            d - 1e-4 * jnp.sin(x)
+
+    res = lbfgs.minimize(slow_vg, jnp.zeros(30),
+                         config=SolverConfig(max_iterations=40,
+                                             tolerance=1e-14,
+                                             track_states=8))
+    trk = OptimizationStatesTracker.from_result(res)
+    if trk.iterations > 8:
+        assert len(trk.losses) == 8
+        assert np.all(np.diff(trk.losses) <= 1e-9)  # ordered oldest->newest
+        assert trk.losses[-1] == pytest.approx(float(res.value), rel=1e-6)
+
+
+def test_tracking_off_by_default():
+    res = lbfgs.minimize(_quadratic(jnp.ones(3)), jnp.zeros(3))
+    assert res.loss_history is None
+    assert OptimizationStatesTracker.from_result(res) is None
+
+
+def test_tron_tracks_states():
+    center = jnp.asarray([1.0, -2.0, 0.5])
+    vg = _quadratic(center)
+    hv = lambda x, v: v
+    res = tron.minimize(vg, hv, jnp.zeros(3),
+                        config=SolverConfig(max_iterations=15, tolerance=1e-8,
+                                            track_states=20))
+    trk = OptimizationStatesTracker.from_result(res)
+    assert trk is not None and len(trk.losses) >= 1
+    assert trk.losses[-1] == pytest.approx(float(res.value))
+
+
+def test_random_effect_tracker_aggregation():
+    trk = RandomEffectOptimizationTracker(
+        iterations=np.asarray([3, 5, 0, -1]),
+        reasons=np.asarray([int(ConvergenceReason.GRADIENT_CONVERGED),
+                            int(ConvergenceReason.FUNCTION_VALUES_CONVERGED),
+                            int(ConvergenceReason.GRADIENT_CONVERGED),
+                            -1]))
+    counts = trk.reason_counts()
+    assert counts["GRADIENT_CONVERGED"] == 2
+    assert counts["FUNCTION_VALUES_CONVERGED"] == 1
+    mean_it, lo, hi = trk.iteration_stats()
+    assert (lo, hi) == (-1, 5)
+    assert "entities" in trk.summary()
+
+
+def test_re_coordinate_exposes_tracker():
+    from photon_tpu.game.coordinate import RandomEffectCoordinate
+    from photon_tpu.game.dataset import EntityVocabulary, FeatureShard, GameDataFrame
+    from photon_tpu.game.random_effect import (
+        RandomEffectDataConfiguration,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(0)
+    n, users, d = 120, 5, 3
+    rows = [(np.arange(d, dtype=np.int32), rng.normal(size=d)) for _ in range(n)]
+    df = GameDataFrame(
+        num_samples=n, response=(rng.random(n) < 0.5).astype(float),
+        feature_shards={"u": FeatureShard(rows, d)},
+        id_tags={"userId": [f"u{i % users}" for i in range(n)]})
+    vocab = EntityVocabulary()
+    ds = build_random_effect_dataset(df, RandomEffectDataConfiguration("userId", "u"), vocab)
+    coord = RandomEffectCoordinate(ds, n, "userId", "u",
+                                   TaskType.LOGISTIC_REGRESSION)
+    coord.update_model(None, None)
+    trk = coord.last_tracker
+    assert trk.num_entities == users
+    assert np.all(trk.iterations >= 0)  # every entity trained
+    assert sum(trk.reason_counts().values()) == users
+
+
+def test_timed_records_and_summary():
+    from photon_tpu.utils.timing import Timed, clear_timings, timing_records, timing_summary
+
+    clear_timings()
+    with Timed("phase-a"):
+        pass
+    with Timed("phase-b"):
+        pass
+    recs = timing_records()
+    assert [r[0] for r in recs] == ["phase-a", "phase-b"]
+    assert all(r[1] >= 0 for r in recs)
+    assert "phase-a" in timing_summary()
+
+
+def test_event_emitter_dispatch_and_class_registration():
+    from photon_tpu.utils.events import (
+        CollectingListener,
+        EventEmitter,
+        optimization_log_event,
+        training_start_event,
+    )
+
+    em = EventEmitter()
+    lst = CollectingListener()
+    em.register(lst)
+    em.register_by_class_name("photon_tpu.utils.events.CollectingListener")
+    em.emit(training_start_event(task="LOGISTIC_REGRESSION"))
+    em.emit(optimization_log_event(loss=0.5))
+    assert [e.name for e in lst.events] == ["TrainingStartEvent",
+                                            "PhotonOptimizationLogEvent"]
+    assert lst.events[0].payload["task"] == "LOGISTIC_REGRESSION"
+    em.close()
+    em.emit(training_start_event())  # listeners cleared: no error, no delivery
+    assert len(lst.events) == 2
+
+
+def test_photon_logger_writes_file(tmp_path):
+    from photon_tpu.utils.photon_logger import PhotonLogger, parse_level
+
+    out = str(tmp_path / "job")
+    with PhotonLogger(out, name="photon_tpu.test", level="DEBUG") as pl:
+        pl.info("hello %s", "world")
+        pl.debug("debug line")
+    text = open(os.path.join(out, "driver.log")).read()
+    assert "hello world" in text and "debug line" in text
+    assert parse_level("WARN") == logging.WARNING
+    with pytest.raises(ValueError):
+        parse_level("NOPE")
