@@ -1,0 +1,49 @@
+"""Model diagnostics: bootstrap CIs, calibration, learning curves,
+feature importance, independence analysis, report rendering.
+
+Replaces the reference's photon-diagnostics module.
+"""
+
+from photon_tpu.diagnostics.bootstrap import (
+    CoefficientSummary,
+    aggregate_coefficient_confidence_intervals,
+    aggregate_metrics_confidence_intervals,
+    bootstrap_training,
+    bootstrap_weights,
+)
+from photon_tpu.diagnostics.fitting import FittingReport, fitting_diagnostic
+from photon_tpu.diagnostics.hl import (
+    HosmerLemeshowBin,
+    HosmerLemeshowReport,
+    hosmer_lemeshow,
+)
+from photon_tpu.diagnostics.importance import (
+    FeatureImportanceReport,
+    expected_magnitude_importance,
+    variance_importance,
+)
+from photon_tpu.diagnostics.independence import KendallTauReport, kendall_tau
+from photon_tpu.diagnostics.reporting import (
+    BulletedList,
+    Chapter,
+    Document,
+    NumberedList,
+    Section,
+    SimpleText,
+    Table,
+    render_html,
+    render_text,
+)
+
+__all__ = [
+    "CoefficientSummary", "bootstrap_training", "bootstrap_weights",
+    "aggregate_coefficient_confidence_intervals",
+    "aggregate_metrics_confidence_intervals",
+    "FittingReport", "fitting_diagnostic",
+    "HosmerLemeshowBin", "HosmerLemeshowReport", "hosmer_lemeshow",
+    "FeatureImportanceReport", "expected_magnitude_importance",
+    "variance_importance",
+    "KendallTauReport", "kendall_tau",
+    "Document", "Chapter", "Section", "SimpleText", "BulletedList",
+    "NumberedList", "Table", "render_text", "render_html",
+]

@@ -1,0 +1,74 @@
+"""Fitting (learning-curve) diagnostic.
+
+Reference: photon-diagnostics diagnostics/fitting/FittingDiagnostic
+.scala:33 — train on growing fractions of the data, record the train and
+holdout metric per fraction; diverging curves diagnose over/under-fit.
+
+TPU re-design: a "fraction" is a prefix mask over a fixed permutation, so
+every sub-training reuses the same compiled solve with a masked weight
+vector — no data subsetting, no recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.dataset import DataBatch
+
+Array = jax.Array
+
+DEFAULT_FRACTIONS = (0.1, 0.25, 0.5, 0.75, 1.0)
+
+
+@dataclasses.dataclass
+class FittingReport:
+    fractions: List[float]
+    train_metrics: Dict[str, List[float]]
+    test_metrics: Dict[str, List[float]]
+
+    def summary(self) -> str:
+        parts = []
+        for name in self.train_metrics:
+            parts.append(
+                f"{name}: train {self.train_metrics[name][-1]:.4f} / "
+                f"test {self.test_metrics[name][-1]:.4f} at full data")
+        return "; ".join(parts)
+
+
+def fitting_diagnostic(
+    batch: DataBatch,
+    train_model: Callable[[DataBatch], object],
+    evaluate: Callable[[object, str], Dict[str, float]],
+    fractions: Sequence[float] = DEFAULT_FRACTIONS,
+    seed: int = 0,
+) -> FittingReport:
+    """``train_model(masked_batch) -> model``;
+    ``evaluate(model, split) -> {metric: value}`` with split in
+    {"train", "test"}."""
+    n = batch.num_samples
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    base_w = (np.asarray(batch.weights) if batch.weights is not None
+              else np.ones(n))
+
+    train_out: Dict[str, List[float]] = {}
+    test_out: Dict[str, List[float]] = {}
+    used: List[float] = []
+    for frac in fractions:
+        k = max(int(frac * n), 1)
+        mask = np.zeros(n)
+        mask[perm[:k]] = 1.0
+        masked = DataBatch(batch.features, batch.labels, batch.offsets,
+                           jnp.asarray(base_w * mask, batch.labels.dtype))
+        model = train_model(masked)
+        used.append(frac)
+        for split, out in (("train", train_out), ("test", test_out)):
+            for name, v in evaluate(model, split).items():
+                out.setdefault(name, []).append(float(v))
+    return FittingReport(fractions=used, train_metrics=train_out,
+                         test_metrics=test_out)

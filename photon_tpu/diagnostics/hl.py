@@ -1,0 +1,118 @@
+"""Hosmer-Lemeshow calibration diagnostic for logistic models.
+
+Reference: photon-diagnostics diagnostics/hl/HosmerLemeshowDiagnostic
+.scala:29 — bin samples by predicted probability, chi^2 over
+(observed - expected) positive AND negative counts per bin, degrees of
+freedom = bins - 2, p-value + standard confidence cutoffs; bins with
+expected counts below a minimum are flagged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import numpy as np
+from scipy.stats import chi2 as _chi2
+
+MINIMUM_EXPECTED_IN_BUCKET = 5.0
+CONFIDENCE_CUTOFFS = (0.90, 0.95, 0.99, 0.99999999)
+
+
+@dataclasses.dataclass
+class HosmerLemeshowBin:
+    """One predicted-probability bin (reference:
+    PredictedProbabilityVersusObservedFrequencyHistogramBin)."""
+
+    lower: float
+    upper: float
+    count: int
+    observed_pos: float
+    expected_pos: float
+
+    @property
+    def observed_neg(self) -> float:
+        return self.count - self.observed_pos
+
+    @property
+    def expected_neg(self) -> float:
+        return self.count - self.expected_pos
+
+    @property
+    def chi_square_term(self) -> float:
+        d = 0.0
+        if self.expected_pos > 0:
+            d += (self.observed_pos - self.expected_pos) ** 2 / self.expected_pos
+        if self.expected_neg > 0:
+            d += (self.observed_neg - self.expected_neg) ** 2 / self.expected_neg
+        return d
+
+    @property
+    def too_small(self) -> bool:
+        return (self.expected_pos < MINIMUM_EXPECTED_IN_BUCKET
+                or self.expected_neg < MINIMUM_EXPECTED_IN_BUCKET)
+
+
+@dataclasses.dataclass
+class HosmerLemeshowReport:
+    bins: List[HosmerLemeshowBin]
+    chi_square: float
+    degrees_of_freedom: int
+    p_value: float                      # P[chi2 >= observed] under H0
+    cutoffs: dict                       # confidence -> chi2 threshold
+    warnings: List[str]
+
+    @property
+    def well_calibrated(self, confidence: float = 0.95) -> bool:
+        return self.chi_square <= self.cutoffs[0.95]
+
+    def summary(self) -> str:
+        return (f"HL chi2 = {self.chi_square:.3f} on {self.degrees_of_freedom} "
+                f"d.o.f. (P[>=] = {self.p_value:.4g}); "
+                f"{len(self.warnings)} bin warning(s)")
+
+
+def hosmer_lemeshow(
+    labels: np.ndarray,
+    predicted_probabilities: np.ndarray,
+    num_bins: int = 10,
+    weights: Optional[np.ndarray] = None,
+) -> HosmerLemeshowReport:
+    """Equal-frequency (decile) binning by predicted probability."""
+    labels = np.asarray(labels, float)
+    p = np.asarray(predicted_probabilities, float)
+    w = np.ones_like(p) if weights is None else np.asarray(weights, float)
+
+    order = np.argsort(p, kind="stable")
+    p_s, y_s, w_s = p[order], labels[order], w[order]
+    edges = np.linspace(0, len(p), num_bins + 1).astype(int)
+
+    bins: List[HosmerLemeshowBin] = []
+    warnings: List[str] = []
+    for b in range(num_bins):
+        lo, hi = edges[b], edges[b + 1]
+        if hi <= lo:
+            continue
+        wb = w_s[lo:hi]
+        bins.append(HosmerLemeshowBin(
+            lower=float(p_s[lo]), upper=float(p_s[hi - 1]),
+            count=float(np.sum(wb)),
+            observed_pos=float(np.sum(wb * (y_s[lo:hi] > 0.5))),
+            expected_pos=float(np.sum(wb * p_s[lo:hi])),
+        ))
+        if bins[-1].too_small:
+            warnings.append(
+                f"bin [{bins[-1].lower:.3f}, {bins[-1].upper:.3f}]: expected "
+                f"count too small for a sound chi^2 estimate")
+
+    chi_sq = float(sum(b.chi_square_term for b in bins))
+    dof = max(len(bins) - 2, 1)
+    dist = _chi2(dof)
+    return HosmerLemeshowReport(
+        bins=bins,
+        chi_square=chi_sq,
+        degrees_of_freedom=dof,
+        p_value=float(dist.sf(chi_sq)),
+        cutoffs={c: float(dist.ppf(c)) for c in CONFIDENCE_CUTOFFS},
+        warnings=warnings,
+    )

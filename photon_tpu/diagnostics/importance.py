@@ -1,0 +1,80 @@
+"""Feature importance diagnostics.
+
+Reference: photon-diagnostics diagnostics/featureimportance/
+ExpectedMagnitudeFeatureImportanceDiagnostic.scala (importance =
+|w_j| * E[|x_j|] when a feature summary exists, else |w_j|) and
+VarianceFeatureImportanceDiagnostic (|w_j| * sd(x_j)); importances are
+ranked descending and bucketed into rank fractions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from photon_tpu.data.stats import FeatureDataStatistics
+
+MAX_RANKED_FEATURES = 15
+
+
+@dataclasses.dataclass
+class FeatureImportanceReport:
+    importance_type: str
+    description: str
+    # (feature key, column, importance), descending importance
+    ranked: List[Tuple[str, int, float]]
+    # rank fraction (0-1) -> importance value at that rank
+    rank_to_importance: Dict[float, float]
+
+    def top(self, k: int = MAX_RANKED_FEATURES):
+        return self.ranked[:k]
+
+
+def _report(kind: str, description: str, importances: np.ndarray,
+            names: Optional[List[str]]) -> FeatureImportanceReport:
+    order = np.argsort(-importances, kind="stable")
+    ranked = [(names[j] if names else str(j), int(j), float(importances[j]))
+              for j in order]
+    fractions = np.linspace(0.0, 1.0, 11)
+    rank_to_imp = {
+        float(f): float(importances[order[min(int(f * (len(order) - 1)),
+                                              len(order) - 1)]])
+        for f in fractions} if len(order) else {}
+    return FeatureImportanceReport(kind, description, ranked, rank_to_imp)
+
+
+def expected_magnitude_importance(
+    coefficients: np.ndarray,
+    summary: Optional[FeatureDataStatistics] = None,
+    feature_names: Optional[List[str]] = None,
+) -> FeatureImportanceReport:
+    """|w_j| * E[|x_j|] (mean magnitude approximated by |mean| + sd, as the
+    reference uses the summary's expected absolute value when present)."""
+    w = np.abs(np.asarray(coefficients, float))
+    if summary is not None:
+        exp_abs = np.abs(np.asarray(summary.mean)) + np.sqrt(
+            np.maximum(np.asarray(summary.variance), 0))
+        imp = w * exp_abs
+        desc = "Expected magnitude of inner product contribution"
+    else:
+        imp = w
+        desc = "Magnitude of feature coefficient"
+    return _report("Inner product expectation", desc, imp, feature_names)
+
+
+def variance_importance(
+    coefficients: np.ndarray,
+    summary: Optional[FeatureDataStatistics] = None,
+    feature_names: Optional[List[str]] = None,
+) -> FeatureImportanceReport:
+    """|w_j| * sd(x_j): contribution to score variance."""
+    w = np.abs(np.asarray(coefficients, float))
+    if summary is not None:
+        imp = w * np.sqrt(np.maximum(np.asarray(summary.variance), 0))
+        desc = "Contribution to score standard deviation"
+    else:
+        imp = w
+        desc = "Magnitude of feature coefficient"
+    return _report("Variance", desc, imp, feature_names)

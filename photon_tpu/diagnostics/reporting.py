@@ -1,0 +1,141 @@
+"""Logical report tree + text/HTML renderers.
+
+Reference: photon-diagnostics diagnostics/reporting/ — a LogicalReport
+tree (Document -> Chapter -> Section -> items: SimpleText, bulleted /
+numbered lists, tables) rendered by pluggable strategies with HTML
+(reporting/html/*.scala) and text (reporting/text/*.scala) backends.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import html as _html
+from typing import List, Sequence, Union
+
+
+@dataclasses.dataclass
+class SimpleText:
+    text: str
+
+
+@dataclasses.dataclass
+class BulletedList:
+    items: List[str]
+
+
+@dataclasses.dataclass
+class NumberedList:
+    items: List[str]
+
+
+@dataclasses.dataclass
+class Table:
+    header: List[str]
+    rows: List[Sequence]
+    caption: str = ""
+
+
+ReportItem = Union[SimpleText, BulletedList, NumberedList, Table]
+
+
+@dataclasses.dataclass
+class Section:
+    title: str
+    items: List[ReportItem] = dataclasses.field(default_factory=list)
+
+    def add(self, item: ReportItem) -> "Section":
+        self.items.append(item)
+        return self
+
+
+@dataclasses.dataclass
+class Chapter:
+    title: str
+    sections: List[Section] = dataclasses.field(default_factory=list)
+
+    def add(self, section: Section) -> "Chapter":
+        self.sections.append(section)
+        return self
+
+
+@dataclasses.dataclass
+class Document:
+    title: str
+    chapters: List[Chapter] = dataclasses.field(default_factory=list)
+
+    def add(self, chapter: Chapter) -> "Document":
+        self.chapters.append(chapter)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# renderers
+# ---------------------------------------------------------------------------
+
+
+def _render_item_text(item: ReportItem, out: List[str]) -> None:
+    if isinstance(item, SimpleText):
+        out.append(item.text)
+    elif isinstance(item, BulletedList):
+        out.extend(f"  * {x}" for x in item.items)
+    elif isinstance(item, NumberedList):
+        out.extend(f"  {i + 1}. {x}" for i, x in enumerate(item.items))
+    elif isinstance(item, Table):
+        if item.caption:
+            out.append(item.caption)
+        widths = [max(len(str(h)), *(len(str(r[j])) for r in item.rows))
+                  if item.rows else len(str(h))
+                  for j, h in enumerate(item.header)]
+        fmt = " | ".join(f"{{:<{w}}}" for w in widths)
+        out.append(fmt.format(*item.header))
+        out.append("-+-".join("-" * w for w in widths))
+        out.extend(fmt.format(*(str(c) for c in r)) for r in item.rows)
+    else:
+        out.append(str(item))
+
+
+def render_text(doc: Document) -> str:
+    out: List[str] = [doc.title, "=" * len(doc.title), ""]
+    for ch in doc.chapters:
+        out += [ch.title, "-" * len(ch.title)]
+        for sec in ch.sections:
+            out += ["", f"## {sec.title}"]
+            for item in sec.items:
+                _render_item_text(item, out)
+        out.append("")
+    return "\n".join(out)
+
+
+def _render_item_html(item: ReportItem, out: List[str]) -> None:
+    esc = _html.escape
+    if isinstance(item, SimpleText):
+        out.append(f"<p>{esc(item.text)}</p>")
+    elif isinstance(item, BulletedList):
+        out.append("<ul>" + "".join(f"<li>{esc(x)}</li>" for x in item.items)
+                   + "</ul>")
+    elif isinstance(item, NumberedList):
+        out.append("<ol>" + "".join(f"<li>{esc(x)}</li>" for x in item.items)
+                   + "</ol>")
+    elif isinstance(item, Table):
+        rows = "".join(
+            "<tr>" + "".join(f"<td>{esc(str(c))}</td>" for c in r) + "</tr>"
+            for r in item.rows)
+        head = "<tr>" + "".join(f"<th>{esc(h)}</th>" for h in item.header) + "</tr>"
+        cap = f"<caption>{esc(item.caption)}</caption>" if item.caption else ""
+        out.append(f"<table border='1'>{cap}{head}{rows}</table>")
+    else:
+        out.append(f"<p>{esc(str(item))}</p>")
+
+
+def render_html(doc: Document) -> str:
+    esc = _html.escape
+    out = [f"<html><head><title>{esc(doc.title)}</title></head><body>",
+           f"<h1>{esc(doc.title)}</h1>"]
+    for ch in doc.chapters:
+        out.append(f"<h2>{esc(ch.title)}</h2>")
+        for sec in ch.sections:
+            out.append(f"<h3>{esc(sec.title)}</h3>")
+            for item in sec.items:
+                _render_item_html(item, out)
+    out.append("</body></html>")
+    return "\n".join(out)

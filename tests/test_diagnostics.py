@@ -1,0 +1,229 @@
+"""Diagnostics tests vs scipy/analytic oracles.
+
+Mirrors photon-diagnostics test coverage: BootstrapTrainingTest,
+HosmerLemeshowDiagnosticTest, KendallTauAnalysisTest,
+FeatureImportanceDiagnosticTest, FittingDiagnostic + report renderers.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from photon_tpu.diagnostics import (
+    BulletedList,
+    Chapter,
+    CoefficientSummary,
+    Document,
+    Section,
+    SimpleText,
+    Table,
+    bootstrap_training,
+    bootstrap_weights,
+    expected_magnitude_importance,
+    fitting_diagnostic,
+    hosmer_lemeshow,
+    kendall_tau,
+    render_html,
+    render_text,
+    variance_importance,
+)
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.types import TaskType
+
+
+# -- bootstrap ---------------------------------------------------------------
+
+
+def test_coefficient_summary_stats():
+    s = CoefficientSummary(np.asarray([1.0, 2.0, 3.0, 4.0]))
+    assert s.mean == pytest.approx(2.5)
+    assert s.min == 1.0 and s.max == 4.0
+    assert s.median in (3.0, 2.0)  # index-based quantile like the reference
+    assert s.count == 4
+    assert "Mean" in str(s)
+
+
+def test_bootstrap_weights_shape_and_mass():
+    w = bootstrap_weights(jnp.asarray(np.asarray([0, 1], np.uint32)), 5, 100,
+                          portion=0.8)
+    assert w.shape == (5, 100)
+    np.testing.assert_allclose(np.asarray(w).sum(axis=1), 80)
+
+
+def test_bootstrap_training_recovers_coefficients():
+    rng = np.random.default_rng(0)
+    n, d = 400, 4
+    w_true = np.asarray([2.0, -1.0, 0.5, 0.0])
+    X = rng.normal(size=(n, d))
+    y = X @ w_true + 0.1 * rng.normal(size=n)
+    batch = DataBatch(jnp.asarray(X), jnp.asarray(y))
+    out = bootstrap_training(TaskType.LINEAR_REGRESSION, batch, d,
+                             num_bootstrap_samples=12, seed=1)
+    assert out["models"].shape == (12, d)
+    summaries = out["coefficients"]
+    for j in range(d):
+        # true coefficient within the bootstrap spread
+        spread = 5 * max(summaries[j].std_dev, 0.02)
+        assert abs(summaries[j].mean - w_true[j]) < spread
+    # replicas differ (resampling actually happened)
+    assert np.std(out["models"][:, 0]) > 1e-4
+
+
+def test_bootstrap_metric_aggregation():
+    rng = np.random.default_rng(1)
+    n, d = 200, 3
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) < 0.5).astype(float)
+    batch = DataBatch(jnp.asarray(X), jnp.asarray(y))
+
+    def ev(coef):
+        return {"norm": float(jnp.linalg.norm(coef))}
+
+    out = bootstrap_training(TaskType.LOGISTIC_REGRESSION, batch, d,
+                             num_bootstrap_samples=5, l2_weight=1.0,
+                             evaluate_fn=ev, seed=2)
+    assert "norm" in out["metrics"]
+    assert out["metrics"]["norm"].count == 5
+
+
+# -- Hosmer-Lemeshow ---------------------------------------------------------
+
+
+def test_hl_well_calibrated_model_passes():
+    rng = np.random.default_rng(3)
+    n = 5000
+    p = rng.uniform(0.05, 0.95, size=n)
+    y = (rng.random(n) < p).astype(float)
+    rep = hosmer_lemeshow(y, p, num_bins=10)
+    assert rep.degrees_of_freedom == 8
+    # calibrated: chi2 below the 99% cutoff almost surely
+    assert rep.chi_square < rep.cutoffs[0.99]
+    assert 0.0 <= rep.p_value <= 1.0
+    assert len(rep.bins) == 10
+    assert "chi2" in rep.summary()
+
+
+def test_hl_miscalibrated_model_fails():
+    rng = np.random.default_rng(4)
+    n = 5000
+    p = rng.uniform(0.05, 0.95, size=n)
+    y = (rng.random(n) < p ** 2).astype(float)  # systematically over-predicted
+    rep = hosmer_lemeshow(y, p, num_bins=10)
+    assert rep.chi_square > rep.cutoffs[0.99999999]
+    assert rep.p_value < 1e-6
+
+
+def test_hl_counts_conserve_mass():
+    rng = np.random.default_rng(5)
+    p = rng.uniform(size=1000)
+    y = (rng.random(1000) < 0.3).astype(float)
+    rep = hosmer_lemeshow(y, p)
+    assert sum(b.count for b in rep.bins) == pytest.approx(1000)
+    assert sum(b.observed_pos for b in rep.bins) == pytest.approx(y.sum())
+
+
+# -- Kendall tau -------------------------------------------------------------
+
+
+def test_kendall_tau_matches_scipy():
+    from scipy.stats import kendalltau
+
+    rng = np.random.default_rng(6)
+    a = rng.normal(size=300)
+    b = 0.6 * a + 0.4 * rng.normal(size=300)
+    rep = kendall_tau(a, b)
+    ref_tau, _ = kendalltau(a, b)
+    assert rep.tau_beta == pytest.approx(ref_tau, abs=1e-10)
+    assert rep.num_items == 300
+    assert rep.z_alpha > 3  # clearly dependent
+
+
+def test_kendall_tau_independent():
+    rng = np.random.default_rng(7)
+    rep = kendall_tau(rng.normal(size=400), rng.normal(size=400))
+    assert abs(rep.tau_alpha) < 0.1
+    assert rep.p_value < 0.99  # inside-mass not extreme
+
+
+def test_kendall_tau_tie_reporting():
+    a = np.asarray([1.0, 1.0, 2.0, 3.0])
+    b = np.asarray([1.0, 2.0, 2.0, 3.0])
+    rep = kendall_tau(a, b)
+    assert rep.num_ties_a == 1 and rep.num_ties_b == 1
+    assert "ties" in rep.message
+
+
+# -- feature importance ------------------------------------------------------
+
+
+def test_feature_importance_ordering():
+    from photon_tpu.data.stats import compute_feature_stats
+
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(500, 3)) * np.asarray([1.0, 10.0, 0.1])
+    stats = compute_feature_stats(jnp.asarray(X), 3)
+    coef = np.asarray([1.0, 1.0, 1.0])
+    rep = variance_importance(coef, stats, feature_names=["a", "b", "c"])
+    assert rep.ranked[0][0] == "b"   # largest sd dominates
+    assert rep.ranked[-1][0] == "c"
+    rep2 = expected_magnitude_importance(coef, None)
+    assert all(v == 1.0 for _, _, v in rep2.ranked)
+    assert 0.0 in rep.rank_to_importance and 1.0 in rep.rank_to_importance
+
+
+# -- fitting diagnostic ------------------------------------------------------
+
+
+def test_fitting_diagnostic_learning_curve():
+    from photon_tpu.optim.problem import GlmOptimizationProblem
+
+    rng = np.random.default_rng(9)
+    n, d = 600, 5
+    w_true = rng.normal(size=d)
+    X = rng.normal(size=(n, d))
+    y = X @ w_true + 0.5 * rng.normal(size=n)
+    Xt = rng.normal(size=(200, d))
+    yt = Xt @ w_true + 0.5 * rng.normal(size=200)
+    batch = DataBatch(jnp.asarray(X), jnp.asarray(y))
+    prob = GlmOptimizationProblem(TaskType.LINEAR_REGRESSION)
+
+    def train(masked):
+        model, _ = prob.run(masked, dim=d, dtype=masked.labels.dtype)
+        return model
+
+    def evaluate(model, split):
+        Xe, ye = (X, y) if split == "train" else (Xt, yt)
+        pred = np.asarray(model.compute_score(jnp.asarray(Xe)))
+        return {"rmse": float(np.sqrt(np.mean((pred - ye) ** 2)))}
+
+    rep = fitting_diagnostic(batch, train, evaluate,
+                             fractions=(0.1, 0.5, 1.0), seed=0)
+    assert rep.fractions == [0.1, 0.5, 1.0]
+    # test error improves (weakly) with more data
+    assert rep.test_metrics["rmse"][-1] <= rep.test_metrics["rmse"][0] + 0.05
+    assert "rmse" in rep.summary()
+
+
+# -- reporting ---------------------------------------------------------------
+
+
+def test_report_renderers():
+    doc = Document("Model report").add(
+        Chapter("Diagnostics").add(
+            Section("Calibration")
+            .add(SimpleText("chi2 = 3.2"))
+            .add(BulletedList(["bin 1 ok", "bin 2 ok"]))
+            .add(Table(["name", "value"], [["AUC", 0.91], ["RMSE", 0.3]],
+                       caption="metrics"))))
+    text = render_text(doc)
+    assert "Model report" in text and "chi2 = 3.2" in text
+    assert "* bin 1 ok" in text and "AUC" in text
+    html = render_html(doc)
+    assert html.startswith("<html>") and "<table" in html
+    assert "<li>bin 2 ok</li>" in html
+    # escaping
+    doc2 = Document("<script>").add(Chapter("c").add(
+        Section("s").add(SimpleText("a < b"))))
+    html2 = render_html(doc2)
+    assert "<script>" not in html2.replace("&lt;script&gt;", "")
+    assert "a &lt; b" in html2
