@@ -248,6 +248,50 @@ class GameEstimator:
         return results
 
 
+def persistable_artifacts(estimator: "GameEstimator", model: GameModel):
+    """(model, projections) ready for model IO: coordinates trained under a
+    RANDOM projector are back-projected into the original feature space
+    (reference: Projector.projectCoefficients) so their coefficients can be
+    written as (name, term, value) records."""
+    import numpy as np
+
+    from photon_tpu.game.model import RandomEffectModel
+
+    projections = {cid: np.asarray(ds.projection)
+                   for cid, ds in estimator._re_datasets.items()}
+    out_models = dict(model.models)
+    for cid, cfg in estimator.coordinate_configs.items():
+        if not cfg.is_random_effect or cid not in out_models:
+            continue
+        m = out_models[cid]
+        if not isinstance(m, RandomEffectModel):
+            continue
+        orig_dim = estimator._original_dims.get(cid)
+        rp = cfg.data.random_projection(orig_dim) if orig_dim else None
+        if rp is None:
+            continue
+        proj = projections[cid]
+        # expand projected-slot coefficients to the full projected space,
+        # then back-project: w_orig = P^T w_proj
+        coef_p = np.zeros((m.num_entities, rp.projected_dim))
+        block = np.asarray(m.coefficients)
+        for s in range(proj.shape[1]):
+            cols = proj[:, s]
+            ok = cols >= 0
+            coef_p[ok, cols[ok]] = block[ok, s]
+        coef_orig = rp.back_project_coefficients(coef_p)  # [E, D]
+        E, D = coef_orig.shape
+        out_models[cid] = RandomEffectModel(
+            coefficients=jnp.asarray(coef_orig),
+            random_effect_type=m.random_effect_type,
+            feature_shard_id=m.feature_shard_id,
+            task=m.task,
+            variances=None,  # variances do not survive back-projection
+        )
+        projections[cid] = np.tile(np.arange(D, dtype=np.int32), (E, 1))
+    return GameModel(out_models), projections
+
+
 class GameTransformer:
     """Score new frames under a trained GAME model
     (reference: GameTransformer.scala:39)."""

@@ -1,0 +1,63 @@
+"""Per-entity feature-space projectors.
+
+Reference: photon-api projector/Projector.scala:20-33 (projectFeatures /
+projectCoefficients), ProjectorType.scala:17-28 (RANDOM = shared Gaussian
+random projection, INDEX_MAP = per-entity compact reindex [default],
+IDENTITY), ProjectionMatrixBroadcast.scala:15 (one broadcast projection
+matrix shared by all entities), IndexMapProjectorRDD.scala:19.
+
+TPU re-design: INDEX_MAP is the gather-table pipeline built by
+build_random_effect_dataset. RANDOM is implemented here: one deterministic
+Gaussian matrix P [proj_dim, D] (seeded, never materialized per entity)
+projects every sample's sparse row to a dense proj_dim vector at ingest —
+a [nnz] scatter-matmul — and back-projects trained coefficients to the
+original space for persistence (margin invariance: w.(Px) = (P^T w).x).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class ProjectorType(enum.Enum):
+    """Reference: ProjectorType.scala:17-28."""
+
+    INDEX_MAP = "INDEX_MAP"
+    RANDOM = "RANDOM"
+    IDENTITY = "IDENTITY"
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomProjection:
+    """Shared Gaussian projection (ProjectionMatrixBroadcast analog)."""
+
+    original_dim: int
+    projected_dim: int
+    seed: int = 0
+
+    def matrix(self) -> np.ndarray:
+        """P [proj_dim, D], entries N(0, 1/proj_dim) — deterministic."""
+        rng = np.random.default_rng(self.seed)
+        return rng.normal(size=(self.projected_dim, self.original_dim)) \
+            / np.sqrt(self.projected_dim)
+
+    def project_rows(self, rows) -> np.ndarray:
+        """Sparse rows [(idx, val)] -> dense [n, proj_dim]."""
+        P = self.matrix()
+        out = np.zeros((len(rows), self.projected_dim))
+        for i, (idx, val) in enumerate(rows):
+            if len(idx):
+                out[i] = P[:, idx] @ val
+        return out
+
+    def project_dense(self, X: np.ndarray) -> np.ndarray:
+        return X @ self.matrix().T
+
+    def back_project_coefficients(self, coef: np.ndarray) -> np.ndarray:
+        """[..., proj_dim] projected-space coefficients -> [..., D]
+        original-space equivalents (w.(Px) == (P^T w).x)."""
+        return np.asarray(coef) @ self.matrix()

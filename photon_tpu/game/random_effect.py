@@ -38,7 +38,7 @@ Array = jax.Array
 @dataclasses.dataclass(frozen=True)
 class RandomEffectDataConfiguration:
     """Reference: RandomEffectDataConfiguration (CoordinateDataConfiguration
-    .scala:68)."""
+    .scala:68) incl. projectorType."""
 
     random_effect_type: str
     feature_shard_id: str
@@ -46,6 +46,21 @@ class RandomEffectDataConfiguration:
     active_data_upper_bound: Optional[int] = None   # reservoir cap
     features_to_samples_ratio: Optional[float] = None  # Pearson cap
     keep_passive_data: bool = True
+    # ProjectorType.INDEX_MAP (default) | RANDOM | IDENTITY; RANDOM needs
+    # projected_dimension (reference: ProjectorType.scala, RandomProjection)
+    projector_type: str = "INDEX_MAP"
+    projected_dimension: Optional[int] = None
+    projection_seed: int = 0
+
+    def random_projection(self, original_dim: int):
+        from photon_tpu.game.projector import ProjectorType, RandomProjection
+
+        if ProjectorType(self.projector_type) != ProjectorType.RANDOM:
+            return None
+        assert self.projected_dimension, \
+            "RANDOM projector needs projected_dimension"
+        return RandomProjection(original_dim, self.projected_dimension,
+                                self.projection_seed)
 
 
 class EntityBlock(NamedTuple):
@@ -140,6 +155,7 @@ def build_random_effect_dataset(
     re_type = config.random_effect_type
     shard = df.feature_shards[config.feature_shard_id]
     assert not shard.is_dense, "random-effect shards use sparse rows"
+    shard = _maybe_random_project(shard, config)
     n = df.num_samples
     D = shard.dim
 
@@ -328,6 +344,22 @@ def build_random_effect_dataset(
     )
 
 
+def _maybe_random_project(shard, config: RandomEffectDataConfiguration):
+    """RANDOM projector: replace the shard with dense rows in the shared
+    Gaussian-projected space (the pipeline then treats every projected dim
+    as observed for every entity)."""
+    from photon_tpu.game.dataset import FeatureShard
+
+    rp = config.random_projection(shard.dim)
+    if rp is None:
+        return shard
+    dense = rp.project_rows(shard.rows)
+    pd = rp.projected_dim
+    idx = np.arange(pd, dtype=np.int32)
+    rows = [(idx, dense[i]) for i in range(len(dense))]
+    return FeatureShard(rows, pd)
+
+
 def _pearson_scores_vectorized(uniq, pair, keep_nz, vals, s_nz, entity_idx,
                                resp, weights, active, E, D) -> np.ndarray:
     """|Pearson corr(feature, label)| per observed (entity, feature) pair
@@ -377,6 +409,7 @@ def project_for_scoring(
     entities -> entity index E (out of range => zero score); unmapped
     features are dropped. Fully vectorized."""
     shard = df.feature_shards[config.feature_shard_id]
+    shard = _maybe_random_project(shard, config)
     n = df.num_samples
     D = shard.dim
     proj_np = np.asarray(projection)
