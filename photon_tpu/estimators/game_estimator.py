@@ -242,9 +242,10 @@ class GameEstimator:
                 descent=descent,
             ))
             warm = descent.model
-        # expose artifacts for transformer reuse / model IO
+        # expose artifacts for transformer reuse / model IO / telemetry
         self._vocab = vocab
         self._re_datasets = re_datasets
+        self._coordinates = coordinates
         return results
 
 
