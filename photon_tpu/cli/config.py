@@ -90,6 +90,15 @@ def _regularization(args: Dict[str, str]) -> RegularizationContext:
     raise ValueError(f"unknown regularization {reg!r}")
 
 
+def _projector_type(text: str) -> str:
+    """Accept both enum spellings and the compact grammar; validate at
+    parse time so a typo fails here, not mid-ingest."""
+    from photon_tpu.game.projector import ProjectorType
+
+    canon = {"INDEXMAP": "INDEX_MAP"}.get(text.upper(), text.upper())
+    return ProjectorType(canon).value
+
+
 def parse_coordinate_config(text: str) -> ParsedCoordinate:
     args = parse_kv_args(text)
     name = args.pop("name")
@@ -109,6 +118,11 @@ def parse_coordinate_config(text: str) -> ParsedCoordinate:
             features_to_samples_ratio=(
                 None if "features.to.samples.ratio" not in args
                 else float(args.pop("features.to.samples.ratio"))),
+            # reference: ProjectorType via RandomEffectDataConfiguration
+            # ("indexmap"/"random"/"identity" in its compact grammar)
+            projector_type=_projector_type(args.pop("projector", "INDEX_MAP")),
+            projected_dimension=popi("projected.dimension"),
+            projection_seed=popi("projection.seed") or 0,
         )
         args.pop("passive.data.bound", None)
     else:

@@ -187,7 +187,8 @@ class LegacyDriver:
             models, stats = train_generalized_linear_model(
                 self.task, self.train_batch, self.dim, config,
                 regularization_weights=lambdas, norm=self.norm,
-                dtype=self.train_batch.labels.dtype)
+                dtype=self.train_batch.labels.dtype,
+                intercept_index=(self.dim - 1 if args.intercept else None))
         self.models = models
         self.solver_stats = stats
         self.stage = DriverStage.TRAINED

@@ -49,6 +49,7 @@ from photon_tpu.io.data_io import (
     records_to_game_dataframe,
 )
 from photon_tpu.io.model_io import save_game_model
+from photon_tpu.ops.normalization import NormalizationType
 from photon_tpu.types import TaskType, VarianceComputationType
 from photon_tpu.utils.timing import Timed
 
@@ -101,8 +102,89 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--model-sparsity-threshold", type=float, default=1e-4)
     p.add_argument("--num-devices", type=int, default=0,
                    help="shard training over this many devices (0 = single)")
+    p.add_argument("--normalization-type", default="NONE",
+                   choices=[t.value for t in NormalizationType],
+                   help="feature normalization, built from training-data "
+                        "statistics per feature shard (reference: "
+                        "GameTrainingDriver.scala:556)")
+    p.add_argument("--data-summary-directory", default=None,
+                   help="write per-shard FeatureSummarizationResultAvro here "
+                        "(reference: ModelProcessingUtils.scala:393)")
     p.add_argument("--log-level", default="INFO")
     return p
+
+
+def compute_shard_statistics(df, shard_ids):
+    """Per-shard FeatureDataStatistics over the training frame
+    (reference: GameTrainingDriver.prepareFeatureMapsAndStats)."""
+    from photon_tpu.data.stats import compute_feature_stats
+
+    out = {}
+    for sid in shard_ids:
+        feats = df.shard_features(sid)
+        out[sid] = compute_feature_stats(feats, df.feature_shards[sid].dim)
+    return out
+
+
+def build_normalization(args, df, index_maps, shard_ids):
+    """(contexts, intercept_indices, stats) for the estimator + summary
+    output. Stats are computed when either normalization or a summary
+    directory asks for them."""
+    from photon_tpu.io.index_map import INTERCEPT_KEY
+    from photon_tpu.ops.normalization import build_normalization_context
+
+    ntype = NormalizationType(args.normalization_type)
+    want_stats = ntype != NormalizationType.NONE or args.data_summary_directory
+    if not want_stats:
+        return {}, {}, {}
+    stats = compute_shard_statistics(df, shard_ids)
+    intercepts = {
+        sid: idx for sid, idx in
+        ((sid, index_maps[sid].get_index(INTERCEPT_KEY)) for sid in shard_ids)
+        if idx >= 0
+    }
+    contexts = {}
+    if ntype != NormalizationType.NONE:
+        for sid in shard_ids:
+            s = stats[sid]
+            contexts[sid] = build_normalization_context(
+                ntype, s.mean, s.variance, s.abs_max,
+                intercept_index=intercepts.get(sid))
+    return contexts, intercepts, stats
+
+
+def write_feature_summaries(summary_dir, stats, index_maps) -> None:
+    """One Avro file per shard with per-feature summary metrics
+    (reference: ModelProcessingUtils.writeBasicStatistics :393)."""
+    from photon_tpu.io.avro import write_avro
+    from photon_tpu.io.index_map import split_feature_key
+    from photon_tpu.io.schemas import FEATURE_SUMMARIZATION_RESULT_AVRO
+
+    for sid, s in stats.items():
+        imap = index_maps[sid]
+        mean = np.asarray(s.mean)
+        var = np.asarray(s.variance)
+        mn = np.asarray(s.min)
+        mx = np.asarray(s.max)
+        nnz = np.asarray(s.num_nonzeros)
+        records = []
+        for j in range(len(mean)):
+            key = imap.get_feature_name(j)
+            name, term = split_feature_key(key) if key else (str(j), "")
+            records.append({
+                "featureName": name,
+                "featureTerm": term,
+                "metrics": {"mean": float(mean[j]), "variance": float(var[j]),
+                            "min": float(mn[j]), "max": float(mx[j]),
+                            "numNonzeros": float(nnz[j]),
+                            "count": float(s.count)},
+            })
+        d = os.path.join(summary_dir, sid)
+        os.makedirs(d, exist_ok=True)
+        write_avro(os.path.join(d, "part-00000.avro"),
+                   FEATURE_SUMMARIZATION_RESULT_AVRO, records)
+        logger.info("wrote %d feature summaries for shard %s under %s",
+                    len(records), sid, d)
 
 
 def _id_tags_needed(args, parsed: List[ParsedCoordinate]) -> List[str]:
@@ -151,6 +233,15 @@ def run(args: argparse.Namespace) -> List:
     with Timed("data validation", logger):
         validate_dataframe(df, task, DataValidationType(args.data_validation))
 
+    shard_ids = sorted({p.configuration.data.feature_shard_id for p in parsed})
+    with Timed("feature stats + normalization", logger):
+        norm_contexts, intercepts, stats = build_normalization(
+            args, df, index_maps, shard_ids)
+    if args.data_summary_directory and stats:
+        with Timed("write feature summaries", logger):
+            write_feature_summaries(args.data_summary_directory, stats,
+                                    index_maps)
+
     mesh = None
     if args.num_devices:
         from photon_tpu.parallel import mesh as M
@@ -174,6 +265,8 @@ def run(args: argparse.Namespace) -> List:
         mesh=mesh,
         variance_computation_type=VarianceComputationType(
             args.variance_computation_type),
+        normalization_contexts=norm_contexts,
+        intercept_indices=intercepts,
     )
 
     sweeps = expand_sweep(parsed)
@@ -231,13 +324,19 @@ def save_models(args, estimator, results, tuned, index_maps, out_dir) -> None:
                 to_save[f"tuned/{i}"] = r
         to_save["best"] = _best_result(estimator, results + tuned)
 
-    projections = {cid: np.asarray(ds.projection)
-                   for cid, ds in estimator._re_datasets.items()}
+    from photon_tpu.estimators.game_estimator import persistable_artifacts
+    base_projections = {cid: np.asarray(ds.projection)
+                        for cid, ds in estimator._re_datasets.items()}
     for rel, result in to_save.items():
         d = os.path.join(out_dir, rel)
         with Timed(f"save model {rel}", logger):
+            # RANDOM-projected coordinates are back-projected into the
+            # original feature space before hitting disk (reference:
+            # Projector.projectCoefficients); INDEX_MAP/IDENTITY pass through
+            model, projections = persistable_artifacts(
+                estimator, result.model, base_projections=base_projections)
             save_game_model(
-                d, result.model, index_maps,
+                d, model, index_maps,
                 vocab=estimator._vocab, projections=projections,
                 coordinate_configs=result.config,
                 sparsity_threshold=args.model_sparsity_threshold)

@@ -100,10 +100,20 @@ class GameEstimator:
         dtype=jnp.float32,
         mesh=None,
         variance_computation_type=None,
+        normalization_contexts=None,
+        intercept_indices=None,
     ):
         """``mesh``: a `jax.sharding.Mesh` — fixed-effect batches are
         sample-sharded and random-effect entity blocks entity-sharded over
-        its data axis, so each coordinate's solve runs SPMD (SURVEY §5.8)."""
+        its data axis, so each coordinate's solve runs SPMD (SURVEY §5.8).
+
+        ``normalization_contexts``: {feature_shard_id: NormalizationContext}
+        (reference: GameEstimator.scala:55-111 threading per-coordinate
+        contexts built by the driver). Fixed effects fold the context into
+        their solve; random effects gather it through each entity's
+        projection (NormalizationContextWrapper analog). Published models
+        are ALWAYS in original feature space. ``intercept_indices``:
+        {feature_shard_id: index} — required by shift-ful types."""
         self.task = task
         self.coordinate_configs = coordinate_configs
         self.update_sequence = update_sequence or list(coordinate_configs.keys())
@@ -117,6 +127,8 @@ class GameEstimator:
         self.locked = frozenset(locked_coordinates)
         self.dtype = dtype
         self.mesh = mesh
+        self.normalization_contexts = dict(normalization_contexts or {})
+        self.intercept_indices = dict(intercept_indices or {})
         from photon_tpu.types import VarianceComputationType
         self.variance_computation_type = (
             variance_computation_type or VarianceComputationType.NONE)
@@ -127,8 +139,24 @@ class GameEstimator:
                  sampling_seed: int = 0):
         coordinates: Dict[str, object] = {}
         re_datasets: Dict[str, RandomEffectDataset] = {}
+        # original (pre-RANDOM-projection) feature dims per RE coordinate —
+        # persistable_artifacts needs them to back-project trained models
+        self._original_dims: Dict[str, int] = {}
         for i, (cid, cfg) in enumerate(self.coordinate_configs.items()):
+            shard_id = cfg.data.feature_shard_id
+            norm = self.normalization_contexts.get(shard_id)
+            icpt = self.intercept_indices.get(shard_id)
             if cfg.is_random_effect:
+                if norm is not None and cfg.data.projector_type == "RANDOM":
+                    # contexts are defined in the original feature space;
+                    # a RANDOM projector replaces that space, so the
+                    # coordinate trains unnormalized (the Gaussian mix
+                    # already equalizes column scales)
+                    logger.warning(
+                        "coordinate %s: skipping normalization under a "
+                        "RANDOM projector", cid)
+                    norm, icpt = None, None
+                self._original_dims[cid] = df.feature_shards[shard_id].dim
                 ds = build_random_effect_dataset(
                     df, cfg.data, vocab, dtype=np.dtype(self.dtype).type)
                 re_datasets[cid] = ds
@@ -136,15 +164,16 @@ class GameEstimator:
                     ds, df.num_samples, cfg.data.random_effect_type,
                     cfg.data.feature_shard_id, self.task, cfg.optimization,
                     mesh=self.mesh,
-                    variance_type=self.variance_computation_type)
+                    variance_type=self.variance_computation_type,
+                    norm=norm, intercept_index=icpt)
             else:
-                shard_id = cfg.data.feature_shard_id
                 batch = df.fixed_effect_batch(shard_id, dtype=np.dtype(self.dtype).type)
                 key = jax.random.PRNGKey(sampling_seed + i)
                 coordinates[cid] = FixedEffectCoordinate(
                     batch, df.feature_shards[shard_id].dim, shard_id, self.task,
                     cfg.optimization, sampling_key=key, mesh=self.mesh,
-                    variance_type=self.variance_computation_type)
+                    variance_type=self.variance_computation_type,
+                    norm=norm, intercept_index=icpt)
         return coordinates, re_datasets
 
     def _build_scorer(self, df: GameDataFrame, vocab: EntityVocabulary,
@@ -249,17 +278,22 @@ class GameEstimator:
         return results
 
 
-def persistable_artifacts(estimator: "GameEstimator", model: GameModel):
+def persistable_artifacts(estimator: "GameEstimator", model: GameModel,
+                          base_projections=None):
     """(model, projections) ready for model IO: coordinates trained under a
     RANDOM projector are back-projected into the original feature space
     (reference: Projector.projectCoefficients) so their coefficients can be
-    written as (name, term, value) records."""
+    written as (name, term, value) records.
+
+    ``base_projections``: optional pre-fetched {cid: np.ndarray} projection
+    tables (callers saving several models hoist the device->host copy)."""
     import numpy as np
 
     from photon_tpu.game.model import RandomEffectModel
 
-    projections = {cid: np.asarray(ds.projection)
-                   for cid, ds in estimator._re_datasets.items()}
+    projections = dict(base_projections) if base_projections is not None \
+        else {cid: np.asarray(ds.projection)
+              for cid, ds in estimator._re_datasets.items()}
     out_models = dict(model.models)
     for cid, cfg in estimator.coordinate_configs.items():
         if not cfg.is_random_effect or cid not in out_models:
@@ -283,7 +317,7 @@ def persistable_artifacts(estimator: "GameEstimator", model: GameModel):
         coef_orig = rp.back_project_coefficients(coef_p)  # [E, D]
         E, D = coef_orig.shape
         out_models[cid] = RandomEffectModel(
-            coefficients=jnp.asarray(coef_orig),
+            coefficients=jnp.asarray(coef_orig.astype(block.dtype)),
             random_effect_type=m.random_effect_type,
             feature_shard_id=m.feature_shard_id,
             task=m.task,

@@ -36,13 +36,15 @@ def train_generalized_linear_model(
     warm_start: bool = True,
     initial: Optional[Array] = None,
     dtype=jnp.float32,
+    intercept_index: Optional[int] = None,
 ) -> Tuple[Dict[float, GeneralizedLinearModel], Dict[float, SolverResult]]:
     """Train one GLM per regularization weight, warm-starting along the path
     (descending lambda order is the caller's choice, as in the reference).
 
     Returns ({lambda: model}, {lambda: solver stats}).
     """
-    problem = GlmOptimizationProblem(task, config, norm)
+    problem = GlmOptimizationProblem(task, config, norm,
+                                     intercept_index=intercept_index)
     models: Dict[float, GeneralizedLinearModel] = {}
     stats: Dict[float, SolverResult] = {}
     coef = initial
@@ -52,5 +54,7 @@ def train_generalized_linear_model(
         models[lam] = model
         stats[lam] = result
         if warm_start:
-            coef = result.coef
+            # models are published in original space; run() converts warm
+            # starts back into the transformed optimization space
+            coef = model.coefficients.means
     return models, stats

@@ -65,6 +65,7 @@ class FixedEffectCoordinate:
         sampling_key: Optional[jax.Array] = None,
         mesh=None,
         variance_type=None,
+        intercept_index: Optional[int] = None,
     ):
         from photon_tpu.ops.normalization import no_normalization
         from photon_tpu.types import VarianceComputationType
@@ -82,7 +83,9 @@ class FixedEffectCoordinate:
         self.feature_shard_id = feature_shard_id
         self.task = task
         self.config = config
-        self.problem = GlmOptimizationProblem(task, config, norm or no_normalization())
+        self.problem = GlmOptimizationProblem(task, config,
+                                              norm or no_normalization(),
+                                              intercept_index=intercept_index)
         self._sampling_key = sampling_key
         self._update_count = 0
         self.mesh = mesh
@@ -150,6 +153,8 @@ class RandomEffectCoordinate:
         config: GLMOptimizationConfiguration = GLMOptimizationConfiguration(),
         mesh=None,
         variance_type=None,
+        norm=None,
+        intercept_index: Optional[int] = None,
     ):
         from photon_tpu.types import VarianceComputationType
 
@@ -170,6 +175,42 @@ class RandomEffectCoordinate:
         self.config = config
         self.objective = GLMObjective(loss_for_task(task))
         self.mesh = mesh
+        # per-entity normalization (reference: NormalizationContextWrapper):
+        # the shard-level [D] context is gathered through each entity's
+        # projection into local-slot space; pad slots get factor 1, shift 0
+        self._norm_local = self._build_local_norm(norm, intercept_index)
+
+    def _build_local_norm(self, norm, intercept_index: Optional[int]):
+        """Gather a shard-space NormalizationContext [D] into per-entity
+        local-slot arrays aligned with this dataset's projection table:
+        (factors [E, D_loc], shifts [E, D_loc] | None, islot [E]).
+        ``islot`` is each entity's local slot of the intercept feature
+        (-1 when unobserved — only possible for entities with no active
+        data, whose zero coefficients transform to zero anyway)."""
+        if norm is None or norm.is_identity:
+            return None
+        import numpy as np
+
+        proj = np.asarray(self.dataset.projection)
+        E, d_loc = proj.shape
+        valid = proj >= 0
+        f = np.ones((E, d_loc), np.float32)
+        if norm.factors is not None:
+            f[valid] = np.asarray(norm.factors, np.float32)[proj[valid]]
+        s = None
+        islot = np.full((E,), -1, np.int32)
+        if norm.shifts is not None:
+            if intercept_index is None:
+                raise ValueError(
+                    "random-effect normalization with shifts requires the "
+                    "shard's intercept_index")
+            s = np.zeros((E, d_loc), np.float32)
+            s[valid] = np.asarray(norm.shifts, np.float32)[proj[valid]]
+            ent, slot = np.nonzero(proj == intercept_index)
+            islot[ent] = slot
+        return (jnp.asarray(f),
+                None if s is None else jnp.asarray(s),
+                jnp.asarray(islot))
 
     @functools.cached_property
     def _solve_fn(self):
@@ -177,28 +218,50 @@ class RandomEffectCoordinate:
         opt = self.config.optimizer
         solver_cfg = opt.solver_config()
         opt_type = opt.optimizer_type
+        has_norm = self._norm_local is not None
+        has_shifts = has_norm and self._norm_local[1] is not None
 
         def build():
-            def solve_one(feat_idx, feat_val, labels, offsets, weights, x0, l2, l1):
+            from photon_tpu.ops.normalization import NormalizationContext
+
+            def solve_one(feat_idx, feat_val, labels, offsets, weights, x0,
+                          l2, l1, f_row=None, s_row=None, islot=None):
                 batch = DataBatch(F.SparseFeatures(feat_idx, feat_val),
                                   labels, offsets, weights)
                 hyper = Hyper(l2_weight=l2)
-                vg = lambda c: obj.value_and_gradient(c, batch, hyper)
+                if f_row is not None:
+                    # per-entity transformed space (NormalizationContext
+                    # Wrapper analog); x0/coef cross the boundary via the
+                    # margin-invariant maps, islot the dynamic intercept slot
+                    ctx = NormalizationContext(f_row, s_row)
+                    obj_e = GLMObjective(obj.loss, ctx)
+                    x0 = ctx.model_to_transformed_space(
+                        x0, islot if s_row is not None else None)
+                else:
+                    obj_e = obj
+                vg = lambda c: obj_e.value_and_gradient(c, batch, hyper)
                 if opt_type == OptimizerType.OWLQN:
                     r = owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
                 elif opt_type == OptimizerType.TRON:
-                    hv = lambda c, v: obj.hessian_vector(c, v, batch, hyper)
+                    hv = lambda c, v: obj_e.hessian_vector(c, v, batch, hyper)
                     r = tron.minimize(vg, hv, x0, config=solver_cfg)
                 else:
                     r = lbfgs.minimize(vg, x0, config=solver_cfg)
-                return r.coef, r.iterations, r.reason
+                coef = r.coef
+                if f_row is not None:
+                    coef = ctx.transformed_space_to_model(
+                        coef, islot if s_row is not None else None)
+                return coef, r.iterations, r.reason
 
             # the dataset enters as a pytree argument, never a closure (a
             # closed-over array would be baked into the HLO as a constant);
             # the Python loop over size buckets unrolls into one program
             @jax.jit
             def solve_all(ds: RandomEffectDataset, residual_flat: Optional[Array],
-                          coef0: Array, l2: Array, l1: Array):
+                          coef0: Array, l2: Array, l1: Array,
+                          norm_f: Optional[Array] = None,
+                          norm_s: Optional[Array] = None,
+                          norm_islot: Optional[Array] = None):
                 out = coef0  # entities with no active data keep warm start
                 E = coef0.shape[0]
                 # per-entity solver stats (-1 = entity never trained)
@@ -212,10 +275,21 @@ class RandomEffectCoordinate:
                             mode="fill", fill_value=0.0)
                         offsets = offsets + res
                     x0 = coef0.at[blk.entity_rows].get(mode="fill", fill_value=0.0)
+                    args = [blk.features.indices, blk.features.values,
+                            blk.labels, offsets, blk.weights, x0, l2, l1]
+                    axes = [0, 0, 0, 0, 0, 0, None, None]
+                    if norm_f is not None:
+                        args.append(norm_f.at[blk.entity_rows].get(
+                            mode="fill", fill_value=1.0))
+                        axes.append(0)
+                        if norm_s is not None:
+                            args.append(norm_s.at[blk.entity_rows].get(
+                                mode="fill", fill_value=0.0))
+                            args.append(norm_islot.at[blk.entity_rows].get(
+                                mode="fill", fill_value=-1))
+                            axes.extend([0, 0])
                     solved, it_b, reason_b = jax.vmap(
-                        solve_one, in_axes=(0, 0, 0, 0, 0, 0, None, None))(
-                        blk.features.indices, blk.features.values,
-                        blk.labels, offsets, blk.weights, x0, l2, l1)
+                        solve_one, in_axes=tuple(axes))(*args)
                     out = out.at[blk.entity_rows].set(solved, mode="drop")
                     iters = iters.at[blk.entity_rows].set(it_b, mode="drop")
                     reasons = reasons.at[blk.entity_rows].set(reason_b, mode="drop")
@@ -223,7 +297,8 @@ class RandomEffectCoordinate:
 
             return solve_all
 
-        key = ("re_solve", self.task, solver_cache_key(opt))
+        key = ("re_solve", self.task, solver_cache_key(opt),
+               has_norm, has_shifts)
         return jitcache.get_or_build(key, build)
 
     def update_model(
@@ -238,8 +313,12 @@ class RandomEffectCoordinate:
         lam = self.config.regularization_weight
         l2 = jnp.asarray(self.config.regularization.l2_weight(lam), dtype)
         l1 = jnp.asarray(self.config.regularization.l1_weight(lam), dtype)
+        norm_args = ()
+        if self._norm_local is not None:
+            f, s, islot = self._norm_local
+            norm_args = (f,) if s is None else (f, s, islot)
         coefs, iters, reasons = self._solve_fn(self.dataset, residual_scores,
-                                               coef0, l2, l1)
+                                               coef0, l2, l1, *norm_args)
         # per-entity outcome aggregation (RandomEffectOptimizationTracker)
         import numpy as _np
         from photon_tpu.optim.tracking import RandomEffectOptimizationTracker

@@ -96,6 +96,13 @@ class GlmOptimizationProblem:
     ``run`` maps to Optimizer.optimize over the whole batch; the reg weight
     is dynamic so ``update_regularization_weight`` (reference reg-path
     support) is free.
+
+    Model space contract: the OPTIMIZER runs in transformed (normalized)
+    coefficient space — that is the conditioning win — but every model this
+    class accepts (warm starts) and returns lives in ORIGINAL feature
+    space, converted at this boundary via the margin-invariant maps
+    (reference: NormalizationContext.scala:80-126). Published models can
+    therefore always be scored as theta.x against raw features.
     """
 
     def __init__(
@@ -103,10 +110,24 @@ class GlmOptimizationProblem:
         task: TaskType,
         config: GLMOptimizationConfiguration = GLMOptimizationConfiguration(),
         norm: NormalizationContext = no_normalization(),
+        intercept_index: Optional[int] = None,
     ):
+        if norm.shifts is not None and intercept_index is None:
+            # a shift moves margins by a constant; only an intercept can
+            # absorb it (reference: NormalizationContext requires an
+            # intercept for shift-ful normalization types)
+            raise ValueError(
+                "normalization with shifts (STANDARDIZATION) requires an "
+                "intercept feature; pass intercept_index")
         self.task = task
         self.config = config
+        self.intercept_index = intercept_index
         self.objective = GLMObjective(loss_for_task(task), norm)
+        # variances are reported for the PUBLISHED (original-space) model,
+        # so curvature is evaluated with the unnormalized objective
+        self._var_objective = (
+            self.objective if norm.is_identity
+            else GLMObjective(loss_for_task(task)))
 
     # -- solving ------------------------------------------------------------
 
@@ -153,9 +174,14 @@ class GlmOptimizationProblem:
         whole optimize loop then runs as ONE SPMD program whose gradient
         reductions are all-reduces over ICI (the treeAggregate + broadcast
         replacement, SURVEY §5.8)."""
+        norm = self.objective.norm
         if initial is None:
             assert dim is not None, "need dim when no initial coefficients"
             initial = jnp.zeros((dim,), dtype)
+        elif not norm.is_identity:
+            # warm starts arrive in original space; optimize in transformed
+            initial = norm.model_to_transformed_space(
+                jnp.asarray(initial), self.intercept_index)
         if mesh is not None:
             from photon_tpu.parallel import mesh as M
             batch = M.shard_batch(batch, mesh)
@@ -165,14 +191,17 @@ class GlmOptimizationProblem:
         l2 = jnp.asarray(self.config.regularization.l2_weight(lam), initial.dtype)
         l1 = jnp.asarray(self.config.regularization.l1_weight(lam), initial.dtype)
         result = self._solve_fn(initial, batch, l2, l1)
-        model = GeneralizedLinearModel(Coefficients(result.coef), self.task)
+        coef = result.coef
+        if not norm.is_identity:
+            coef = norm.transformed_space_to_model(coef, self.intercept_index)
+        model = GeneralizedLinearModel(Coefficients(coef), self.task)
         return model, result
 
     # -- variances (reference: DistributedOptimizationProblem:82-100) -------
 
     @functools.cached_property
     def _variance_fns(self):
-        obj = self.objective
+        obj = self._var_objective  # original-space curvature (see __init__)
 
         def build():
             @jax.jit
@@ -191,7 +220,7 @@ class GlmOptimizationProblem:
 
             return simple, full
 
-        key = ("glm_variance", self.task, norm_cache_key(self.objective.norm))
+        key = ("glm_variance", self.task, norm_cache_key(self._var_objective.norm))
         return jitcache.get_or_build(key, build)
 
     def compute_variances(

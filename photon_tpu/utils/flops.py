@@ -39,6 +39,7 @@ _PEAK_FLOPS_BY_KIND = (
     ("v2", 45e12),
 )
 _CPU_FALLBACK_PEAK = 1e11     # nominal; flags MFU as not-a-TPU number
+_UNKNOWN_TPU_PEAK = 275e12    # v4 figure, assumed for unrecognized TPU kinds
 
 
 def peak_flops(device) -> tuple:
@@ -49,7 +50,7 @@ def peak_flops(device) -> tuple:
         if marker in low:
             return peak, kind
     if getattr(device, "platform", "") in ("tpu", "axon"):
-        return _PEAK_FLOPS_BY_KIND[3][1], kind or "tpu-unknown(v4 assumed)"
+        return _UNKNOWN_TPU_PEAK, kind or "tpu-unknown(v4 assumed)"
     return _CPU_FALLBACK_PEAK, kind or "cpu"
 
 

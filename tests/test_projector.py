@@ -1,0 +1,181 @@
+"""RANDOM projector end-to-end (VERDICT r2 missing #5 / weak #3, #4).
+
+Reference: projector/ProjectionMatrixBroadcast.scala:15 (one shared
+Gaussian matrix projecting every entity's features),
+Projector.projectCoefficients (back-projection for persistence),
+ProjectorType.scala:17-28.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.estimators.game_estimator import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    GameEstimator,
+    GameTransformer,
+    persistable_artifacts,
+)
+from photon_tpu.function.objective import L2Regularization
+from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+from photon_tpu.game.projector import RandomProjection
+from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+from photon_tpu.optim.problem import (
+    GLMOptimizationConfiguration,
+    OptimizerConfig,
+)
+from photon_tpu.types import TaskType
+
+
+def test_projection_margin_invariance_roundtrip():
+    """w.(Px) == (P^T w).x — the algebra that makes back-projection valid
+    (reference: ProjectionMatrixBroadcast margin preservation)."""
+    rng = np.random.default_rng(0)
+    D, pd, n = 40, 8, 30
+    rp = RandomProjection(D, pd, seed=3)
+    rows = []
+    for _ in range(n):
+        k = rng.integers(1, 6)
+        idx = rng.choice(D, size=k, replace=False).astype(np.int32)
+        rows.append((idx, rng.normal(size=k)))
+    Xp = rp.project_rows(rows)                      # [n, pd]
+    w_p = rng.normal(size=pd)
+    w_orig = rp.back_project_coefficients(w_p)      # [D]
+    dense = np.zeros((n, D))
+    for i, (idx, val) in enumerate(rows):
+        dense[i, idx] = val
+    np.testing.assert_allclose(Xp @ w_p, dense @ w_orig, rtol=1e-10)
+    # determinism: same seed -> same matrix
+    np.testing.assert_array_equal(rp.matrix(),
+                                  RandomProjection(D, pd, seed=3).matrix())
+
+
+def _frame(n=500, D=60, users=10, seed=0):
+    """High-dimensional sparse per-user shard — the RANDOM projector's
+    use case (per-entity dim reduction, SURVEY §2.6)."""
+    rng = np.random.default_rng(seed)
+    users_idx = rng.integers(0, users, size=n)
+    w_u = rng.normal(size=(users, D)) * 1.0
+    rows, margins = [], np.zeros(n)
+    for i in range(n):
+        k = int(rng.integers(3, 10))
+        idx = np.sort(rng.choice(D, size=k, replace=False)).astype(np.int32)
+        val = rng.normal(size=k)
+        rows.append((idx, val))
+        margins[i] = val @ w_u[users_idx[i], idx]
+    y = (rng.random(n) < 1 / (1 + np.exp(-margins))).astype(np.float64)
+    df = GameDataFrame(
+        num_samples=n, response=y,
+        feature_shards={"per_user": FeatureShard(rows, D)},
+        id_tags={"userId": [f"u{u}" for u in users_idx]})
+    return df, D
+
+
+def _estimator(pd=None, seed=0):
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=60, tolerance=1e-9),
+        regularization=L2Regularization, regularization_weight=0.5)
+    kwargs = {}
+    if pd is not None:
+        kwargs = {"projector_type": "RANDOM", "projected_dimension": pd,
+                  "projection_seed": seed}
+    return GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {"per_user": CoordinateConfiguration(
+            RandomEffectDataConfiguration("userId", "per_user", **kwargs),
+            opt)},
+        num_iterations=1, dtype=np.float64)
+
+
+def test_glmix_random_projector_end_to_end():
+    """Training under RANDOM projection produces a usable model whose
+    back-projected persistable form scores IDENTICALLY (margin
+    invariance), and the projected dim really is the configured one."""
+    df, D = _frame()
+    pd = 16
+    est = _estimator(pd=pd)
+    res = est.fit(df)
+    model = res[-1].model
+
+    re = model["per_user"]
+    assert re.coefficients.shape[1] == pd            # trained in proj space
+    scores_proj = np.asarray(GameTransformer(model, est).transform(df))
+    assert np.all(np.isfinite(scores_proj))
+
+    back_model, back_proj = persistable_artifacts(est, model)
+    coef_orig = np.asarray(back_model["per_user"].coefficients)
+    assert coef_orig.shape[1] == D                   # back in original space
+
+    # margin invariance of the persisted form: w_orig.x == w_proj.(Px)
+    shard = df.feature_shards["per_user"]
+    users = df.id_tags["userId"]
+    for i in range(0, df.num_samples, 57):
+        idx, val = shard.rows[i]
+        e = int(est._vocab.lookup("userId", [users[i]])[0])
+        margin_orig = val @ coef_orig[e, idx]
+        np.testing.assert_allclose(margin_orig, scores_proj[i], rtol=1e-6,
+                                   atol=1e-9, err_msg=f"sample {i}")
+
+
+def test_random_projector_quality_close_to_indexmap():
+    """pd=32 of D=60 keeps most signal (Johnson-Lindenstrauss-style
+    sanity, not a tight bound): training AUC stays far above chance and
+    within 0.12 of the exact INDEX_MAP fit."""
+    from sklearn.metrics import roc_auc_score
+
+    df, D = _frame(n=800, seed=2)
+    y = np.asarray(df.response)
+    est_exact = _estimator(pd=None)
+    auc_exact = roc_auc_score(
+        y, np.asarray(GameTransformer(est_exact.fit(df)[-1].model,
+                                      est_exact).transform(df)))
+    est_rand = _estimator(pd=32)
+    auc_rand = roc_auc_score(
+        y, np.asarray(GameTransformer(est_rand.fit(df)[-1].model,
+                                      est_rand).transform(df)))
+    assert auc_rand > max(0.8, auc_exact - 0.12), (auc_rand, auc_exact)
+
+
+def test_random_projector_driver_save_load_score_parity(tmp_path):
+    """Full driver round trip with a RANDOM-projected coordinate: train ->
+    save (back-projected) -> load -> score must match the in-memory
+    transformer's metrics (VERDICT r2 item 4 done-criterion)."""
+    from tests.test_drivers import _write_game_records
+    from photon_tpu.cli import score, train
+
+    data = str(tmp_path / "data" / "train.avro")
+    _write_game_records(data, n=500, d=12, seed=7)
+    out = str(tmp_path / "out")
+
+    results = train.run(train.build_arg_parser().parse_args([
+        "--input-data-directories", os.path.dirname(data),
+        "--validation-data-directories", os.path.dirname(data),
+        "--root-output-directory", out,
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--coordinate-configuration",
+        ("name=fixed,feature.shard=global,optimizer=LBFGS,tolerance=1e-7,"
+         "max.iter=40,regularization=L2,reg.weights=1"),
+        "--coordinate-configuration",
+        ("name=per_user,random.effect.type=userId,feature.shard=global,"
+         "optimizer=LBFGS,tolerance=1e-6,max.iter=30,regularization=L2,"
+         "reg.weights=10,projector=RANDOM,projected.dimension=6"),
+        "--coordinate-update-sequence", "fixed,per_user",
+    ]))
+    train_auc = results[0].evaluation["AUC"]
+    assert train_auc > 0.7
+
+    out_score = str(tmp_path / "scores")
+    score.run(score.build_arg_parser().parse_args([
+        "--input-data-directories", os.path.dirname(data),
+        "--model-input-directory", os.path.join(out, "best"),
+        "--root-output-directory", out_score,
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--evaluators", "AUC",
+    ]))
+    import json
+
+    ev = json.load(open(os.path.join(out_score, "evaluation.json")))
+    assert ev["AUC"] == pytest.approx(train_auc, abs=2e-3)
