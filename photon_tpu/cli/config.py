@@ -110,6 +110,9 @@ def parse_coordinate_config(text: str) -> ParsedCoordinate:
         def popi(key):
             v = args.pop(key, None)
             return None if v is None else int(float(v))
+        extra = {}
+        if "max.entity.buckets" in args:  # else: dataclass default rules
+            extra["max_entity_buckets"] = popi("max.entity.buckets")
         data = RandomEffectDataConfiguration(
             random_effect_type=re_type,
             feature_shard_id=shard,
@@ -123,6 +126,7 @@ def parse_coordinate_config(text: str) -> ParsedCoordinate:
             projector_type=_projector_type(args.pop("projector", "INDEX_MAP")),
             projected_dimension=popi("projected.dimension"),
             projection_seed=popi("projection.seed") or 0,
+            **extra,
         )
         args.pop("passive.data.bound", None)
     else:

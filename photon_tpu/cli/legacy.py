@@ -92,6 +92,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    choices=[v.value for v in DataValidationType])
     p.add_argument("--intercept", action="store_true", default=True)
     p.add_argument("--no-intercept", dest="intercept", action="store_false")
+    p.add_argument("--event-listeners", nargs="*", default=[],
+                   help="fully-qualified EventListener class names "
+                        "(reference: Driver.scala:62-73)")
     p.add_argument("--log-level", default="INFO")
     return p
 
@@ -243,12 +246,29 @@ class LegacyDriver:
         logger.info("saved %d models to %s", len(recs), out)
 
     def run(self):
-        self.preprocess()
-        self.train()
-        self.validate()
-        self.save()
-        logger.info(timing_summary())
-        return self
+        """Stage sequence with lifecycle events (reference: Driver.scala
+        sendEvent(PhotonSetupEvent) at init :73, TrainingStart/Finish and
+        PhotonOptimizationLogEvent around train :150-170)."""
+        from photon_tpu.utils import events
+
+        with events.driver_listeners(
+                getattr(self.args, "event_listeners", [])):
+            events.emitter.emit(events.setup_event(driver="legacy",
+                                                   params=vars(self.args)))
+            self.preprocess()
+            events.emitter.emit(events.training_start_event(
+                task=self.task.value, dim=self.dim))
+            self.train()
+            events.emitter.emit(events.optimization_log_event(**{
+                f"lambda/{lam}": str(stats.reason)
+                for lam, stats in self.solver_stats.items()}))
+            self.validate()
+            events.emitter.emit(events.training_finish_event(
+                best_lambda=self.best_lambda,
+                metrics={str(k): v for k, v in self.metrics.items()}))
+            self.save()
+            logger.info(timing_summary())
+            return self
 
 
 def main(argv: Optional[List[str]] = None) -> LegacyDriver:

@@ -46,6 +46,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help='e.g. AUC "AUC:userId"')
     p.add_argument("--id-tag-columns", nargs="*", default=[])
     p.add_argument("--model-id", default="photon_tpu")
+    p.add_argument("--event-listeners", nargs="*", default=[],
+                   help="fully-qualified EventListener class names "
+                        "(reference: Driver.scala:62-73)")
     p.add_argument("--log-level", default="INFO")
     return p
 
@@ -53,6 +56,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
 def run(args: argparse.Namespace) -> np.ndarray:
     logging.basicConfig(level=args.log_level,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from photon_tpu.utils import events
+
+    with events.driver_listeners(args.event_listeners):
+        events.emitter.emit(events.setup_event(driver="game-score",
+                                               params=vars(args)))
+        return _run(args)
+
+
+def _run(args: argparse.Namespace) -> np.ndarray:
+    from photon_tpu.utils import events
+
     out_dir = args.root_output_directory
     os.makedirs(out_dir, exist_ok=True)
 
@@ -100,13 +114,19 @@ def run(args: argparse.Namespace) -> np.ndarray:
                      uids=uids if any(u is not None for u in uids) else None,
                      model_id=args.model_id)
 
+    evaluations = None
     if args.evaluators:
         suite = EvaluationSuite(args.evaluators, df.response,
                                 weights=df.weights, id_tags=df.id_tags)
         results = suite.evaluate(jnp.asarray(scores))
+        evaluations = results.evaluations
         with open(os.path.join(out_dir, "evaluation.json"), "w") as f:
-            json.dump(results.evaluations, f, indent=2)
-        logger.info("evaluation: %s", results.evaluations)
+            json.dump(evaluations, f, indent=2)
+        logger.info("evaluation: %s", evaluations)
+    events.emitter.emit(events.Event(
+        "ScoringFinishEvent",
+        payload={"num_scored": int(len(scores)),
+                 "evaluation": evaluations}))
     return scores
 
 

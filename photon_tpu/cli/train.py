@@ -71,7 +71,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
         prog="photon_tpu.train",
         description="Train a GAME model (fixed + random effects) on TPU")
     p.add_argument("--input-data-directories", nargs="+", required=True)
+    p.add_argument("--input-data-date-range", default=None,
+                   help="yyyymmdd-yyyymmdd: expand each input dir to its "
+                        "daily/yyyy/mm/dd partitions in range (reference: "
+                        "DateRange.scala:107)")
+    p.add_argument("--input-data-days-range", default=None,
+                   help="START-END days ago, e.g. 90-1 (DaysRange.scala)")
     p.add_argument("--validation-data-directories", nargs="*", default=[])
+    p.add_argument("--validation-data-date-range", default=None)
+    p.add_argument("--validation-data-days-range", default=None)
     p.add_argument("--root-output-directory", required=True)
     p.add_argument("--training-task", required=True,
                    choices=[t.value for t in TaskType])
@@ -110,8 +118,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-summary-directory", default=None,
                    help="write per-shard FeatureSummarizationResultAvro here "
                         "(reference: ModelProcessingUtils.scala:393)")
+    p.add_argument("--event-listeners", nargs="*", default=[],
+                   help="fully-qualified EventListener class names "
+                        "(reference: Driver.scala:62-73)")
     p.add_argument("--log-level", default="INFO")
     return p
+
+
+def _emit_optimization_logs(estimator, results) -> None:
+    """One PhotonOptimizationLogEvent per trained configuration with the
+    per-coordinate convergence summaries snapshotted per configuration
+    (reference: Driver.scala PhotonOptimizationLogEvent with the
+    lambda-model trackers)."""
+    from photon_tpu.utils import events
+
+    for i, result in enumerate(results):
+        payload = {"configuration": i,
+                   "regularization": {
+                       cid: c.optimization.regularization_weight
+                       for cid, c in result.config.items()}}
+        for cid, summary in result.tracker_summaries.items():
+            payload[f"tracker/{cid}"] = summary
+        if result.evaluation is not None:
+            payload["evaluation"] = dict(result.evaluation)
+        events.emitter.emit(events.optimization_log_event(**payload))
 
 
 def compute_shard_statistics(df, shard_ids):
@@ -203,6 +233,17 @@ def _id_tags_needed(args, parsed: List[ParsedCoordinate]) -> List[str]:
 def run(args: argparse.Namespace) -> List:
     logging.basicConfig(level=args.log_level,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from photon_tpu.utils import events
+
+    with events.driver_listeners(args.event_listeners):
+        events.emitter.emit(events.setup_event(driver="game-train",
+                                               params=vars(args)))
+        return _run(args)
+
+
+def _run(args: argparse.Namespace) -> List:
+    from photon_tpu.utils import events
+
     task = TaskType(args.training_task)
     out_dir = args.root_output_directory
     os.makedirs(out_dir, exist_ok=True)
@@ -218,15 +259,40 @@ def run(args: argparse.Namespace) -> List:
         raise ValueError(f"update sequence references unknown coordinates: {unknown}")
     id_tags = _id_tags_needed(args, parsed)
 
+    from photon_tpu.utils.date_range import (
+        DateRange,
+        DaysRange,
+        resolve_input_dirs,
+    )
+
+    def date_range_of(range_text, days_text):
+        if range_text and days_text:
+            raise ValueError(
+                "--*-date-range and --*-days-range are mutually exclusive "
+                "(reference: GameDriver treats them so)")
+        if range_text:
+            return DateRange.from_string(range_text)
+        if days_text:
+            return DaysRange.from_string(days_text).to_date_range()
+        return None
+
     with Timed("read training data", logger):
-        records = read_records(args.input_data_directories)
+        input_dirs = resolve_input_dirs(
+            args.input_data_directories,
+            date_range_of(args.input_data_date_range,
+                          args.input_data_days_range))
+        records = read_records(input_dirs)
         index_maps = build_index_maps(records, shard_configs)
         df = records_to_game_dataframe(records, shard_configs, index_maps,
                                        id_tag_columns=id_tags)
     validation_df = None
     if args.validation_data_directories:
         with Timed("read validation data", logger):
-            vrecords = read_records(args.validation_data_directories)
+            val_dirs = resolve_input_dirs(
+                args.validation_data_directories,
+                date_range_of(args.validation_data_date_range,
+                              args.validation_data_days_range))
+            vrecords = read_records(val_dirs)
             validation_df = records_to_game_dataframe(
                 vrecords, shard_configs, index_maps, id_tag_columns=id_tags)
 
@@ -270,10 +336,14 @@ def run(args: argparse.Namespace) -> List:
     )
 
     sweeps = expand_sweep(parsed)
+    events.emitter.emit(events.training_start_event(
+        task=task.value, configurations=len(sweeps),
+        coordinates=list(update_sequence), num_samples=df.num_samples))
     with Timed(f"train {len(sweeps)} configuration(s)", logger):
         results = estimator.fit(df, validation_df=validation_df,
                                 configurations=sweeps,
                                 initial_model=initial_model)
+    _emit_optimization_logs(estimator, results)
 
     tuned = []
     mode = HyperparameterTuningMode(args.hyper_parameter_tuning)
@@ -295,6 +365,11 @@ def run(args: argparse.Namespace) -> List:
                 n_iterations=args.hyper_parameter_tuning_iter,
                 mode=mode, prior_results=results)
 
+    best = _best_result(estimator, results + tuned)
+    events.emitter.emit(events.training_finish_event(
+        models_trained=len(results) + len(tuned),
+        best_evaluation=None if best.evaluation is None
+        else dict(best.evaluation)))
     save_models(args, estimator, results, tuned, index_maps, out_dir)
     return results + tuned
 

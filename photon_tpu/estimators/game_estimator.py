@@ -84,6 +84,10 @@ class GameResult:
     config: Dict[str, CoordinateConfiguration]
     evaluation: Optional[Dict[str, float]]
     descent: CoordinateDescentResult
+    # per-coordinate convergence summaries captured at the END of THIS
+    # configuration's descent (coordinates are reused across a sweep, so
+    # their live trackers only ever show the last configuration)
+    tracker_summaries: Dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 class GameEstimator:
@@ -269,6 +273,7 @@ class GameEstimator:
                 config=dict(self.coordinate_configs),
                 evaluation=evaluation,
                 descent=descent,
+                tracker_summaries=_tracker_summaries(coordinates),
             ))
             warm = descent.model
         # expose artifacts for transformer reuse / model IO / telemetry
@@ -276,6 +281,23 @@ class GameEstimator:
         self._re_datasets = re_datasets
         self._coordinates = coordinates
         return results
+
+
+def _tracker_summaries(coordinates) -> Dict[str, str]:
+    """Snapshot each coordinate's convergence summary (ring-buffer tracker
+    when state tracking is on, basic solver stats otherwise)."""
+    out: Dict[str, str] = {}
+    for cid, coord in coordinates.items():
+        tracker = getattr(coord, "last_tracker", None)
+        if tracker is not None:
+            out[cid] = tracker.summary()
+            continue
+        r = getattr(coord, "last_result", None)
+        if r is not None:
+            from photon_tpu.optim.base import ConvergenceReason
+            out[cid] = (f"{int(r.iterations)} iters, "
+                        f"{ConvergenceReason(int(r.reason)).name}")
+    return out
 
 
 def persistable_artifacts(estimator: "GameEstimator", model: GameModel,

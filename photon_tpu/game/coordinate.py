@@ -67,17 +67,45 @@ class FixedEffectCoordinate:
         variance_type=None,
         intercept_index: Optional[int] = None,
     ):
-        from photon_tpu.ops.normalization import no_normalization
+        from photon_tpu.ops.normalization import (
+            NormalizationContext,
+            no_normalization,
+        )
         from photon_tpu.types import VarianceComputationType
 
         self.variance_type = variance_type or VarianceComputationType.NONE
 
         self._n_orig = batch.num_samples
+        self._model_sharded = False
+        self._dim_padded = dim
         if mesh is not None:
             from photon_tpu.parallel import mesh as M
-            # sample-shard once at construction; every solve and score pass
-            # then runs SPMD over the data axis
-            batch = M.shard_batch(batch, mesh)
+            model_par = (M.MODEL_AXIS in mesh.axis_names
+                         and M.axis_size(mesh, M.MODEL_AXIS) > 1)
+            if model_par and not isinstance(batch.features, F.SparseFeatures):
+                # feature-dimension (tensor-parallel) sharding for theta
+                # bigger than one chip's HBM (SURVEY §5.7): X placed
+                # P(data, model), theta P(model); XLA turns the partial
+                # dots of matvec/rmatvec into all-reduces over the model
+                # axis. Sparse (ELL) shards fall back to data-only
+                # sharding below — a ragged model-axis gather would
+                # shuffle every nonzero across chips each iteration,
+                # so the sparse path stays data-parallel by design.
+                batch = M.shard_features_model_parallel(batch, mesh)
+                self._model_sharded = True
+                self._dim_padded = batch.features.shape[1]
+                if norm is not None and not norm.is_identity:
+                    # pad the context to the padded feature dim
+                    pad = self._dim_padded - dim
+                    norm = NormalizationContext(
+                        None if norm.factors is None else jnp.pad(
+                            norm.factors, (0, pad), constant_values=1.0),
+                        None if norm.shifts is None else jnp.pad(
+                            norm.shifts, (0, pad)))
+            else:
+                # sample-shard once at construction; every solve and score
+                # pass then runs SPMD over the data axis
+                batch = M.shard_batch(batch, mesh)
         self.batch = batch
         self.dim = dim
         self.feature_shard_id = feature_shard_id
@@ -109,6 +137,13 @@ class FixedEffectCoordinate:
             batch = maybe_downsample(batch, self.task,
                                      self.config.down_sampling_rate, key)
         init = prev.model.coefficients.means if prev is not None else None
+        if self._model_sharded:
+            from photon_tpu.parallel import mesh as M
+            # theta lives P(model): pad to the sharded feature dim and
+            # place; zero-init also placed so the solve is fully SPMD
+            init = jnp.zeros((self.dim,), batch.labels.dtype) \
+                if init is None else jnp.asarray(init)
+            init = M.shard_coef_model_parallel(init, self.mesh)
         model, result = self.problem.run(
             batch, initial=init, dim=self.dim, dtype=batch.labels.dtype,
             # read the weight from the coordinate's (possibly sweep-updated)
@@ -127,13 +162,24 @@ class FixedEffectCoordinate:
             if var is not None:
                 model = GeneralizedLinearModel(
                     Coefficients(model.coefficients.means, var), model.task)
+        if self._model_sharded and self._dim_padded != self.dim:
+            # publish at the true feature dim; padding stays internal
+            c = model.coefficients
+            model = GeneralizedLinearModel(
+                Coefficients(c.means[: self.dim],
+                             None if c.variances is None
+                             else c.variances[: self.dim]), model.task)
         return FixedEffectModel(model, self.feature_shard_id)
 
     def score(self, model: FixedEffectModel) -> Array:
         """Training-data scores WITHOUT offsets — coordinate-descent score
         algebra sums raw model scores (reference: scoreForCoordinateDescent).
         Mesh pad rows are sliced off so score algebra stays [n]."""
-        s = _fixed_score(self.batch.features, model.model.coefficients.means)
+        coef = model.model.coefficients.means
+        if self._model_sharded:
+            from photon_tpu.parallel import mesh as M
+            coef = M.shard_coef_model_parallel(jnp.asarray(coef), self.mesh)
+        s = _fixed_score(self.batch.features, coef)
         if s.shape[0] != self._n_orig:
             s = s[: self._n_orig]
         return s

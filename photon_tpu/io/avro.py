@@ -10,8 +10,10 @@ can consume byte-for-byte.
 
 Supported: null/boolean/int/long/float/double/bytes/string, records,
 enums, arrays, maps, unions, fixed; container codecs ``null`` and
-``deflate``. Schema resolution is writer-schema-only (no reader-schema
-projection) — sufficient for framework parity.
+``deflate``. Each file decodes under its writer schema; ``read_merged``
+then resolves a cross-file reader schema (top-level field union, numeric
+precedence INT < LONG < FLOAT < DOUBLE, absent -> nullable) the way the
+reference's AvroDataReader.readMerged does (:246).
 """
 
 from __future__ import annotations
@@ -394,19 +396,120 @@ def read_avro(path: str) -> Tuple[Any, List[Any]]:
         return r.schema, list(r)
 
 
+def list_avro_files(path: str) -> List[str]:
+    """``*.avro`` files under a directory (or the file itself), name
+    order — the reference reads part-files the same way (AvroUtils:47)."""
+    if os.path.isfile(path):
+        return [path]
+    return sorted(
+        os.path.join(path, n) for n in os.listdir(path)
+        if n.endswith(".avro") and not n.startswith("."))
+
+
 def iter_avro_dir(path: str) -> Iterator[Any]:
     """Iterate records across all ``*.avro`` files in a directory (or a
-    single file) in name order — the reference reads part-files the same
-    way (AvroUtils.scala:47)."""
-    if os.path.isfile(path):
-        files = [path]
-    else:
-        files = sorted(
-            os.path.join(path, n) for n in os.listdir(path)
-            if n.endswith(".avro") and not n.startswith("."))
-    for fp in files:
+    single file) in name order."""
+    for fp in list_avro_files(path):
         with open(fp, "rb") as f:
             yield from AvroFileReader(f)
+
+
+# -- cross-file reader-schema resolution -------------------------------------
+
+_NUMERIC_WIDTH = {"int": 0, "long": 1, "float": 2, "double": 3}
+
+
+def _field_core_type(t) -> Tuple[Any, bool]:
+    """(non-null branch, nullable) of a field type; a multi-branch union
+    stays as-is."""
+    if isinstance(t, list):
+        non_null = [x for x in t if x != "null"]
+        return (non_null[0] if len(non_null) == 1 else non_null,
+                "null" in t)
+    return t, False
+
+
+def _merge_field_types(a, b, name: str):
+    """Widest numeric type wins (INT < LONG < FLOAT < DOUBLE); identical
+    types pass through; anything else is a schema conflict (reference:
+    AvroDataReader.checkAndConvertTypes / numeric precedence :246)."""
+    if a == b:
+        return a
+    if isinstance(a, str) and isinstance(b, str) \
+            and a in _NUMERIC_WIDTH and b in _NUMERIC_WIDTH:
+        return a if _NUMERIC_WIDTH[a] >= _NUMERIC_WIDTH[b] else b
+    raise ValueError(
+        f"incompatible Avro schemas across files for field {name!r}: "
+        f"{a!r} vs {b!r}")
+
+
+def merge_schemas(schemas: List[Any]) -> Any:
+    """Reader-schema resolution across container files: the union of all
+    top-level fields, numeric types widened by precedence, a field
+    nullable when any writer declares it nullable OR omits it entirely
+    (reference: AvroDataReader.readMerged field merge :246)."""
+    merged: Dict[str, list] = {}     # name -> [type, nullable, seen_count]
+    order: List[str] = []
+    for s in schemas:
+        for f in s["fields"]:
+            t, nullable = _field_core_type(f["type"])
+            slot = merged.get(f["name"])
+            if slot is None:
+                merged[f["name"]] = [t, nullable, 1]
+                order.append(f["name"])
+            else:
+                slot[0] = _merge_field_types(slot[0], t, f["name"])
+                slot[1] = slot[1] or nullable
+                slot[2] += 1
+    fields = []
+    for name in order:
+        t, nullable, seen = merged[name]
+        if nullable or seen < len(schemas):
+            t = ["null", t] if not isinstance(t, list) else ["null"] + t
+        fields.append({"name": name, "type": t})
+    base = schemas[0]
+    return {"type": "record", "name": base.get("name", "Merged"),
+            "namespace": base.get("namespace", ""), "fields": fields}
+
+
+def read_merged(paths: List[str]) -> Tuple[Any, List[Any]]:
+    """Read many files/directories under ONE resolved reader schema:
+    records gain None for fields their writer omitted, and integer values
+    of numerically-widened fields are coerced to the merged float type
+    (the reference's readMerged contract)."""
+    per_file: List[Tuple[Any, List[Any]]] = []
+    for p in paths:
+        for fp in list_avro_files(p):
+            per_file.append(read_avro(fp))
+    if not per_file:
+        return None, []
+    schemas = [s for s, _ in per_file]
+    first = json.dumps(schemas[0], sort_keys=True)
+    if all(json.dumps(s, sort_keys=True) == first for s in schemas[1:]):
+        return schemas[0], [r for _, recs in per_file for r in recs]
+
+    merged = merge_schemas(schemas)
+    float_fields = set()
+    all_names = []
+    for f in merged["fields"]:
+        t, _ = _field_core_type(f["type"])
+        all_names.append(f["name"])
+        if t in ("float", "double"):
+            float_fields.add(f["name"])
+    out: List[Any] = []
+    for schema, recs in per_file:
+        local = {f["name"] for f in schema["fields"]}
+        missing = [n for n in all_names if n not in local]
+        coerce = [n for n in float_fields if n in local]
+        for r in recs:
+            for n in missing:
+                r[n] = None
+            for n in coerce:
+                v = r[n]
+                if isinstance(v, int) and not isinstance(v, bool):
+                    r[n] = float(v)
+            out.append(r)
+    return merged, out
 
 
 def write_avro(path: str, schema: Any, records: Iterable[Any],

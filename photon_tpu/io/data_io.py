@@ -151,11 +151,11 @@ def records_to_game_dataframe(
 
 
 def read_records(directories: Sequence[str]) -> List[dict]:
-    """Read all Avro records under the given files/directories, erroring
-    clearly when nothing is found (shared by every driver)."""
-    records: List[dict] = []
-    for d in directories:
-        records.extend(avro_io.iter_avro_dir(d))
+    """Read all Avro records under the given files/directories under one
+    resolved reader schema — cross-file field union + numeric precedence
+    (reference: AvroDataReader.readMerged :246) — erroring clearly when
+    nothing is found (shared by every driver)."""
+    _, records = avro_io.read_merged(list(directories))
     if not records:
         raise ValueError(f"no Avro records under {list(directories)}")
     return records

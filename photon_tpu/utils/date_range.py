@@ -1,0 +1,100 @@
+"""Date-range input-directory resolution.
+
+Reference: photon-client util/DateRange.scala:107 (parse
+"yyyyMMdd-yyyyMMdd"), util/DaysRange.scala ("start-end" days ago,
+converted to a DateRange), util/IOUtils.getInputPathsWithinDateRange
+(expand base/daily/yyyy/MM/dd directories inside the range, erroring
+when a base dir yields nothing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import os
+import re
+from typing import List, Optional, Sequence
+
+_DATE_FMT = "%Y%m%d"
+_SPLIT = re.compile(r"\s*-\s*")
+
+
+@dataclasses.dataclass(frozen=True)
+class DateRange:
+    """Inclusive [start, end] calendar range (DateRange.scala:20)."""
+
+    start: datetime.date
+    end: datetime.date
+
+    def __post_init__(self):
+        if self.start > self.end:
+            raise ValueError(
+                f"invalid date range: {self.start} is after {self.end}")
+
+    @staticmethod
+    def from_string(text: str) -> "DateRange":
+        """Parse "yyyymmdd-yyyymmdd" (DateRange.scala:107)."""
+        parts = _SPLIT.split(text.strip())
+        if len(parts) != 2:
+            raise ValueError(f"date range must be yyyymmdd-yyyymmdd: {text!r}")
+        start, end = (datetime.datetime.strptime(p, _DATE_FMT).date()
+                      for p in parts)
+        return DateRange(start, end)
+
+    def dates(self) -> List[datetime.date]:
+        n = (self.end - self.start).days
+        return [self.start + datetime.timedelta(days=i) for i in range(n + 1)]
+
+
+@dataclasses.dataclass(frozen=True)
+class DaysRange:
+    """"start-end" DAYS AGO, start >= end (DaysRange.scala:24): e.g.
+    "90-1" = from 90 days ago through yesterday."""
+
+    start_days_ago: int
+    end_days_ago: int
+
+    def __post_init__(self):
+        if self.start_days_ago < self.end_days_ago:
+            raise ValueError(
+                f"days range start {self.start_days_ago} must be >= end "
+                f"{self.end_days_ago} (both are days ago)")
+
+    @staticmethod
+    def from_string(text: str) -> "DaysRange":
+        parts = _SPLIT.split(text.strip())
+        if len(parts) != 2:
+            raise ValueError(f"days range must be start-end: {text!r}")
+        return DaysRange(int(parts[0]), int(parts[1]))
+
+    def to_date_range(self, today: Optional[datetime.date] = None) -> DateRange:
+        today = today or datetime.date.today()
+        return DateRange(today - datetime.timedelta(days=self.start_days_ago),
+                         today - datetime.timedelta(days=self.end_days_ago))
+
+
+def daily_path(base: str, day: datetime.date) -> str:
+    """base/daily/yyyy/MM/dd (IOUtils.getInputPathsWithinDateRange)."""
+    return os.path.join(base, "daily", f"{day.year:04d}", f"{day.month:02d}",
+                        f"{day.day:02d}")
+
+
+def resolve_input_dirs(
+    base_dirs: Sequence[str],
+    date_range: Optional[DateRange],
+) -> List[str]:
+    """With no range, pass the dirs through; with one, expand each base to
+    its existing daily partitions inside the range, erroring when a base
+    contributes nothing (reference: IOUtils errors on empty ranges)."""
+    if date_range is None:
+        return list(base_dirs)
+    out: List[str] = []
+    for base in base_dirs:
+        found = [p for d in date_range.dates()
+                 if os.path.isdir(p := daily_path(base, d))]
+        if not found:
+            raise ValueError(
+                f"no daily input under {base} within "
+                f"{date_range.start:%Y%m%d}-{date_range.end:%Y%m%d}")
+        out.extend(found)
+    return out

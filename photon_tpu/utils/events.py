@@ -61,12 +61,22 @@ class EventEmitter:
         with self._lock:
             self._listeners.append(listener)
 
-    def register_by_class_name(self, class_name: str) -> None:
+    def register_by_class_name(self, class_name: str) -> EventListener:
         """Reference: listeners registered by fully-qualified class name
-        from the CLI (Driver.scala:62-73)."""
+        from the CLI (Driver.scala:62-73). Returns the instance so callers
+        can unregister exactly what they added."""
         module, _, cls = class_name.rpartition(".")
         listener_cls = getattr(importlib.import_module(module), cls)
-        self.register(listener_cls())
+        listener = listener_cls()
+        self.register(listener)
+        return listener
+
+    def unregister(self, listener: EventListener) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(listener)
+            except ValueError:
+                pass
 
     def emit(self, event: Event) -> None:
         with self._lock:
@@ -94,3 +104,34 @@ class CollectingListener(EventListener):
 
 # default process-wide emitter (drivers emit here)
 emitter = EventEmitter()
+
+
+class driver_listeners:
+    """Scope a driver run's CLI-registered listeners on the process-wide
+    emitter: register on enter, unregister + close on exit — WITHOUT
+    touching listeners other code registered (an embedding application's
+    listeners survive a driver run). Registration failures roll back the
+    partial set before re-raising."""
+
+    def __init__(self, class_names):
+        self._names = list(class_names or [])
+        self._mine = []
+
+    def __enter__(self):
+        try:
+            for name in self._names:
+                self._mine.append(emitter.register_by_class_name(name))
+        except Exception:
+            self._cleanup()
+            raise
+        return self
+
+    def __exit__(self, *exc):
+        self._cleanup()
+        return False
+
+    def _cleanup(self):
+        for listener in self._mine:
+            emitter.unregister(listener)
+            listener.close()
+        self._mine.clear()

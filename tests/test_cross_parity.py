@@ -1,0 +1,151 @@
+"""Cross-implementation semantic parity on the reference's own artifacts
+(VERDICT r2 weak #6 / item 6 — the GameTrainingDriverIntegTest
+.compareModelEvaluation style oracle, :613-704).
+
+The reference checks in a full persisted GAME model
+(GameIntegTest/gameModel: 14,982-coefficient fixed effect over
+features+userFeatures+songFeatures) and a yahoo-music input fixture.
+The claim under test is SEMANTIC, not just serialization: our whole
+ingest -> index -> score pipeline, fed the reference's model and the
+reference's data, must reproduce the mathematically-defined GAME score
+computed by an independent plain-dict oracle over the raw (name, term)
+records — and the evaluation metrics computed from those scores must
+match a hand-rolled metric.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.evaluation.multi import EvaluationSuite
+from photon_tpu.game.scoring import GameScorer
+from photon_tpu.io.avro import read_avro
+from photon_tpu.io.data_io import (
+    FeatureShardConfiguration,
+    build_index_maps,
+    records_to_game_dataframe,
+)
+from photon_tpu.io.index_map import INTERCEPT_KEY, IndexMap, feature_key
+from photon_tpu.io.model_io import load_game_model
+from photon_tpu.types import TaskType
+
+REFERENCE = "/root/reference/photon-client/src/integTest/resources/GameIntegTest"
+pytestmark = pytest.mark.skipif(not os.path.isdir(REFERENCE),
+                                reason="reference not mounted")
+
+BAGS = ("features", "userFeatures", "songFeatures")
+
+
+def _oracle_scores(recs, coef_lookup):
+    """Independent score computation straight off the raw records: for
+    each record, sum value * coefficient over every bag's (name, term)
+    pairs, plus the intercept. Duplicate (name, term) entries follow our
+    reader's documented last-wins rule (the reference instead REQUIRES
+    no duplicates — AvroDataReader.scala:319-324 — so any behavior here
+    is an extension, and last-wins is ours)."""
+    out = np.zeros(len(recs))
+    for i, r in enumerate(recs):
+        seen = {}
+        for bag in BAGS:
+            for m in r[bag]:
+                seen[(str(m["name"]), str(m["term"]))] = float(m["value"])
+        out[i] = sum(coef_lookup.get(k, 0.0) * v for k, v in seen.items())
+        out[i] += coef_lookup.get(("(INTERCEPT)", ""), 0.0)
+    return out
+
+
+def test_reference_model_scores_match_plain_oracle():
+    # the reference's own persisted coefficients, raw
+    _, mrecs = read_avro(f"{REFERENCE}/gameModel/fixed-effect/globalShard/"
+                         "coefficients/part-00000.avro")
+    means = mrecs[0]["means"]
+    coef_lookup = {(str(m["name"]), str(m["term"])): float(m["value"])
+                   for m in means}
+    im = IndexMap.from_keys(
+        [feature_key(str(m["name"]), str(m["term"])) for m in means])
+
+    # the reference's own input fixture, through OUR reader + pipeline
+    _, recs = read_avro(
+        f"{REFERENCE}/input/duplicateFeatures/yahoo-music-train.avro")
+    shards = {"globalShard": FeatureShardConfiguration.of(
+        *BAGS, intercept=im.get_index(INTERCEPT_KEY) >= 0)}
+    df = records_to_game_dataframe(recs, shards, {"globalShard": im},
+                                   response_columns=("response",))
+
+    loaded = load_game_model(f"{REFERENCE}/gameModel", {"globalShard": im},
+                             dtype=np.float64)
+    assert loaded.task == TaskType.LINEAR_REGRESSION
+
+    scorer = GameScorer(df.num_samples, dtype=np.float64)
+    scorer.add_fixed_effect("globalShard", df, "globalShard")
+    ours = np.asarray(scorer.score(loaded.model))
+
+    expected = _oracle_scores(recs, coef_lookup)
+    np.testing.assert_allclose(ours, expected, rtol=1e-10, atol=1e-12,
+                               err_msg="pipeline score != plain-dict oracle")
+
+    # evaluation parity, compareModelEvaluation-style: the suite's RMSE on
+    # these scores equals the hand-rolled RMSE
+    y = np.asarray(df.response)
+    suite = EvaluationSuite(["RMSE"], y, dtype=np.float64)
+    rmse_suite = suite.evaluate(np.asarray(ours)).evaluations["RMSE"]
+    rmse_hand = float(np.sqrt(np.mean((expected - y) ** 2)))
+    assert rmse_suite == pytest.approx(rmse_hand, rel=1e-9)
+
+
+def test_fresh_model_evaluation_matches_through_persistence(tmp_path):
+    """compareModelEvaluation proper: train a fresh repo model on the
+    reference's fixture data, save it in the reference layout, reload it,
+    and assert the reloaded model's evaluation equals the in-memory
+    model's (the reference compares two model dirs the same way)."""
+    from photon_tpu.estimators.game_estimator import (
+        CoordinateConfiguration,
+        FixedEffectDataConfiguration,
+        GameEstimator,
+        GameTransformer,
+        persistable_artifacts,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+
+    _, recs = read_avro(
+        f"{REFERENCE}/input/duplicateFeatures/yahoo-music-train.avro")
+    shards = {"globalShard": FeatureShardConfiguration.of(*BAGS)}
+    imaps = build_index_maps(recs, shards)
+    df = records_to_game_dataframe(recs, shards, imaps,
+                                   response_columns=("response",))
+
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=50, tolerance=1e-9),
+        regularization=L2Regularization, regularization_weight=1.0)
+    est = GameEstimator(
+        TaskType.LINEAR_REGRESSION,
+        {"global": CoordinateConfiguration(
+            FixedEffectDataConfiguration("globalShard"), opt)},
+        dtype=np.float64)
+    res = est.fit(df)
+    in_memory = res[-1].model
+
+    d = str(tmp_path / "model")
+    model, projections = persistable_artifacts(est, in_memory)
+    save_game_model(d, model, imaps, vocab=est._vocab,
+                    projections=projections,
+                    coordinate_configs=res[-1].config,
+                    sparsity_threshold=0.0)
+    reloaded = load_game_model(d, imaps, dtype=np.float64)
+
+    scores_mem = np.asarray(GameTransformer(in_memory, est).transform(df))
+    scorer = GameScorer(df.num_samples, dtype=np.float64)
+    scorer.add_fixed_effect("global", df, "globalShard")
+    scores_disk = np.asarray(scorer.score(reloaded.model))
+
+    y = np.asarray(df.response)
+    suite = EvaluationSuite(["RMSE"], y, dtype=np.float64)
+    rmse_mem = suite.evaluate(np.asarray(scores_mem)).evaluations["RMSE"]
+    rmse_disk = suite.evaluate(np.asarray(scores_disk)).evaluations["RMSE"]
+    assert rmse_disk == pytest.approx(rmse_mem, rel=1e-9)

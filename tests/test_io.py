@@ -346,3 +346,123 @@ def test_variance_only_features_survive_roundtrip(tmp_path):
     # mean fell below threshold but its variance survives
     assert got[1] == (pytest.approx(0.0), pytest.approx(0.2))
     assert got[2] == (pytest.approx(0.25), pytest.approx(0.3))
+
+
+# -- cross-file reader-schema resolution (AvroDataReader.readMerged :246) ----
+
+
+def _mini_schema(fields):
+    return {"type": "record", "name": "T", "namespace": "t", "fields": fields}
+
+
+def test_merge_schemas_numeric_precedence_and_field_union(tmp_path):
+    from photon_tpu.io.avro import merge_schemas, read_merged
+
+    s1 = _mini_schema([{"name": "response", "type": "int"},
+                       {"name": "weight", "type": "float"}])
+    s2 = _mini_schema([{"name": "response", "type": "double"},
+                       {"name": "offset", "type": "long"}])
+    merged = merge_schemas([s1, s2])
+    by_name = {f["name"]: f["type"] for f in merged["fields"]}
+    assert by_name["response"] == "double"          # int < double
+    assert by_name["weight"] == ["null", "float"]   # absent in s2 -> nullable
+    assert by_name["offset"] == ["null", "long"]    # absent in s1 -> nullable
+
+    d = tmp_path / "multi"
+    d.mkdir()
+    write_avro(str(d / "a.avro"), s1, [{"response": 1, "weight": 2.0}])
+    write_avro(str(d / "b.avro"), s2, [{"response": 0.5, "offset": 7}])
+    schema, recs = read_merged([str(d)])
+    assert {f["name"] for f in schema["fields"]} == {"response", "weight",
+                                                     "offset"}
+    # int response coerced to the merged double type; missing fields None
+    assert recs[0] == {"response": 1.0, "weight": 2.0, "offset": None}
+    assert isinstance(recs[0]["response"], float)
+    assert recs[1] == {"response": 0.5, "offset": 7, "weight": None}
+
+
+def test_merge_schemas_incompatible_types_raise():
+    from photon_tpu.io.avro import merge_schemas
+
+    s1 = _mini_schema([{"name": "x", "type": "string"}])
+    s2 = _mini_schema([{"name": "x", "type": "double"}])
+    with pytest.raises(ValueError, match="incompatible"):
+        merge_schemas([s1, s2])
+
+
+def test_read_merged_identical_schemas_fast_path(tmp_path):
+    from photon_tpu.io.avro import read_merged
+
+    d = tmp_path / "same"
+    d.mkdir()
+    for i in range(2):
+        write_avro(str(d / f"p{i}.avro"), TRAINING_EXAMPLE_AVRO,
+                   [{"uid": f"u{i}", "label": float(i), "features": [],
+                     "metadataMap": None, "weight": None, "offset": None}])
+    schema, recs = read_merged([str(d)])
+    assert schema["name"] == "TrainingExampleAvro"
+    assert [r["uid"] for r in recs] == ["u0", "u1"]
+
+
+# -- date-range input resolution (DateRange.scala:107, IOUtils) --------------
+
+
+def test_date_range_parse_and_resolution(tmp_path):
+    import datetime
+
+    from photon_tpu.utils.date_range import (
+        DateRange,
+        DaysRange,
+        daily_path,
+        resolve_input_dirs,
+    )
+
+    r = DateRange.from_string("20260728-20260730")
+    assert [d.day for d in r.dates()] == [28, 29, 30]
+    with pytest.raises(ValueError, match="after"):
+        DateRange.from_string("20260730-20260728")
+
+    base = str(tmp_path / "in")
+    for day in (28, 29):
+        os.makedirs(daily_path(base, datetime.date(2026, 7, day)))
+    dirs = resolve_input_dirs([base], r)
+    assert len(dirs) == 2 and dirs[0].endswith(os.path.join("07", "28"))
+    # passthrough without a range
+    assert resolve_input_dirs([base], None) == [base]
+    with pytest.raises(ValueError, match="no daily input"):
+        resolve_input_dirs([base], DateRange.from_string("20250101-20250102"))
+
+    dr = DaysRange.from_string("90-1")
+    today = datetime.date(2026, 7, 29)
+    conv = dr.to_date_range(today)
+    assert conv.start == today - datetime.timedelta(days=90)
+    assert conv.end == today - datetime.timedelta(days=1)
+    with pytest.raises(ValueError, match="must be >="):
+        DaysRange.from_string("1-90")
+
+
+def test_train_driver_date_range_inputs(tmp_path):
+    """Driver reads daily partitions selected by --input-data-date-range."""
+    import datetime
+
+    from photon_tpu.cli import train
+    from photon_tpu.utils.date_range import daily_path
+    from tests.test_drivers import FIXED_COORD, _write_game_records
+
+    base = str(tmp_path / "data")
+    for i, day in enumerate((1, 2, 3)):
+        d = daily_path(base, datetime.date(2026, 7, day))
+        _write_game_records(os.path.join(d, "part.avro"), n=150, seed=i)
+    out = str(tmp_path / "out")
+    results = train.run(train.build_arg_parser().parse_args([
+        "--input-data-directories", base,
+        "--input-data-date-range", "20260701-20260702",  # day 3 excluded
+        "--validation-data-directories", base,
+        "--validation-data-date-range", "20260703-20260703",
+        "--root-output-directory", out,
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--coordinate-configuration", FIXED_COORD,
+        "--coordinate-update-sequence", "fixed",
+    ]))
+    assert results[0].evaluation["AUC"] > 0.7

@@ -171,3 +171,80 @@ def test_photon_logger_writes_file(tmp_path):
     assert parse_level("WARN") == logging.WARNING
     with pytest.raises(ValueError):
         parse_level("NOPE")
+
+
+# -- driver event wiring (reference: Driver.scala:62-73 listener registration
+# by class name + lifecycle events around the stage machine) ----------------
+
+class RecordingListener:
+    """Registered by fully-qualified class name through the CLI flag."""
+
+    captured = []  # class-level: the driver instantiates us internally
+
+    def on_event(self, event):
+        RecordingListener.captured.append(event)
+
+    def close(self):
+        RecordingListener.captured.append("closed")
+
+
+def test_train_driver_emits_lifecycle_events(tmp_path):
+    from photon_tpu.cli import train
+    from tests.test_drivers import FIXED_COORD, _write_game_records
+
+    RecordingListener.captured.clear()
+    data = str(tmp_path / "data" / "train.avro")
+    _write_game_records(data, n=300, seed=9)
+    train.run(train.build_arg_parser().parse_args([
+        "--input-data-directories", os.path.dirname(data),
+        "--validation-data-directories", os.path.dirname(data),
+        "--root-output-directory", str(tmp_path / "out"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--coordinate-configuration", FIXED_COORD,
+        "--coordinate-update-sequence", "fixed",
+        "--event-listeners",
+        f"{RecordingListener.__module__}.RecordingListener",
+    ]))
+    names = [e if isinstance(e, str) else e.name
+             for e in RecordingListener.captured]
+    assert names == ["PhotonSetupEvent", "TrainingStartEvent",
+                     "PhotonOptimizationLogEvent", "TrainingFinishEvent",
+                     "closed"]
+    log_ev = RecordingListener.captured[2]
+    assert "tracker/fixed" in log_ev.payload
+    assert log_ev.payload["evaluation"]["AUC"] > 0.5
+    finish = RecordingListener.captured[3]
+    assert finish.payload["best_evaluation"]["AUC"] > 0.5
+
+
+def test_score_driver_emits_events(tmp_path):
+    from photon_tpu.cli import score, train
+    from tests.test_drivers import FIXED_COORD, _write_game_records
+
+    data = str(tmp_path / "data" / "train.avro")
+    _write_game_records(data, n=300, seed=10)
+    train.run(train.build_arg_parser().parse_args([
+        "--input-data-directories", os.path.dirname(data),
+        "--root-output-directory", str(tmp_path / "out"),
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--coordinate-configuration", FIXED_COORD,
+        "--coordinate-update-sequence", "fixed",
+        "--output-mode", "BEST",
+    ]))
+    RecordingListener.captured.clear()
+    score.run(score.build_arg_parser().parse_args([
+        "--input-data-directories", os.path.dirname(data),
+        "--model-input-directory", str(tmp_path / "out" / "best"),
+        "--root-output-directory", str(tmp_path / "scores"),
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--evaluators", "AUC",
+        "--event-listeners",
+        f"{RecordingListener.__module__}.RecordingListener",
+    ]))
+    names = [e if isinstance(e, str) else e.name
+             for e in RecordingListener.captured]
+    assert names == ["PhotonSetupEvent", "ScoringFinishEvent", "closed"]
+    assert RecordingListener.captured[1].payload["num_scored"] == 300
+    assert RecordingListener.captured[1].payload["evaluation"]["AUC"] > 0.5
