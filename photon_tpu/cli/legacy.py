@@ -272,6 +272,8 @@ class LegacyDriver:
 
 
 def main(argv: Optional[List[str]] = None) -> LegacyDriver:
+    from photon_tpu.utils.compile_cache import maybe_enable
+    maybe_enable()
     args = build_arg_parser().parse_args(argv)
     logging.basicConfig(level=args.log_level,
                         format="%(asctime)s %(name)s %(levelname)s %(message)s")

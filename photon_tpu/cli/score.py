@@ -131,6 +131,8 @@ def _run(args: argparse.Namespace) -> np.ndarray:
 
 
 def main(argv: Optional[List[str]] = None) -> None:
+    from photon_tpu.utils.compile_cache import maybe_enable
+    maybe_enable()
     run(build_arg_parser().parse_args(argv))
 
 

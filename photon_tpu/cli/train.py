@@ -422,6 +422,8 @@ def save_models(args, estimator, results, tuned, index_maps, out_dir) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> None:
+    from photon_tpu.utils.compile_cache import maybe_enable
+    maybe_enable()
     run(build_arg_parser().parse_args(argv))
 
 

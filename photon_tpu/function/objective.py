@@ -127,6 +127,29 @@ class GLMObjective:
         )
         return hv + hyper.l2_weight * vector
 
+    def hessian_weights(self, coef: Array, batch: DataBatch) -> Array:
+        """Per-sample curvature weights, constant over one TRON CG solve."""
+        return aggregators.hessian_weights(
+            self.loss, batch.features, batch.labels, batch.offsets, batch.weights,
+            coef, self.norm,
+        )
+
+    def hessian_vector_from_weights(
+        self, d2: Array, vector: Array, batch: DataBatch, hyper: Hyper
+    ) -> Array:
+        hv = aggregators.hessian_vector_from_weights(
+            batch.features, d2, vector, self.norm, vector.shape[0],
+        )
+        return hv + hyper.l2_weight * vector
+
+    def hessian_matrix_from_weights(
+        self, d2: Array, dim: int, batch: DataBatch, hyper: Hyper
+    ) -> Array:
+        h = aggregators.hessian_matrix_from_weights(
+            batch.features, d2, self.norm, dim,
+        )
+        return h + hyper.l2_weight * jnp.eye(dim, dtype=h.dtype)
+
     def hessian_diagonal(self, coef: Array, batch: DataBatch, hyper: Hyper) -> Array:
         d = aggregators.hessian_diagonal(
             self.loss, batch.features, batch.labels, batch.offsets, batch.weights,

@@ -82,18 +82,31 @@ class FixedEffectCoordinate:
             from photon_tpu.parallel import mesh as M
             model_par = (M.MODEL_AXIS in mesh.axis_names
                          and M.axis_size(mesh, M.MODEL_AXIS) > 1)
-            if model_par and not isinstance(batch.features, F.SparseFeatures):
+            if model_par:
                 # feature-dimension (tensor-parallel) sharding for theta
-                # bigger than one chip's HBM (SURVEY §5.7): X placed
+                # bigger than one chip's HBM (SURVEY §5.7). Dense: X placed
                 # P(data, model), theta P(model); XLA turns the partial
                 # dots of matvec/rmatvec into all-reduces over the model
-                # axis. Sparse (ELL) shards fall back to data-only
-                # sharding below — a ragged model-axis gather would
-                # shuffle every nonzero across chips each iteration,
-                # so the sparse path stays data-parallel by design.
-                batch = M.shard_features_model_parallel(batch, mesh)
+                # axis. Sparse (ELL): nonzeros are re-partitioned at ingest
+                # into per-feature-range blocks with LOCAL ids — the
+                # billion-coefficient workload the reference serves with
+                # partitioned PalDB indexes (PalDBIndexMap.scala:43) —
+                # and margins/gradients psum over model/data axes via
+                # shard_map (ops/features.ModelShardedSparse).
+                if isinstance(batch.features, F.SparseFeatures):
+                    if self.variance_type == VarianceComputationType.FULL:
+                        raise ValueError(
+                            "FULL variance needs the dense d x d Hessian, "
+                            "which contradicts model-axis sharding of a "
+                            "sparse theta; use SIMPLE variance or a "
+                            "data-parallel mesh for this coordinate")
+                    batch = M.shard_sparse_features_model_parallel(
+                        batch, mesh, dim)
+                    self._dim_padded = batch.features.padded_dim
+                else:
+                    batch = M.shard_features_model_parallel(batch, mesh)
+                    self._dim_padded = batch.features.shape[1]
                 self._model_sharded = True
-                self._dim_padded = batch.features.shape[1]
                 if norm is not None and not norm.is_identity:
                     # pad the context to the padded feature dim
                     pad = self._dim_padded - dim
@@ -143,7 +156,8 @@ class FixedEffectCoordinate:
             # place; zero-init also placed so the solve is fully SPMD
             init = jnp.zeros((self.dim,), batch.labels.dtype) \
                 if init is None else jnp.asarray(init)
-            init = M.shard_coef_model_parallel(init, self.mesh)
+            init = M.shard_coef_model_parallel(init, self.mesh,
+                                               padded_dim=self._dim_padded)
         model, result = self.problem.run(
             batch, initial=init, dim=self.dim, dtype=batch.labels.dtype,
             # read the weight from the coordinate's (possibly sweep-updated)
@@ -178,7 +192,8 @@ class FixedEffectCoordinate:
         coef = model.model.coefficients.means
         if self._model_sharded:
             from photon_tpu.parallel import mesh as M
-            coef = M.shard_coef_model_parallel(jnp.asarray(coef), self.mesh)
+            coef = M.shard_coef_model_parallel(jnp.asarray(coef), self.mesh,
+                                               padded_dim=self._dim_padded)
         s = _fixed_score(self.batch.features, coef)
         if s.shape[0] != self._n_orig:
             s = s[: self._n_orig]

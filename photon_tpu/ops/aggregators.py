@@ -103,6 +103,66 @@ def value_and_gradient(
     return value, grad
 
 
+def hessian_weights(
+    loss: PointwiseLoss,
+    x: FeatureMatrix,
+    labels: Array,
+    offsets: Optional[Array],
+    weights: Optional[Array],
+    coef: Array,
+    norm: NormalizationContext,
+) -> Array:
+    """Per-sample Gauss-Newton curvature weights ``w_i l''(margin_i)``.
+
+    The Hessian at a fixed coefficient point is fully determined by these
+    weights; they are constant across an entire truncated-CG solve, so TRON
+    computes them ONCE per outer iteration instead of re-deriving margins
+    inside every Hv product (the reference pays one extra treeAggregate per
+    CG step for exactly this — HessianVectorAggregator.scala:37)."""
+    margins = compute_margins(x, coef, offsets, norm)
+    d2 = loss.d2z(margins, labels)
+    if weights is not None:
+        d2 = d2 * weights
+    return d2
+
+
+def hessian_vector_from_weights(
+    x: FeatureMatrix,
+    d2: Array,
+    vector: Array,
+    norm: NormalizationContext,
+    dim: int,
+) -> Array:
+    """Hv given precomputed curvature weights: two passes over X."""
+    v_eff = vector * norm.factors if norm.factors is not None else vector
+    t = matvec(x, v_eff)
+    if norm.shifts is not None:
+        t = t - jnp.dot(v_eff, norm.shifts)
+    coeffs = d2 * t
+    vector_sum = rmatvec(x, coeffs, dim)
+    return _apply_factor_and_shift(vector_sum, jnp.sum(coeffs), norm)
+
+
+def hessian_matrix_from_weights(
+    x: FeatureMatrix,
+    d2: Array,
+    norm: NormalizationContext,
+    dim: int,
+) -> Array:
+    """Full H from precomputed curvature weights: one GEMM (MXU).
+
+    For small feature dims this turns a whole CG solve's data passes into a
+    single ``X^T diag(d2) X`` contraction plus O(d^2) matvecs."""
+    h = weighted_gram(x, d2, dim)
+    if norm.shifts is not None:
+        lin = rmatvec(x, d2, dim)
+        outer = jnp.outer(lin, norm.shifts)
+        h = h - outer - outer.T + jnp.sum(d2) * jnp.outer(norm.shifts, norm.shifts)
+    if norm.factors is not None:
+        h = h * jnp.outer(norm.factors, norm.factors)
+    return h
+
+
 def hessian_vector(
     loss: PointwiseLoss,
     x: FeatureMatrix,
@@ -116,18 +176,8 @@ def hessian_vector(
     """Gauss-Newton Hessian-vector product (reference:
     HessianVectorAggregator.calcHessianVector :130/:158), used by TRON CG."""
     dim = coef.shape[0]
-    margins = compute_margins(x, coef, offsets, norm)
-    d2 = loss.d2z(margins, labels)
-    if weights is not None:
-        d2 = d2 * weights
-
-    v_eff = vector * norm.factors if norm.factors is not None else vector
-    t = matvec(x, v_eff)
-    if norm.shifts is not None:
-        t = t - jnp.dot(v_eff, norm.shifts)
-    coeffs = d2 * t
-    vector_sum = rmatvec(x, coeffs, dim)
-    return _apply_factor_and_shift(vector_sum, jnp.sum(coeffs), norm)
+    d2 = hessian_weights(loss, x, labels, offsets, weights, coef, norm)
+    return hessian_vector_from_weights(x, d2, vector, norm, dim)
 
 
 def hessian_diagonal(
@@ -171,16 +221,5 @@ def hessian_matrix(
     HessianMatrixAggregator.calcHessianMatrix :92/:116); FULL variance,
     small dims only."""
     dim = coef.shape[0]
-    margins = compute_margins(x, coef, offsets, norm)
-    d2 = loss.d2z(margins, labels)
-    if weights is not None:
-        d2 = d2 * weights
-
-    h = weighted_gram(x, d2, dim)
-    if norm.shifts is not None:
-        lin = rmatvec(x, d2, dim)
-        outer = jnp.outer(lin, norm.shifts)
-        h = h - outer - outer.T + jnp.sum(d2) * jnp.outer(norm.shifts, norm.shifts)
-    if norm.factors is not None:
-        h = h * jnp.outer(norm.factors, norm.factors)
-    return h
+    d2 = hessian_weights(loss, x, labels, offsets, weights, coef, norm)
+    return hessian_matrix_from_weights(x, d2, norm, dim)

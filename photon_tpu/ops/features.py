@@ -19,11 +19,13 @@ plus ``weighted_gram`` for small-dimension full Hessians.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec
 
 Array = jax.Array
 
@@ -36,22 +38,98 @@ class SparseFeatures(NamedTuple):
     values: Array   # [n, k] float
 
 
-FeatureMatrix = Union[Array, SparseFeatures]
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ModelShardedSparse:
+    """Feature-range-partitioned ELL rows for model-parallel sparse theta.
+
+    The TPU answer to the reference's partitioned PalDB feature indexes
+    (PalDBIndexMap.scala:43) feeding "hundreds of billions of coefficients"
+    (README.md:56): theta is range-sharded over the mesh's model axis, and
+    each sample's nonzeros are pre-partitioned AT INGEST into one ELL block
+    per range with LOCAL column ids. On device, margins are per-shard
+    gather-dots psum-ed over the model axis, and gradients are per-shard
+    local scatters psum-ed over the data axis — no nonzero ever crosses a
+    chip boundary after ingest (SURVEY §5.7's "moral equivalent of sequence
+    parallelism").
+
+    ``indices``/``values`` are ``[P, n, kp]`` with ``indices[p, i, j]`` the
+    LOCAL id (global id − p·shard_size) of the j-th in-range nonzero of
+    sample i; pad slots are ``(0, 0.0)``. Placement: ``P(model, data)``.
+    """
+
+    indices: Array  # [P, n, kp] int32, local ids
+    values: Array   # [P, n, kp]
+    shard_size: int = dataclasses.field(metadata=dict(static=True))
+    mesh: jax.sharding.Mesh = dataclasses.field(metadata=dict(static=True))
+    data_axis: str = dataclasses.field(default="data",
+                                       metadata=dict(static=True))
+    model_axis: str = dataclasses.field(default="model",
+                                        metadata=dict(static=True))
+
+    @property
+    def padded_dim(self) -> int:
+        return self.indices.shape[0] * self.shard_size
+
+    @property
+    def shape(self):  # (n, d_padded) by analogy with a dense matrix
+        return (self.values.shape[1], self.padded_dim)
+
+
+FeatureMatrix = Union[Array, SparseFeatures, ModelShardedSparse]
 
 
 def num_samples(x: FeatureMatrix) -> int:
+    if isinstance(x, ModelShardedSparse):
+        return x.values.shape[1]
     return (x.values if isinstance(x, SparseFeatures) else x).shape[0]
+
+
+def _ms_specs(x: ModelShardedSparse):
+    ell = PartitionSpec(x.model_axis, x.data_axis, None)
+    return ell, PartitionSpec(x.model_axis), PartitionSpec(x.data_axis)
 
 
 def matvec(x: FeatureMatrix, theta: Array) -> Array:
     """Per-sample margins ``X @ theta`` -> [n]."""
+    if isinstance(x, ModelShardedSparse):
+        ell, model_vec, data_vec = _ms_specs(x)
+
+        def f(idx, val, th):
+            # idx/val [1, n_local, kp]; th [shard_size] = this chip's range
+            part = jnp.sum(val[0] * th[idx[0]], axis=-1)
+            return jax.lax.psum(part, x.model_axis)
+
+        return jax.shard_map(f, mesh=x.mesh,
+                             in_specs=(ell, ell, model_vec),
+                             out_specs=data_vec)(x.indices, x.values, theta)
     if isinstance(x, SparseFeatures):
         return jnp.sum(x.values * theta[x.indices], axis=-1)
     return x @ theta
 
 
+def _ms_scatter(x: ModelShardedSparse, w: Array, square: bool) -> Array:
+    """Shared shard_map scatter for X^T w / (X*X)^T w on the model-sharded
+    layout: local scatters into this chip's theta range, psum over data."""
+    ell, model_vec, data_vec = _ms_specs(x)
+    shard_size = x.shard_size
+
+    def f(idx, val, wl):
+        v = val[0] * val[0] if square else val[0]
+        contrib = (v * wl[:, None]).ravel()
+        g = jnp.zeros((shard_size,), dtype=contrib.dtype)
+        g = g.at[idx[0].ravel()].add(contrib)
+        return jax.lax.psum(g, x.data_axis)
+
+    return jax.shard_map(f, mesh=x.mesh,
+                         in_specs=(ell, ell, data_vec),
+                         out_specs=model_vec)(x.indices, x.values, w)
+
+
 def rmatvec(x: FeatureMatrix, w: Array, dim: int) -> Array:
     """``X^T w`` -> [d]; ``w`` is a per-sample weight vector [n]."""
+    if isinstance(x, ModelShardedSparse):
+        return _ms_scatter(x, w, square=False)
     if isinstance(x, SparseFeatures):
         contrib = (x.values * w[:, None]).ravel()
         return jnp.zeros((dim,), dtype=contrib.dtype).at[x.indices.ravel()].add(contrib)
@@ -60,6 +138,8 @@ def rmatvec(x: FeatureMatrix, w: Array, dim: int) -> Array:
 
 def sq_rmatvec(x: FeatureMatrix, w: Array, dim: int) -> Array:
     """``(X * X)^T w`` -> [d] (elementwise square), for Hessian diagonals."""
+    if isinstance(x, ModelShardedSparse):
+        return _ms_scatter(x, w, square=True)
     if isinstance(x, SparseFeatures):
         contrib = (x.values * x.values * w[:, None]).ravel()
         return jnp.zeros((dim,), dtype=contrib.dtype).at[x.indices.ravel()].add(contrib)
@@ -69,6 +149,10 @@ def sq_rmatvec(x: FeatureMatrix, w: Array, dim: int) -> Array:
 def weighted_gram(x: FeatureMatrix, w: Array, dim: int) -> Array:
     """``X^T diag(w) X`` -> [d, d], for small-dim full Hessians
     (reference: HessianMatrixAggregator.scala:31)."""
+    if isinstance(x, ModelShardedSparse):
+        raise NotImplementedError(
+            "model-sharded sparse theta is matrix-free by design: a d x d "
+            "Hessian would defeat the point of sharding theta")
     if isinstance(x, SparseFeatures):
         dense = to_dense(x, dim)
         return dense.T @ (dense * w[:, None])
@@ -76,12 +160,62 @@ def weighted_gram(x: FeatureMatrix, w: Array, dim: int) -> Array:
 
 
 def to_dense(x: FeatureMatrix, dim: int) -> Array:
+    if isinstance(x, ModelShardedSparse):
+        raise NotImplementedError("refusing to densify model-sharded features")
     if isinstance(x, SparseFeatures):
         n, k = x.indices.shape
         out = jnp.zeros((n, dim), dtype=x.values.dtype)
         rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, k))
         return out.at[rows.ravel(), x.indices.ravel()].add(x.values.ravel())
     return x
+
+
+def partition_by_feature_range(
+    sf: SparseFeatures, dim: int, n_shards: int, pad_multiple: int = 1
+) -> tuple:
+    """Host-side ingest step for model-parallel sparse training: split each
+    ELL row's nonzeros into ``n_shards`` contiguous feature ranges with
+    LOCAL column ids (the reference's partitioned-PalDB layout,
+    PalDBIndexMapBuilder.scala:27, re-done as static arrays).
+
+    Returns ``(indices [P, n, kp], values [P, n, kp], shard_size)`` as
+    numpy arrays; kp is the worst-case per-(row, range) nonzero count,
+    padded to ``pad_multiple``. Index maps that hash feature names over the
+    id space keep ranges load-balanced — partitioning is by id range, the
+    hashing already happened at index build.
+    """
+    idx = np.asarray(sf.indices)
+    val = np.asarray(sf.values)
+    n, k = idx.shape
+    shard_size = -(-dim // n_shards)  # ceil
+    if k == 0 or n == 0:
+        kp = max(pad_multiple, 1)
+        return (np.zeros((n_shards, n, kp), np.int32),
+                np.zeros((n_shards, n, kp), val.dtype), shard_size)
+    shard_of = idx // shard_size                       # [n, k]
+    # ELL pad slots (value 0) must not inflate kp: route them to a virtual
+    # shard n_shards, which sorts last and is truncated after scatter
+    shard_of = np.where(val == 0, n_shards, shard_of)
+    order = np.argsort(shard_of, axis=1, kind="stable")
+    shard_sorted = np.take_along_axis(shard_of, order, 1)
+    idx_sorted = np.take_along_axis(idx, order, 1)
+    val_sorted = np.take_along_axis(val, order, 1)
+    js = np.broadcast_to(np.arange(k), (n, k))
+    new_group = np.concatenate(
+        [np.ones((n, 1), bool), shard_sorted[:, 1:] != shard_sorted[:, :-1]], 1)
+    group_start = np.maximum.accumulate(np.where(new_group, js, 0), axis=1)
+    pos = js - group_start                             # slot within (row, range)
+    real = shard_sorted < n_shards
+    kp = int(pos[real].max()) + 1 if real.any() else 1
+    kp = -(-kp // pad_multiple) * pad_multiple
+    out_idx = np.zeros((n_shards + 1, n, max(kp, int(pos.max()) + 1)), np.int32)
+    out_val = np.zeros_like(out_idx, dtype=val.dtype)
+    rows = np.broadcast_to(np.arange(n)[:, None], (n, k))
+    out_idx[shard_sorted, rows, pos] = idx_sorted - shard_sorted * shard_size
+    out_val[shard_sorted, rows, pos] = val_sorted
+    # drop the virtual pad shard and the slots only it used
+    return (np.ascontiguousarray(out_idx[:n_shards, :, :kp]),
+            np.ascontiguousarray(out_val[:n_shards, :, :kp]), shard_size)
 
 
 def from_scipy_csr(csr, max_nnz: int | None = None, dtype=np.float32) -> SparseFeatures:

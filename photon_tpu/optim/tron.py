@@ -114,9 +114,19 @@ def minimize(
     *args,
     config: SolverConfig = SolverConfig(max_iterations=15, tolerance=1e-5),
     cg_tol_factor: float = 0.1,
+    hess_setup=None,
+    hess_apply=None,
 ) -> SolverResult:
     """Minimize with ``value_and_grad(x, *args)`` and
-    ``hess_vec(x, v, *args)`` (Hessian at x applied to v)."""
+    ``hess_vec(x, v, *args)`` (Hessian at x applied to v).
+
+    When ``hess_setup``/``hess_apply`` are given, the Hessian operator is
+    split into a once-per-outer-iteration ``hstate = hess_setup(x, *args)``
+    (e.g. Gauss-Newton curvature weights, or the explicit d x d matrix for
+    small dims) and a cheap per-CG-step ``hess_apply(hstate, v, *args)``.
+    The GLM Hessian at fixed x is fully determined by per-sample curvature
+    weights, so this removes one full data pass from every CG step
+    (reference pays it: HessianVectorAggregator.scala:37)."""
     f0, g0 = value_and_grad(x0, *args)
     tols = absolute_tolerances(f0, g0, config.tolerance)
     dtype = x0.dtype
@@ -125,7 +135,11 @@ def minimize(
         return c.reason == ConvergenceReason.NOT_CONVERGED
 
     def body(c: _Carry) -> _Carry:
-        hv = lambda v: hess_vec(c.x, v, *args)
+        if hess_setup is not None:
+            hstate = hess_setup(c.x, *args)
+            hv = lambda v: hess_apply(hstate, v, *args)
+        else:
+            hv = lambda v: hess_vec(c.x, v, *args)
         s, r = _trcg(lambda v, *_: hv(v), c.g, c.delta,
                      config.max_cg_iterations, cg_tol_factor)
 

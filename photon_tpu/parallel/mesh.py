@@ -245,10 +245,43 @@ def shard_features_model_parallel(batch: DataBatch, mesh: Mesh,
 
 
 def shard_coef_model_parallel(coef: jax.Array, mesh: Mesh,
-                              model_axis: str = MODEL_AXIS) -> jax.Array:
+                              model_axis: str = MODEL_AXIS,
+                              padded_dim: Optional[int] = None) -> jax.Array:
     d_mult = axis_size(mesh, model_axis)
     d = coef.shape[0]
-    d_pad = pad_to_multiple(d, d_mult)
+    d_pad = padded_dim if padded_dim is not None else pad_to_multiple(d, d_mult)
     if d_pad != d:
         coef = jnp.pad(coef, [(0, d_pad - d)])
     return jax.device_put(coef, NamedSharding(mesh, P(model_axis)))
+
+
+def shard_sparse_features_model_parallel(
+    batch: DataBatch, mesh: Mesh, dim: int,
+    data_axis: str = DATA_AXIS, model_axis: str = MODEL_AXIS) -> DataBatch:
+    """Sparse (ELL) feature-range sharding for model-parallel theta
+    (SURVEY §5.7, reference scale claim README.md:56): nonzeros are
+    re-partitioned ON THE HOST into per-range ELL blocks with local ids
+    (ops/features.partition_by_feature_range), placed ``P(model, data)``.
+    Margins then psum partial gather-dots over the model axis; gradients
+    psum local scatters over the data axis — the billion-feature fixed
+    effect trains without theta ever being replicated."""
+    assert isinstance(batch.features, F.SparseFeatures), \
+        "model-parallel sparse sharding needs ELL features"
+    n_shards = axis_size(mesh, model_axis)
+    batch = pad_batch(batch, axis_size(mesh, data_axis))
+    idx, val, shard_size = F.partition_by_feature_range(
+        batch.features, dim, n_shards)
+    ell = NamedSharding(mesh, P(model_axis, data_axis, None))
+    feats = F.ModelShardedSparse(
+        indices=jax.device_put(jnp.asarray(idx), ell),
+        values=jax.device_put(jnp.asarray(val), ell),
+        shard_size=shard_size, mesh=mesh,
+        data_axis=data_axis, model_axis=model_axis)
+
+    def put_vec(a):
+        return None if a is None else jax.device_put(
+            a, NamedSharding(mesh, P(data_axis)))
+
+    return DataBatch(features=feats, labels=put_vec(batch.labels),
+                     offsets=put_vec(batch.offsets),
+                     weights=put_vec(batch.weights))

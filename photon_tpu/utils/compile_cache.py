@@ -1,0 +1,61 @@
+"""Persistent XLA compilation cache across processes.
+
+The in-process jitcache (utils/jitcache.py) removes re-traces within one
+run; this module removes re-COMPILES across runs. A GAME fit's cold start
+is compile-dominated (the CD loop jits one solve per coordinate x config
+shape), so the first run of a driver on a fresh host pays tens of seconds
+that every later run can skip by loading serialized XLA executables from
+disk.
+
+The reference has no analog (JVM/Spark JITs incrementally); on TPU this is
+the standard deployment answer: ``jax.config.jax_compilation_cache_dir``.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "photon_tpu", "xla_cache")
+
+_enabled = False
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """Enable JAX's on-disk compilation cache (idempotent).
+
+    Returns the cache directory in use. Call before the first jit
+    compilation for maximum effect; later calls still help future jits.
+    """
+    global _enabled
+    import jax
+
+    path = cache_dir or os.environ.get("PHOTON_TPU_XLA_CACHE", _DEFAULT_DIR)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every executable that took meaningful time to build; the
+    # defaults skip fast compiles, which is what we want
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _enabled = True
+    return path
+
+
+def maybe_enable() -> str | None:
+    """Entry-point hook: enable the cache unless the user opted out via
+    ``PHOTON_TPU_NO_XLA_CACHE``. One opt-out semantic for every driver.
+    The cache is a pure optimization — any failure (unwritable HOME,
+    missing jax config flags) is logged, never fatal."""
+    if os.environ.get("PHOTON_TPU_NO_XLA_CACHE"):
+        return None
+    try:
+        return enable_persistent_cache()
+    except Exception as e:  # noqa: BLE001 — optional feature must not kill a driver
+        import logging
+        logging.getLogger("photon_tpu").warning(
+            "persistent XLA cache unavailable: %r", e)
+        return None
+
+
+def is_enabled() -> bool:
+    return _enabled
