@@ -96,6 +96,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="record columns carrying entity ids")
     p.add_argument("--model-input-directory", default=None,
                    help="warm-start GAME model directory")
+    p.add_argument("--checkpoint-directory", default=None,
+                   help="publish a per-sweep mid-training checkpoint here "
+                        "(params, PRNG counters, best-model bookkeeping); "
+                        "SURVEY §5.3's Spark-lineage replacement")
+    p.add_argument("--resume-from", default=None,
+                   help="resume coordinate descent from the latest sweep "
+                        "checkpoint in this directory (bitwise-equal "
+                        "continuation); implies checkpointing there")
     p.add_argument("--partial-retrain-locked-coordinates", nargs="*",
                    default=[])
     p.add_argument("--output-mode", default="BEST",
@@ -107,6 +115,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--hyper-parameter-tuning", default="NONE",
                    choices=[m.value for m in HyperparameterTuningMode])
     p.add_argument("--hyper-parameter-tuning-iter", type=int, default=0)
+    p.add_argument("--hyper-parameter-shrink-radius", type=float, default=None,
+                   help="narrow search ranges around the prior best before "
+                        "tuning; radius in rescaled [0,1] space (reference: "
+                        "ShrinkSearchRange.scala:28)")
+    p.add_argument("--hyper-parameter-prior-json", default=None,
+                   help="path to serialized prior observations "
+                        '{"records": [{<coord>: weight, "evaluationValue": '
+                        "v}]} (reference: GameHyperparameterDefaults + "
+                        "HyperparameterSerialization)")
     p.add_argument("--model-sparsity-threshold", type=float, default=1e-4)
     p.add_argument("--num-devices", type=int, default=0,
                    help="shard training over this many devices (0 = single)")
@@ -339,10 +356,13 @@ def _run(args: argparse.Namespace) -> List:
     events.emitter.emit(events.training_start_event(
         task=task.value, configurations=len(sweeps),
         coordinates=list(update_sequence), num_samples=df.num_samples))
+    ckpt_dir = args.resume_from or args.checkpoint_directory
     with Timed(f"train {len(sweeps)} configuration(s)", logger):
         results = estimator.fit(df, validation_df=validation_df,
                                 configurations=sweeps,
-                                initial_model=initial_model)
+                                initial_model=initial_model,
+                                checkpoint_dir=ckpt_dir,
+                                resume=bool(args.resume_from))
     _emit_optimization_logs(estimator, results)
 
     tuned = []
@@ -360,10 +380,16 @@ def _run(args: argparse.Namespace) -> List:
             and args.hyper_parameter_tuning_iter > 0
             and validation_df is not None):
         with Timed("hyperparameter tuning", logger):
+            prior_json = None
+            if args.hyper_parameter_prior_json:
+                with open(args.hyper_parameter_prior_json) as f:
+                    prior_json = f.read()
             tuned = run_hyperparameter_tuning(
                 estimator, df, validation_df,
                 n_iterations=args.hyper_parameter_tuning_iter,
-                mode=mode, prior_results=results)
+                mode=mode, prior_results=results,
+                prior_json=prior_json,
+                shrink_radius=args.hyper_parameter_shrink_radius)
 
     best = _best_result(estimator, results + tuned)
     events.emitter.emit(events.training_finish_event(

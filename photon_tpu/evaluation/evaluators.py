@@ -12,8 +12,9 @@ arrays (pad samples get weight 0, so static-shape padding is safe).
 
 from __future__ import annotations
 
+import dataclasses
 import enum
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +40,43 @@ class EvaluatorType(enum.Enum):
     def better_than(self, a: float, b: float) -> bool:
         """Reference: EvaluatorType's per-metric comparison op."""
         return a > b if self.bigger_is_better else a < b
+
+    @property
+    def metadata(self) -> "MetricMetadata":
+        return METRIC_METADATA[self]
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricMetadata:
+    """Descriptive metadata for reporting (reference:
+    photon-diagnostics .../metric/MetricMetadata.scala — name,
+    description, worst-to-best ordering, optional (min, max) range)."""
+
+    name: str
+    description: str
+    bigger_is_better: bool            # worstToBestOrdering direction
+    value_range: Optional[Tuple[float, float]] = None
+
+    def sort_worst_to_best(self, values):
+        return sorted(values, reverse=not self.bigger_is_better)
+
+
+METRIC_METADATA: Dict["EvaluatorType", MetricMetadata] = {
+    EvaluatorType.AUC: MetricMetadata(
+        "AUC", "Binary classification metric", True, (0.0, 1.0)),
+    EvaluatorType.AUPR: MetricMetadata(
+        "AUPR", "Binary classification metric", True, (0.0, 1.0)),
+    EvaluatorType.RMSE: MetricMetadata(
+        "RMSE", "Regression metric", False),
+    EvaluatorType.LOGISTIC_LOSS: MetricMetadata(
+        "LOGISTIC_LOSS", "Binary classification loss", False),
+    EvaluatorType.POISSON_LOSS: MetricMetadata(
+        "POISSON_LOSS", "Count-regression loss", False),
+    EvaluatorType.SMOOTHED_HINGE_LOSS: MetricMetadata(
+        "SMOOTHED_HINGE_LOSS", "Classification loss", False),
+    EvaluatorType.SQUARED_LOSS: MetricMetadata(
+        "SQUARED_LOSS", "Regression loss", False),
+}
 
 
 def _weights(scores: Array, weights: Optional[Array]) -> Array:

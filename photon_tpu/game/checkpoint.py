@@ -1,0 +1,174 @@
+"""Mid-training checkpoint/resume for coordinate descent.
+
+SURVEY §5.3: the reference delegates failure recovery to Spark lineage
+(recompute lost partitions deterministically); the TPU-native answer is a
+sweep-granular checkpoint of everything the continuation depends on —
+per-coordinate model arrays, the sweep index, the per-coordinate
+down-sampling counters (the PRNG fold-in state), and the best-model
+bookkeeping — so a killed run resumes BITWISE-equal to an uninterrupted
+one. Scores/full_score are deliberately NOT persisted: they are pure
+deterministic functions of the models and are recomputed on resume (the
+same trick the reference plays with deterministic reservoir keys,
+RandomEffectDataset.scala:212-215).
+
+Layout (one directory per completed sweep, atomic rename on publish):
+
+    <dir>/sweep_0007/
+        meta.json              # sweep, counters, best_*, history
+        model__<coord>.npz     # arrays of that coordinate's model
+        best__<coord>.npz      # arrays of the best-so-far model (if any)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
+from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+_SWEEP_PREFIX = "sweep_"
+
+
+# -- model (de)serialization --------------------------------------------------
+
+def _model_arrays(m) -> Tuple[dict, dict]:
+    """(arrays, meta) for one coordinate model."""
+    if isinstance(m, FixedEffectModel):
+        c = m.model.coefficients
+        arrays = {"means": np.asarray(c.means)}
+        if c.variances is not None:
+            arrays["variances"] = np.asarray(c.variances)
+        return arrays, {"kind": "fixed", "task": m.model.task.value,
+                        "feature_shard_id": m.feature_shard_id}
+    if isinstance(m, RandomEffectModel):
+        arrays = {"coefficients": np.asarray(m.coefficients)}
+        if m.variances is not None:
+            arrays["variances"] = np.asarray(m.variances)
+        return arrays, {"kind": "random", "task": m.task.value,
+                        "feature_shard_id": m.feature_shard_id,
+                        "random_effect_type": m.random_effect_type}
+    raise TypeError(f"unknown coordinate model type {type(m).__name__}")
+
+
+def _model_from_arrays(arrays: dict, meta: dict):
+    task = TaskType(meta["task"])
+    if meta["kind"] == "fixed":
+        coef = Coefficients(
+            jnp.asarray(arrays["means"]),
+            jnp.asarray(arrays["variances"]) if "variances" in arrays else None)
+        return FixedEffectModel(GeneralizedLinearModel(coef, task),
+                                meta["feature_shard_id"])
+    return RandomEffectModel(
+        coefficients=jnp.asarray(arrays["coefficients"]),
+        random_effect_type=meta["random_effect_type"],
+        feature_shard_id=meta["feature_shard_id"],
+        task=task,
+        variances=jnp.asarray(arrays["variances"]) if "variances" in arrays
+        else None)
+
+
+# -- checkpoint state ---------------------------------------------------------
+
+@dataclasses.dataclass
+class CheckpointState:
+    sweep: int                              # last COMPLETED sweep index
+    models: Dict[str, object]               # coordinate id -> model
+    counters: Dict[str, int]                # coordinate id -> _update_count
+    best_models: Optional[Dict[str, object]]
+    best_metric: Optional[float]
+    best_iteration: Optional[int]
+    history: List[Dict[str, float]]
+
+
+def save_checkpoint(
+    directory: str,
+    sweep: int,
+    models: Dict[str, object],
+    counters: Dict[str, int],
+    best_models: Optional[Dict[str, object]] = None,
+    best_metric: Optional[float] = None,
+    best_iteration: Optional[int] = None,
+    history: Optional[List[Dict[str, float]]] = None,
+) -> str:
+    """Atomically publish one sweep's checkpoint; returns its path."""
+    os.makedirs(directory, exist_ok=True)
+    final = os.path.join(directory, f"{_SWEEP_PREFIX}{sweep:04d}")
+    tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=directory)
+    try:
+        model_meta = {}
+        for cid, m in models.items():
+            arrays, meta = _model_arrays(m)
+            np.savez(os.path.join(tmp, f"model__{cid}.npz"), **arrays)
+            model_meta[cid] = meta
+        best_meta = None
+        if best_models is not None:
+            best_meta = {}
+            for cid, m in best_models.items():
+                arrays, meta = _model_arrays(m)
+                np.savez(os.path.join(tmp, f"best__{cid}.npz"), **arrays)
+                best_meta[cid] = meta
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"sweep": sweep,
+                       "counters": counters,
+                       "models": model_meta,
+                       "best_models": best_meta,
+                       "best_metric": best_metric,
+                       "best_iteration": best_iteration,
+                       "history": history or []}, f, indent=2)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return final
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    sweeps = sorted(d for d in os.listdir(directory)
+                    if d.startswith(_SWEEP_PREFIX)
+                    and os.path.isfile(os.path.join(directory, d, "meta.json")))
+    return os.path.join(directory, sweeps[-1]) if sweeps else None
+
+
+def load_checkpoint(path: str) -> CheckpointState:
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+
+    def load_models(prefix: str, metas) -> Optional[Dict[str, object]]:
+        if metas is None:
+            return None
+        out = {}
+        for cid, m in metas.items():
+            with np.load(os.path.join(path, f"{prefix}__{cid}.npz")) as z:
+                out[cid] = _model_from_arrays(dict(z), m)
+        return out
+
+    return CheckpointState(
+        sweep=int(meta["sweep"]),
+        models=load_models("model", meta["models"]),
+        counters={k: int(v) for k, v in meta["counters"].items()},
+        best_models=load_models("best", meta.get("best_models")),
+        best_metric=meta.get("best_metric"),
+        best_iteration=meta.get("best_iteration"),
+        history=meta.get("history") or [],
+    )
+
+
+def load_latest(directory: str) -> Optional[CheckpointState]:
+    path = latest_checkpoint(directory)
+    return load_checkpoint(path) if path else None

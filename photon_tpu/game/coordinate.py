@@ -380,13 +380,14 @@ class RandomEffectCoordinate:
             norm_args = (f,) if s is None else (f, s, islot)
         coefs, iters, reasons = self._solve_fn(self.dataset, residual_scores,
                                                coef0, l2, l1, *norm_args)
-        # per-entity outcome aggregation (RandomEffectOptimizationTracker)
-        import numpy as _np
+        # per-entity outcome aggregation (RandomEffectOptimizationTracker).
+        # Keep the DEVICE arrays: a blocking host transfer here would
+        # serialize every CD sweep on the solver's completion; the tracker
+        # converts lazily when someone actually reads a summary.
         from photon_tpu.optim.tracking import RandomEffectOptimizationTracker
         e_orig = self._num_entities_orig
         self.last_tracker = RandomEffectOptimizationTracker(
-            iterations=_np.asarray(iters)[:e_orig],
-            reasons=_np.asarray(reasons)[:e_orig])
+            iterations=iters[:e_orig], reasons=reasons[:e_orig])
         variances = None
         from photon_tpu.types import VarianceComputationType
         if (self.variance_type != VarianceComputationType.NONE

@@ -57,12 +57,20 @@ def run_coordinate_descent(
     validation_fn: Optional[ValidationFn] = None,
     primary_metric_bigger_is_better: bool = True,
     dtype=jnp.float32,
+    checkpoint_dir: Optional[str] = None,
+    resume: bool = False,
 ) -> CoordinateDescentResult:
     """Run GAME coordinate descent.
 
     ``coordinates`` maps coordinate id -> FixedEffectCoordinate /
     RandomEffectCoordinate (game/coordinate.py); locked ids must come with
     their model inside ``initial_model`` (they only score).
+
+    With ``checkpoint_dir``, every completed sweep is atomically published
+    there; ``resume=True`` restarts from the latest one — the continuation
+    is bitwise-equal to an uninterrupted run (SURVEY §5.3: checkpoint +
+    restart replaces Spark lineage recovery; scores are recomputed from
+    the models, down-sampling PRNG counters are restored).
     """
     to_train = [c for c in config.update_sequence
                 if c not in config.locked_coordinates]
@@ -76,22 +84,42 @@ def run_coordinate_descent(
             raise ValueError(f"locked coordinate {cid!r} needs an initial model")
 
     models: Dict[str, object] = dict(initial_model.models) if initial_model else {}
+    best_model: Optional[GameModel] = None
+    best_metric: Optional[float] = None
+    best_iter: Optional[int] = None
+    history: List[Dict[str, float]] = []
+    start_iter = 0
+
+    if checkpoint_dir and resume:
+        from photon_tpu.game import checkpoint as ckpt
+        state = ckpt.load_latest(checkpoint_dir)
+        if state is not None:
+            models = dict(state.models)
+            start_iter = state.sweep + 1
+            best_model = (GameModel(dict(state.best_models))
+                          if state.best_models else None)
+            best_metric = state.best_metric
+            best_iter = state.best_iteration
+            history = list(state.history)
+            for cid, count in state.counters.items():
+                if cid in coordinates and hasattr(coordinates[cid],
+                                                  "_update_count"):
+                    coordinates[cid]._update_count = count
+            logger.info("resumed from %s (sweep %d complete)",
+                        checkpoint_dir, state.sweep)
+
     scores: Dict[str, Array] = {}
     full_score = jnp.zeros((num_samples,), dtype)
 
-    # initial scores for any pre-existing models (warm start / locked)
+    # initial scores for any pre-existing models (warm start / locked /
+    # checkpoint-resumed — scores are pure functions of the models)
     for cid in config.update_sequence:
         if cid in models:
             s = coordinates[cid].score(models[cid])
             scores[cid] = s
             full_score = full_score + s
 
-    best_model: Optional[GameModel] = None
-    best_metric: Optional[float] = None
-    best_iter: Optional[int] = None
-    history: List[Dict[str, float]] = []
-
-    for it in range(config.num_iterations):
+    for it in range(start_iter, config.num_iterations):
         for cid in config.update_sequence:
             if cid in config.locked_coordinates:
                 continue
@@ -106,7 +134,9 @@ def run_coordinate_descent(
                 new_model = coord.update_model(models.get(cid), residual)
             models[cid] = new_model
             tracker = getattr(coord, "last_tracker", None)
-            if tracker is not None:
+            if tracker is not None and logger.isEnabledFor(logging.DEBUG):
+                # summary() forces a device->host sync; never pay it unless
+                # debug logging actually consumes it
                 logger.debug("coord %s solver: %s", cid, tracker.summary())
             new_score = coord.score(new_model)
             full_score = (full_score - own + new_score) if own is not None \
@@ -129,6 +159,27 @@ def run_coordinate_descent(
                 best_metric = primary
                 best_model = GameModel(dict(models))
                 best_iter = it
+
+        # canonicalize the running sum at sweep boundaries: a resume
+        # rebuilds full_score as a FRESH ordered sum over the models, and
+        # bitwise-equal continuation requires the uninterrupted run to
+        # hold the same value (incremental "full - own + new" arithmetic
+        # drifts in the last ulp)
+        full_score = jnp.zeros((num_samples,), dtype)
+        for cid in config.update_sequence:
+            if cid in scores:
+                full_score = full_score + scores[cid]
+
+        if checkpoint_dir:
+            from photon_tpu.game import checkpoint as ckpt
+            counters = {cid: coordinates[cid]._update_count
+                        for cid in config.update_sequence
+                        if hasattr(coordinates[cid], "_update_count")}
+            ckpt.save_checkpoint(
+                checkpoint_dir, it, models, counters,
+                best_models=None if best_model is None else best_model.models,
+                best_metric=best_metric, best_iteration=best_iter,
+                history=history)
 
     final = GameModel(dict(models))
     return CoordinateDescentResult(

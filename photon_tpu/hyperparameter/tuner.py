@@ -112,6 +112,85 @@ class GameEstimatorEvaluationFunction:
         return out
 
 
+# -- defaults (reference: GameHyperparameterDefaults.scala) -------------------
+
+# per-parameter prior default used when a prior record omits a value
+PRIOR_DEFAULT_WEIGHT = 1.0  # 10^0, the center of the default log range
+
+def game_hyperparameter_defaults(coordinate_ids: Sequence[str]
+                                 ) -> Dict[str, TuningRange]:
+    """Default LOG-scale search ranges per coordinate: 10^-3..10^3
+    (reference: GameHyperparameterDefaults.configDefault — FLOAT/LOG,
+    min -3, max 3 for every regularizer)."""
+    return {cid: TuningRange(1e-3, 1e3) for cid in coordinate_ids}
+
+
+def priors_from_json(json_str: str, coordinate_ids: Sequence[str],
+                     default_weight: float = PRIOR_DEFAULT_WEIGHT
+                     ) -> List[Tuple[Dict[str, float], float]]:
+    """Parse prior observations: ``{"records": [{<coord>: weight, ...,
+    "evaluationValue": v}, ...]}`` — missing coordinates take the default
+    (reference: HyperparameterSerialization.priorFromJson + priorDefault).
+    Values follow this module's MINIMIZE convention."""
+    import json as _json
+    out = []
+    for rec in _json.loads(json_str).get("records", []):
+        config = {cid: float(rec.get(cid, default_weight))
+                  for cid in coordinate_ids}
+        out.append((config, float(rec["evaluationValue"])))
+    return out
+
+
+# -- search-range shrinking (reference: ShrinkSearchRange.scala:28-80) --------
+
+def shrink_search_range(
+    fn: GameEstimatorEvaluationFunction,
+    prior_observations: Sequence[Tuple[np.ndarray, float]],
+    radius: float = 0.25,
+    candidate_pool_size: int = 1000,
+    seed: int = 0,
+) -> Dict[str, TuningRange]:
+    """Narrow each coordinate's search range around the GP-predicted best
+    of the prior observations.
+
+    Reference recipe (ShrinkSearchRange.getBounds): rescale priors to
+    [0,1]^d, fit a Matern-5/2 GP, score a Sobol candidate pool, take the
+    best-predicted candidate, and return [best - radius, best + radius]
+    clipped to the original ranges, mapped back through the log transform.
+    Values are MINIMIZED here (the reference maximizes; its evaluation
+    sign convention is inverted upstream).
+    """
+    from photon_tpu.hyperparameter.gp import GaussianProcessEstimator
+    from photon_tpu.hyperparameter.kernels import Matern52
+    from scipy.stats import qmc
+
+    assert prior_observations, "need prior observations to shrink around"
+    points = np.vstack([np.asarray(p, float) for p, _ in prior_observations])
+    values = np.asarray([v for _, v in prior_observations], float)
+
+    if len(points) == 1:
+        best = points[0]
+    else:
+        model = GaussianProcessEstimator(kernel=Matern52(), seed=seed).fit(
+            points, values)
+        candidates = qmc.Sobol(d=fn.num_params, scramble=True,
+                               seed=seed).random(candidate_pool_size)
+        mean, _ = model.predict(candidates)
+        best = candidates[int(np.argmin(mean))]
+
+    out: Dict[str, TuningRange] = {}
+    for i, cid in enumerate(fn.coordinate_ids):
+        lo01 = max(0.0, float(best[i]) - radius)
+        hi01 = min(1.0, float(best[i]) + radius)
+        lmin, lmax = fn.ranges[cid].log_range
+        span = lmax - lmin
+        out[cid] = TuningRange(10.0 ** (lmin + lo01 * span),
+                               10.0 ** (lmin + hi01 * span))
+        logger.info("shrunk %s range: [%.3g, %.3g]", cid,
+                    out[cid].min_weight, out[cid].max_weight)
+    return out
+
+
 def run_hyperparameter_tuning(
     estimator,
     df,
@@ -120,19 +199,48 @@ def run_hyperparameter_tuning(
     mode: HyperparameterTuningMode = HyperparameterTuningMode.BAYESIAN,
     ranges: Optional[Dict[str, TuningRange]] = None,
     prior_results: Sequence = (),
+    prior_json: Optional[str] = None,
+    shrink_radius: Optional[float] = None,
     seed: int = 0,
 ) -> List:
     """Tune per-coordinate reg weights; returns the candidate GameResults
     (reference: GameTrainingDriver.runHyperparameterTuning :559 +
-    AtlasTuner routing)."""
+    AtlasTuner routing). ``shrink_radius`` narrows the search ranges
+    around the prior best before searching (ShrinkSearchRange.scala:28);
+    ``prior_json`` supplies serialized prior observations in addition to
+    in-memory ``prior_results``."""
     if mode == HyperparameterTuningMode.NONE or n_iterations <= 0:
         return []
+    if ranges is None:
+        ranges = game_hyperparameter_defaults(
+            list(estimator.coordinate_configs.keys()))
     fn = GameEstimatorEvaluationFunction(estimator, df, validation_df,
                                          ranges=ranges)
+    priors = fn.convert_observations(prior_results)
+    if prior_json:
+        for config, value in priors_from_json(prior_json, fn.coordinate_ids):
+            priors.append((fn.configuration_to_vector(config), value))
+    if shrink_radius is not None and priors:
+        full_ranges = fn.ranges  # filled-in per-coordinate ranges
+        shrunk = shrink_search_range(fn, priors, radius=shrink_radius,
+                                     seed=seed)
+        fn = GameEstimatorEvaluationFunction(estimator, df, validation_df,
+                                             ranges=shrunk)
+        # re-express the priors in the SHRUNK [0,1]^d coordinates
+        old_priors = priors
+        priors = []
+        for p, v in old_priors:
+            config = {cid: float(10.0 ** w) for cid, w in zip(
+                fn.coordinate_ids,
+                scale_backward(np.asarray(p),
+                               [full_ranges[cid].log_range
+                                for cid in fn.coordinate_ids]))}
+            vec = fn.configuration_to_vector(config)
+            if np.all((vec >= 0.0) & (vec <= 1.0)):
+                priors.append((vec, v))
     search_cls = (GaussianProcessSearch
                   if mode == HyperparameterTuningMode.BAYESIAN else RandomSearch)
     search = search_cls(fn.num_params, fn, seed=seed)
-    priors = fn.convert_observations(prior_results)
     if priors:
         return search.find_with_prior_observations(n_iterations, priors)
     return search.find(n_iterations)

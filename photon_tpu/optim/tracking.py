@@ -56,29 +56,43 @@ class OptimizationStatesTracker:
 
 @dataclasses.dataclass
 class RandomEffectOptimizationTracker:
-    """Aggregate of per-entity solver outcomes for one coordinate update."""
+    """Aggregate of per-entity solver outcomes for one coordinate update.
 
-    iterations: np.ndarray   # [E] int
-    reasons: np.ndarray      # [E] int (ConvergenceReason)
+    ``iterations``/``reasons`` may be DEVICE arrays — the producing solve
+    hands them over without a host sync, and the first summary accessor
+    pays the (lazy) transfer. A blocking transfer at update time would
+    serialize every coordinate-descent sweep on the solver's completion.
+    """
+
+    iterations: np.ndarray   # [E] int (numpy or jax.Array)
+    reasons: np.ndarray      # [E] int (ConvergenceReason; numpy or jax.Array)
 
     @property
     def num_entities(self) -> int:
         return len(self.iterations)
 
+    def _host(self) -> Tuple[np.ndarray, np.ndarray]:
+        if not isinstance(self.iterations, np.ndarray):
+            object.__setattr__(self, "iterations", np.asarray(self.iterations))
+            object.__setattr__(self, "reasons", np.asarray(self.reasons))
+        return self.iterations, self.reasons
+
     def reason_counts(self) -> Dict[str, int]:
+        _, reasons = self._host()
         out: Dict[str, int] = {}
         for r in ConvergenceReason:
-            c = int(np.sum(self.reasons == int(r)))
+            c = int(np.sum(reasons == int(r)))
             if c:
                 out[r.name] = c
         return out
 
     def iteration_stats(self) -> Tuple[float, int, int]:
         """(mean, min, max) iterations across entities."""
-        if not len(self.iterations):
+        iters, _ = self._host()
+        if not len(iters):
             return 0.0, 0, 0
-        return (float(np.mean(self.iterations)),
-                int(np.min(self.iterations)), int(np.max(self.iterations)))
+        return (float(np.mean(iters)),
+                int(np.min(iters)), int(np.max(iters)))
 
     def summary(self) -> str:
         mean_it, lo, hi = self.iteration_stats()

@@ -79,3 +79,22 @@ def test_mean_loss_evaluators(rng):
     ll = float(E.logistic_loss_eval(jnp.asarray(scores), jnp.asarray(labels)))
     want = np.mean(np.log1p(np.exp(scores)) - labels * scores)
     np.testing.assert_allclose(ll, want, rtol=1e-9)
+
+
+def test_metric_metadata_registry():
+    """Reference: photon-diagnostics metric/MetricMetadata.scala — every
+    evaluator carries (name, description, ordering, optional range)."""
+    from photon_tpu.evaluation.evaluators import (
+        METRIC_METADATA,
+        EvaluatorType,
+        MetricMetadata,
+    )
+
+    assert set(METRIC_METADATA) == set(EvaluatorType)
+    md = EvaluatorType.AUC.metadata
+    assert isinstance(md, MetricMetadata)
+    assert md.value_range == (0.0, 1.0) and md.bigger_is_better
+    # worst-to-best: ascending for AUC, descending for RMSE
+    assert md.sort_worst_to_best([0.9, 0.1, 0.5]) == [0.1, 0.5, 0.9]
+    assert EvaluatorType.RMSE.metadata.sort_worst_to_best(
+        [0.9, 0.1, 0.5]) == [0.9, 0.5, 0.1]

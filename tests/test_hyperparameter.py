@@ -259,3 +259,133 @@ def test_game_tuning_end_to_end():
                for r in results]
     assert len(set(np.round(weights, 6))) > 1
     assert all(1e-3 <= w_ <= 1e3 for w_ in weights)
+
+
+# -- ShrinkSearchRange + GameHyperparameterDefaults (VERDICT r3 item 7) ------
+
+def test_game_hyperparameter_defaults():
+    from photon_tpu.hyperparameter.tuner import (
+        game_hyperparameter_defaults,
+        priors_from_json,
+    )
+
+    d = game_hyperparameter_defaults(["fixed", "per_user", "per_item"])
+    assert set(d) == {"fixed", "per_user", "per_item"}
+    for r in d.values():  # reference: FLOAT/LOG min -3 max 3
+        assert (r.min_weight, r.max_weight) == (1e-3, 1e3)
+
+    priors = priors_from_json(
+        '{"records": [{"fixed": 0.5, "evaluationValue": -0.8},'
+        ' {"evaluationValue": -0.6}]}', ["fixed", "per_user"])
+    assert priors[0][0] == {"fixed": 0.5, "per_user": 1.0}
+    assert priors[0][1] == -0.8
+    assert priors[1][0]["fixed"] == 1.0  # default fills missing params
+
+
+def _shrink_fn(ranges):
+    """Lightweight stand-in exposing the attributes shrink_search_range
+    reads (num_params / coordinate_ids / ranges)."""
+    import types
+
+    return types.SimpleNamespace(
+        num_params=len(ranges), coordinate_ids=list(ranges), ranges=ranges)
+
+
+def test_shrink_search_range_centers_on_prior_best():
+    from photon_tpu.hyperparameter.rescaling import scale_forward
+    from photon_tpu.hyperparameter.tuner import (
+        TuningRange,
+        shrink_search_range,
+    )
+
+    full = {"fixed": TuningRange(1e-3, 1e3)}
+    fn = _shrink_fn(full)
+    target_log = 1.2  # optimum at w = 10^1.2
+    rng = np.random.default_rng(0)
+    priors = []
+    for logw in np.linspace(-3, 3, 9):
+        vec = scale_forward(np.asarray([logw]), [full["fixed"].log_range])
+        priors.append((vec, (logw - target_log) ** 2 + 0.01 * rng.normal()))
+
+    shrunk = shrink_search_range(fn, priors, radius=0.15, seed=0)["fixed"]
+    width_full = np.log10(full["fixed"].max_weight / full["fixed"].min_weight)
+    width_shrunk = np.log10(shrunk.max_weight / shrunk.min_weight)
+    assert width_shrunk <= 0.35 * width_full  # genuinely narrower
+    assert shrunk.min_weight <= 10 ** target_log <= shrunk.max_weight
+
+
+def test_shrunk_range_tuning_beats_full_range(rng):
+    """With the same candidate budget, tuning inside the shrunk range must
+    find a candidate at least as good as full-range tuning (the
+    reference's reason for ShrinkSearchRange: re-tunes with priors should
+    not re-explore the whole space)."""
+    import jax.numpy as jnp
+
+    from photon_tpu.estimators.game_estimator import (
+        CoordinateConfiguration,
+        FixedEffectDataConfiguration,
+        GameEstimator,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+    from photon_tpu.hyperparameter.tuner import (
+        GameEstimatorEvaluationFunction,
+        HyperparameterTuningMode,
+        TuningRange,
+        run_hyperparameter_tuning,
+    )
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    n, d = 400, 20
+    w = rng.normal(size=d)
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) < 1 / (1 + np.exp(-X @ w))).astype(np.float64)
+    Xv = rng.normal(size=(150, d))
+    yv = (rng.random(150) < 1 / (1 + np.exp(-Xv @ w))).astype(np.float64)
+
+    def frame(Xa, ya):
+        return GameDataFrame(num_samples=len(ya), response=ya,
+                             feature_shards={"g": FeatureShard(Xa, d)},
+                             id_tags={})
+
+    def estimator():
+        return GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            {"fixed": CoordinateConfiguration(
+                FixedEffectDataConfiguration("g"),
+                GLMOptimizationConfiguration(
+                    OptimizerConfig(max_iterations=40, tolerance=1e-6),
+                    L2Regularization, 1.0))},
+            dtype=jnp.float64)
+
+    ranges = {"fixed": TuningRange(1e-3, 1e3)}
+    # prior round: full-range Bayesian search
+    prior = run_hyperparameter_tuning(
+        estimator(), frame(X, y), frame(Xv, yv), n_iterations=4,
+        mode=HyperparameterTuningMode.BAYESIAN, ranges=ranges, seed=0)
+    prior_best = max(r.evaluation["AUC"] for r in prior)
+
+    # re-tune WITH shrink: same budget, ranges narrowed around prior best
+    shrunk_results = run_hyperparameter_tuning(
+        estimator(), frame(X, y), frame(Xv, yv), n_iterations=3,
+        mode=HyperparameterTuningMode.BAYESIAN, ranges=ranges,
+        prior_results=prior, shrink_radius=0.15, seed=1)
+    shrunk_best = max(r.evaluation["AUC"] for r in shrunk_results)
+
+    # re-tune WITHOUT shrink on the full range, same budget + priors
+    full_results = run_hyperparameter_tuning(
+        estimator(), frame(X, y), frame(Xv, yv), n_iterations=3,
+        mode=HyperparameterTuningMode.BAYESIAN, ranges=ranges,
+        prior_results=prior, seed=1)
+    full_best = max(r.evaluation["AUC"] for r in full_results)
+
+    assert shrunk_best >= full_best - 0.005, \
+        (shrunk_best, full_best, prior_best)
+    # every shrunk-range candidate stayed inside a narrowed window
+    ws = [r.config["fixed"].optimization.regularization_weight
+          for r in shrunk_results]
+    assert max(ws) / min(ws) < 1e3  # full range spans 1e6
