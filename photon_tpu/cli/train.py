@@ -138,6 +138,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--event-listeners", nargs="*", default=[],
                    help="fully-qualified EventListener class names "
                         "(reference: Driver.scala:62-73)")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the fit into this "
+                        "directory (SURVEY §5.1: the TPU-native analog of "
+                        "the reference's Timed blocks + Spark UI)")
     p.add_argument("--log-level", default="INFO")
     return p
 
@@ -357,7 +361,12 @@ def _run(args: argparse.Namespace) -> List:
         task=task.value, configurations=len(sweeps),
         coordinates=list(update_sequence), num_samples=df.num_samples))
     ckpt_dir = args.resume_from or args.checkpoint_directory
-    with Timed(f"train {len(sweeps)} configuration(s)", logger):
+    import contextlib
+    profile_cm = contextlib.nullcontext()
+    if args.profile_dir:
+        import jax
+        profile_cm = jax.profiler.trace(args.profile_dir)
+    with profile_cm, Timed(f"train {len(sweeps)} configuration(s)", logger):
         results = estimator.fit(df, validation_df=validation_df,
                                 configurations=sweeps,
                                 initial_model=initial_model,

@@ -304,8 +304,29 @@ class RandomEffectCoordinate:
                 if opt_type == OptimizerType.OWLQN:
                     r = owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
                 elif opt_type == OptimizerType.TRON:
-                    hv = lambda c, v: obj_e.hessian_vector(c, v, batch, hyper)
-                    r = tron.minimize(vg, hv, x0, config=solver_cfg)
+                    # explicit K x K Gauss-Newton per outer iteration when
+                    # the per-entity dim is small (the common projected
+                    # case): under vmap it becomes one batched [E, K, K]
+                    # contraction (MXU) and CG touches no sample data.
+                    # IDENTITY projectors / fat entities keep the
+                    # matrix-free operator — an [E, K, K] block at large K
+                    # would dwarf the data itself. opt.explicit_hessian
+                    # overrides, mirroring the fixed-effect gate
+                    # (optim/problem.py).
+                    K = x0.shape[0]
+                    explicit = opt.explicit_hessian
+                    if explicit is None:
+                        explicit = K <= 64
+                    if explicit:
+                        hs = lambda c: obj_e.hessian_matrix_from_weights(
+                            obj_e.hessian_weights(c, batch), K, batch, hyper)
+                        ha = lambda h, v: h @ v
+                    else:
+                        hs = lambda c: obj_e.hessian_weights(c, batch)
+                        ha = lambda d2, v: obj_e.hessian_vector_from_weights(
+                            d2, v, batch, hyper)
+                    r = tron.minimize(vg, None, x0, config=solver_cfg,
+                                      hess_setup=hs, hess_apply=ha)
                 else:
                     r = lbfgs.minimize(vg, x0, config=solver_cfg)
                 coef = r.coef

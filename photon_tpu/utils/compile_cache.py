@@ -33,9 +33,11 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     path = cache_dir or os.environ.get("PHOTON_TPU_XLA_CACHE", _DEFAULT_DIR)
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    # cache every executable that took meaningful time to build; the
-    # defaults skip fast compiles, which is what we want
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # cache aggressively: GAME programs are many medium-sized executables
+    # (one solve per coordinate x block-shape set); tracing/lowering is
+    # NOT covered by this cache, so skipping even fast compiles just adds
+    # to the uncacheable floor
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     _enabled = True
     return path

@@ -248,3 +248,34 @@ def test_entity_bucket_cap_bounds_compiles_and_preserves_results():
     np.testing.assert_allclose(np.asarray(m_cap.coefficients),
                                np.asarray(m_raw.coefficients),
                                rtol=1e-9, atol=1e-12)
+
+
+def test_random_effect_tron_matches_lbfgs(glmix):
+    """A TRON-solved random effect (explicit per-entity K x K Hessian,
+    batched under vmap) must reach the same convex optimum as L-BFGS
+    (reference: RandomEffectOptimizationProblem supports every optimizer,
+    OptimizerFactory.scala)."""
+    train, _, _ = glmix
+
+    def fit(opt_type):
+        opt = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(optimizer_type=opt_type,
+                                      max_iterations=60, tolerance=1e-10),
+            regularization=L2Regularization, regularization_weight=1.0)
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={
+                "per-user": CoordinateConfiguration(
+                    RandomEffectDataConfiguration("userId", "user_feats"),
+                    opt)},
+            update_sequence=["per-user"], num_iterations=1,
+            dtype=jnp.float64)
+        return np.asarray(est.fit(train)[-1].model["per-user"].coefficients)
+
+    from photon_tpu.types import OptimizerType
+
+    a = fit(OptimizerType.LBFGS)
+    b = fit(OptimizerType.TRON)
+    # both stop on FunctionValuesConverged; the optima agree to solver
+    # tolerance, not bitwise (different iterates)
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
