@@ -271,14 +271,19 @@ def bootstrap_platform(args):
     # retry, then explicit "tpu" in case the preset plugin itself is broken.
     # A provably-dead tunnel (TCP refused on the axon relay ports) gets a
     # short ladder — waiting 600s on a dead socket helps nobody.
-    first_timeout = args.probe_timeout
     if diag.get("tunnel_alive") is False:
+        # every route to the chip rides the axon tunnel
+        # (PALLAS_AXON_POOL_IPS); with its TCP refused, the "tpu" stage
+        # would hang on the same dead socket — one short confirmation
+        # attempt, then CPU with the diagnosis embedded in the artifact
         log("axon tunnel TCP check: relay DEAD (connection refused) — "
-            "shortening the probe ladder")
-        first_timeout = min(first_timeout, 90.0)
-    plat, winning_override = probe_backend([(None, first_timeout),
-                                            (None, 120.0),
-                                            ("tpu", 120.0)])
+            "single short probe only")
+        stages = [(None, 45.0)]
+    else:
+        stages = [(None, args.probe_timeout),
+                  (None, 120.0),
+                  ("tpu", 120.0)]
+    plat, winning_override = probe_backend(stages)
     if not plat:
         log("TPU backend unreachable after retries — falling back to CPU "
             f"(probe stderr tail in {_PROBE_ERR_PATH})")

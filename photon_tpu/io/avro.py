@@ -370,6 +370,12 @@ class AvroFileReader:
         self.codec = codec.decode() if isinstance(codec, bytes) else codec
         self._names = _Names()
         self._names.register_all(self.schema)
+        # native block decoder (photon_tpu/native): same objects as
+        # _read_datum at ~2 orders of magnitude higher throughput; falsy
+        # (-> pure-Python fallback) when the compiler or schema shape is
+        # unavailable
+        from photon_tpu import native as _native
+        self._native = _native.BlockDecoder(self.schema, self._names)
 
     def __iter__(self) -> Iterator[Any]:
         dec = self._body
@@ -381,9 +387,12 @@ class AvroFileReader:
                 raw = zlib.decompress(raw, -15)
             elif self.codec != "null":
                 raise SchemaError(f"unsupported codec {self.codec}")
-            block = BinaryDecoder(raw)
-            for _ in range(count):
-                yield _read_datum(block, self.schema, self._names)
+            if self._native:
+                yield from self._native.decode_block(raw, count)
+            else:
+                block = BinaryDecoder(raw)
+                for _ in range(count):
+                    yield _read_datum(block, self.schema, self._names)
             sync = dec.read(SYNC_SIZE)
             if sync != self._sync:
                 raise SchemaError("sync marker mismatch")

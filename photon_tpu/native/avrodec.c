@@ -1,0 +1,363 @@
+/* Native Avro binary block decoder.
+ *
+ * The host-side ingest path must feed TPU chips; the pure-Python datum
+ * decoder (photon_tpu/io/avro.py:_read_datum) tops out at a few MB/s,
+ * two orders of magnitude short of a host pipeline. This CPython
+ * extension walks a schema "program" compiled from the (already
+ * reference-resolved) writer schema and decodes one decompressed block
+ * of records into the exact same Python objects the fallback produces:
+ * dict for records/maps, list for arrays, str/bytes/int/float/bool/None
+ * primitives, enum symbols as str.
+ *
+ * Program encoding (built by photon_tpu/native/__init__.py):
+ *   (0,) null   (1,) boolean   (2,) int/long   (3,) float   (4,) double
+ *   (5,) bytes  (6,) string    (7, size) fixed (8, (sym, ...)) enum
+ *   (9, item) array            (10, value) map
+ *   (11, (branch, ...)) union  (12, ((name, field), ...)) record
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+enum {
+    OP_NULL = 0, OP_BOOL = 1, OP_LONG = 2, OP_FLOAT = 3, OP_DOUBLE = 4,
+    OP_BYTES = 5, OP_STRING = 6, OP_FIXED = 7, OP_ENUM = 8, OP_ARRAY = 9,
+    OP_MAP = 10, OP_UNION = 11, OP_RECORD = 12,
+};
+
+typedef struct Node {
+    int op;
+    Py_ssize_t n;            /* children / symbols / fixed size */
+    struct Node **child;     /* array/map: 1; union/record: n */
+    PyObject **names;        /* record field names / enum symbols (owned) */
+} Node;
+
+static void node_free(Node *node) {
+    if (node == NULL) return;
+    if (node->child != NULL) {
+        for (Py_ssize_t i = 0; i < node->n; i++) node_free(node->child[i]);
+        PyMem_Free(node->child);
+    }
+    if (node->names != NULL) {
+        for (Py_ssize_t i = 0; i < node->n; i++) Py_XDECREF(node->names[i]);
+        PyMem_Free(node->names);
+    }
+    PyMem_Free(node);
+}
+
+static Node *node_build(PyObject *tree, int depth) {
+    if (depth > 64) {
+        PyErr_SetString(PyExc_ValueError, "schema nesting too deep");
+        return NULL;
+    }
+    if (!PyTuple_Check(tree) || PyTuple_GET_SIZE(tree) < 1) {
+        PyErr_SetString(PyExc_TypeError, "schema program node must be a tuple");
+        return NULL;
+    }
+    long op = PyLong_AsLong(PyTuple_GET_ITEM(tree, 0));
+    if (op == -1 && PyErr_Occurred()) return NULL;
+
+    Node *node = (Node *)PyMem_Calloc(1, sizeof(Node));
+    if (node == NULL) { PyErr_NoMemory(); return NULL; }
+    node->op = (int)op;
+
+    switch (op) {
+    case OP_NULL: case OP_BOOL: case OP_LONG: case OP_FLOAT:
+    case OP_DOUBLE: case OP_BYTES: case OP_STRING:
+        return node;
+    case OP_FIXED: {
+        node->n = PyLong_AsSsize_t(PyTuple_GET_ITEM(tree, 1));
+        if (node->n < 0 && PyErr_Occurred()) goto fail;
+        return node;
+    }
+    case OP_ENUM: {
+        PyObject *syms = PyTuple_GET_ITEM(tree, 1);
+        node->n = PyTuple_GET_SIZE(syms);
+        node->names = (PyObject **)PyMem_Calloc((size_t)node->n,
+                                                sizeof(PyObject *));
+        if (node->names == NULL) { PyErr_NoMemory(); goto fail; }
+        for (Py_ssize_t i = 0; i < node->n; i++) {
+            node->names[i] = PyTuple_GET_ITEM(syms, i);
+            Py_INCREF(node->names[i]);
+        }
+        return node;
+    }
+    case OP_ARRAY: case OP_MAP: {
+        node->n = 1;
+        node->child = (Node **)PyMem_Calloc(1, sizeof(Node *));
+        if (node->child == NULL) { PyErr_NoMemory(); goto fail; }
+        node->child[0] = node_build(PyTuple_GET_ITEM(tree, 1), depth + 1);
+        if (node->child[0] == NULL) goto fail;
+        return node;
+    }
+    case OP_UNION: {
+        PyObject *branches = PyTuple_GET_ITEM(tree, 1);
+        node->n = PyTuple_GET_SIZE(branches);
+        node->child = (Node **)PyMem_Calloc((size_t)node->n, sizeof(Node *));
+        if (node->child == NULL) { PyErr_NoMemory(); goto fail; }
+        for (Py_ssize_t i = 0; i < node->n; i++) {
+            node->child[i] = node_build(PyTuple_GET_ITEM(branches, i),
+                                        depth + 1);
+            if (node->child[i] == NULL) goto fail;
+        }
+        return node;
+    }
+    case OP_RECORD: {
+        PyObject *fields = PyTuple_GET_ITEM(tree, 1);
+        node->n = PyTuple_GET_SIZE(fields);
+        node->child = (Node **)PyMem_Calloc((size_t)node->n, sizeof(Node *));
+        node->names = (PyObject **)PyMem_Calloc((size_t)node->n,
+                                                sizeof(PyObject *));
+        if (node->child == NULL || node->names == NULL) {
+            PyErr_NoMemory(); goto fail;
+        }
+        for (Py_ssize_t i = 0; i < node->n; i++) {
+            PyObject *pair = PyTuple_GET_ITEM(fields, i);
+            node->names[i] = PyTuple_GET_ITEM(pair, 0);
+            Py_INCREF(node->names[i]);
+            node->child[i] = node_build(PyTuple_GET_ITEM(pair, 1), depth + 1);
+            if (node->child[i] == NULL) goto fail;
+        }
+        return node;
+    }
+    default:
+        PyErr_Format(PyExc_ValueError, "bad opcode %ld", op);
+        goto fail;
+    }
+fail:
+    node_free(node);
+    return NULL;
+}
+
+/* ---- decoding ---------------------------------------------------------- */
+
+typedef struct {
+    const unsigned char *buf;
+    Py_ssize_t pos, len;
+} Dec;
+
+static int dec_long(Dec *d, long long *out) {
+    unsigned long long acc = 0;
+    int shift = 0;
+    while (1) {
+        if (d->pos >= d->len) {
+            PyErr_SetString(PyExc_EOFError, "truncated avro data");
+            return -1;
+        }
+        unsigned char b = d->buf[d->pos++];
+        acc |= ((unsigned long long)(b & 0x7F)) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+        if (shift > 63) {
+            PyErr_SetString(PyExc_ValueError, "varint too long");
+            return -1;
+        }
+    }
+    *out = (long long)(acc >> 1) ^ -(long long)(acc & 1);
+    return 0;
+}
+
+static const unsigned char *dec_read(Dec *d, Py_ssize_t n) {
+    if (n < 0 || d->pos + n > d->len) {
+        PyErr_SetString(PyExc_EOFError, "truncated avro data");
+        return NULL;
+    }
+    const unsigned char *p = d->buf + d->pos;
+    d->pos += n;
+    return p;
+}
+
+static PyObject *decode_node(Dec *d, const Node *node) {
+    long long v;
+    const unsigned char *p;
+    switch (node->op) {
+    case OP_NULL:
+        Py_RETURN_NONE;
+    case OP_BOOL:
+        if ((p = dec_read(d, 1)) == NULL) return NULL;
+        if (*p) Py_RETURN_TRUE;
+        Py_RETURN_FALSE;
+    case OP_LONG:
+        if (dec_long(d, &v) < 0) return NULL;
+        return PyLong_FromLongLong(v);
+    case OP_FLOAT: {
+        float f;
+        if ((p = dec_read(d, 4)) == NULL) return NULL;
+        memcpy(&f, p, 4);
+        return PyFloat_FromDouble((double)f);
+    }
+    case OP_DOUBLE: {
+        double f;
+        if ((p = dec_read(d, 8)) == NULL) return NULL;
+        memcpy(&f, p, 8);
+        return PyFloat_FromDouble(f);
+    }
+    case OP_BYTES:
+        if (dec_long(d, &v) < 0) return NULL;
+        if ((p = dec_read(d, (Py_ssize_t)v)) == NULL) return NULL;
+        return PyBytes_FromStringAndSize((const char *)p, (Py_ssize_t)v);
+    case OP_STRING:
+        if (dec_long(d, &v) < 0) return NULL;
+        if ((p = dec_read(d, (Py_ssize_t)v)) == NULL) return NULL;
+        return PyUnicode_DecodeUTF8((const char *)p, (Py_ssize_t)v, NULL);
+    case OP_FIXED:
+        if ((p = dec_read(d, node->n)) == NULL) return NULL;
+        return PyBytes_FromStringAndSize((const char *)p, node->n);
+    case OP_ENUM:
+        if (dec_long(d, &v) < 0) return NULL;
+        if (v < 0 || v >= node->n) {
+            PyErr_SetString(PyExc_ValueError, "enum index out of range");
+            return NULL;
+        }
+        Py_INCREF(node->names[v]);
+        return node->names[v];
+    case OP_UNION:
+        if (dec_long(d, &v) < 0) return NULL;
+        if (v < 0 || v >= node->n) {
+            PyErr_SetString(PyExc_ValueError, "union index out of range");
+            return NULL;
+        }
+        return decode_node(d, node->child[v]);
+    case OP_RECORD: {
+        PyObject *obj = PyDict_New();
+        if (obj == NULL) return NULL;
+        for (Py_ssize_t i = 0; i < node->n; i++) {
+            PyObject *val = decode_node(d, node->child[i]);
+            if (val == NULL || PyDict_SetItem(obj, node->names[i], val) < 0) {
+                Py_XDECREF(val);
+                Py_DECREF(obj);
+                return NULL;
+            }
+            Py_DECREF(val);
+        }
+        return obj;
+    }
+    case OP_ARRAY: {
+        PyObject *out = PyList_New(0);
+        if (out == NULL) return NULL;
+        while (1) {
+            if (dec_long(d, &v) < 0) goto arr_fail;
+            if (v == 0) break;
+            if (v < 0) {      /* block with byte size */
+                long long nb;
+                if (dec_long(d, &nb) < 0) goto arr_fail;
+                v = -v;
+            }
+            for (long long i = 0; i < v; i++) {
+                PyObject *item = decode_node(d, node->child[0]);
+                if (item == NULL || PyList_Append(out, item) < 0) {
+                    Py_XDECREF(item);
+                    goto arr_fail;
+                }
+                Py_DECREF(item);
+            }
+        }
+        return out;
+    arr_fail:
+        Py_DECREF(out);
+        return NULL;
+    }
+    case OP_MAP: {
+        PyObject *out = PyDict_New();
+        if (out == NULL) return NULL;
+        while (1) {
+            if (dec_long(d, &v) < 0) goto map_fail;
+            if (v == 0) break;
+            if (v < 0) {
+                long long nb;
+                if (dec_long(d, &nb) < 0) goto map_fail;
+                v = -v;
+            }
+            for (long long i = 0; i < v; i++) {
+                long long klen;
+                if (dec_long(d, &klen) < 0) goto map_fail;
+                if ((p = dec_read(d, (Py_ssize_t)klen)) == NULL) goto map_fail;
+                PyObject *key = PyUnicode_DecodeUTF8(
+                    (const char *)p, (Py_ssize_t)klen, NULL);
+                if (key == NULL) goto map_fail;
+                PyObject *val = decode_node(d, node->child[0]);
+                if (val == NULL || PyDict_SetItem(out, key, val) < 0) {
+                    Py_DECREF(key);
+                    Py_XDECREF(val);
+                    goto map_fail;
+                }
+                Py_DECREF(key);
+                Py_DECREF(val);
+            }
+        }
+        return out;
+    map_fail:
+        Py_DECREF(out);
+        return NULL;
+    }
+    default:
+        PyErr_SetString(PyExc_ValueError, "corrupt schema program");
+        return NULL;
+    }
+}
+
+/* ---- module ------------------------------------------------------------ */
+
+static void capsule_destructor(PyObject *capsule) {
+    node_free((Node *)PyCapsule_GetPointer(capsule, "photon_tpu.avrodec"));
+}
+
+static PyObject *py_compile_program(PyObject *self, PyObject *args) {
+    PyObject *tree;
+    if (!PyArg_ParseTuple(args, "O", &tree)) return NULL;
+    Node *node = node_build(tree, 0);
+    if (node == NULL) return NULL;
+    PyObject *cap = PyCapsule_New(node, "photon_tpu.avrodec",
+                                  capsule_destructor);
+    if (cap == NULL) node_free(node);
+    return cap;
+}
+
+static PyObject *py_decode_block(PyObject *self, PyObject *args) {
+    PyObject *cap;
+    Py_buffer buf;
+    Py_ssize_t count;
+    if (!PyArg_ParseTuple(args, "Oy*n", &cap, &buf, &count)) return NULL;
+    Node *node = (Node *)PyCapsule_GetPointer(cap, "photon_tpu.avrodec");
+    if (node == NULL) { PyBuffer_Release(&buf); return NULL; }
+    Dec d = { (const unsigned char *)buf.buf, 0, buf.len };
+    PyObject *out = PyList_New(count);
+    if (out == NULL) { PyBuffer_Release(&buf); return NULL; }
+    for (Py_ssize_t i = 0; i < count; i++) {
+        PyObject *rec = decode_node(&d, node);
+        if (rec == NULL) {
+            Py_DECREF(out);
+            PyBuffer_Release(&buf);
+            return NULL;
+        }
+        PyList_SET_ITEM(out, i, rec);
+    }
+    if (d.pos != d.len) {
+        Py_DECREF(out);
+        PyBuffer_Release(&buf);
+        PyErr_Format(PyExc_ValueError,
+                     "block not fully consumed (%zd of %zd bytes)",
+                     d.pos, d.len);
+        return NULL;
+    }
+    PyBuffer_Release(&buf);
+    return out;
+}
+
+static PyMethodDef methods[] = {
+    {"compile_program", py_compile_program, METH_VARARGS,
+     "Compile a schema program tree into a decoder capsule."},
+    {"decode_block", py_decode_block, METH_VARARGS,
+     "Decode `count` records from a decompressed Avro block."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_avrodec",
+    "Native Avro binary block decoder for photon_tpu.", -1, methods,
+};
+
+PyMODINIT_FUNC PyInit__avrodec(void) {
+    return PyModule_Create(&moduledef);
+}

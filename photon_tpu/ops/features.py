@@ -154,6 +154,18 @@ def weighted_gram(x: FeatureMatrix, w: Array, dim: int) -> Array:
             "model-sharded sparse theta is matrix-free by design: a d x d "
             "Hessian would defeat the point of sharding theta")
     if isinstance(x, SparseFeatures):
+        n, k = x.indices.shape
+        if k * k <= 4 * dim:
+            # scatter the k x k outer product of each row's nonzeros:
+            # O(n k^2) work and memory, never an [n, dim] densification
+            # (the explicit-Hessian TRON path calls this per entity
+            # under vmap — a dense temp there would dwarf the data)
+            contrib = (w[:, None, None] * x.values[:, :, None]
+                       * x.values[:, None, :])                   # [n, k, k]
+            rows = jnp.broadcast_to(x.indices[:, :, None], (n, k, k))
+            cols = jnp.broadcast_to(x.indices[:, None, :], (n, k, k))
+            return jnp.zeros((dim, dim), contrib.dtype).at[
+                rows.ravel(), cols.ravel()].add(contrib.ravel())
         dense = to_dense(x, dim)
         return dense.T @ (dense * w[:, None])
     return x.T @ (x * w[:, None])
