@@ -18,6 +18,7 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <limits.h>
 #include <string.h>
 
 enum {
@@ -58,6 +59,32 @@ static Node *node_build(PyObject *tree, int depth) {
     long op = PyLong_AsLong(PyTuple_GET_ITEM(tree, 0));
     if (op == -1 && PyErr_Occurred()) return NULL;
 
+    /* ops with an operand need arity 2 and (where applicable) a tuple
+     * operand — a malformed program must raise, never fault */
+    if (op >= OP_FIXED && op <= OP_RECORD) {
+        if (PyTuple_GET_SIZE(tree) < 2) {
+            PyErr_Format(PyExc_ValueError, "opcode %ld needs an operand", op);
+            return NULL;
+        }
+        if (op != OP_FIXED && op != OP_ARRAY && op != OP_MAP
+            && !PyTuple_Check(PyTuple_GET_ITEM(tree, 1))) {
+            PyErr_Format(PyExc_TypeError,
+                         "opcode %ld operand must be a tuple", op);
+            return NULL;
+        }
+        if (op == OP_RECORD) {
+            PyObject *fields = PyTuple_GET_ITEM(tree, 1);
+            for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(fields); i++) {
+                PyObject *pair = PyTuple_GET_ITEM(fields, i);
+                if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+                    PyErr_SetString(PyExc_TypeError,
+                                    "record fields must be (name, schema)");
+                    return NULL;
+                }
+            }
+        }
+    }
+
     Node *node = (Node *)PyMem_Calloc(1, sizeof(Node));
     if (node == NULL) { PyErr_NoMemory(); return NULL; }
     node->op = (int)op;
@@ -68,7 +95,11 @@ static Node *node_build(PyObject *tree, int depth) {
         return node;
     case OP_FIXED: {
         node->n = PyLong_AsSsize_t(PyTuple_GET_ITEM(tree, 1));
-        if (node->n < 0 && PyErr_Occurred()) goto fail;
+        if (node->n < 0) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_ValueError, "negative fixed size");
+            goto fail;
+        }
         return node;
     }
     case OP_ENUM: {
@@ -159,7 +190,9 @@ static int dec_long(Dec *d, long long *out) {
 }
 
 static const unsigned char *dec_read(Dec *d, Py_ssize_t n) {
-    if (n < 0 || d->pos + n > d->len) {
+    /* n > len - pos, never pos + n: a corrupt length near SSIZE_MAX must
+     * not overflow the signed addition and sail past the bounds check */
+    if (n < 0 || n > d->len - d->pos) {
         PyErr_SetString(PyExc_EOFError, "truncated avro data");
         return NULL;
     }
@@ -242,6 +275,10 @@ static PyObject *decode_node(Dec *d, const Node *node) {
             if (v < 0) {      /* block with byte size */
                 long long nb;
                 if (dec_long(d, &nb) < 0) goto arr_fail;
+                if (v == LLONG_MIN) {   /* -v would be signed-overflow UB */
+                    PyErr_SetString(PyExc_ValueError, "bad block count");
+                    goto arr_fail;
+                }
                 v = -v;
             }
             for (long long i = 0; i < v; i++) {
@@ -267,6 +304,10 @@ static PyObject *decode_node(Dec *d, const Node *node) {
             if (v < 0) {
                 long long nb;
                 if (dec_long(d, &nb) < 0) goto map_fail;
+                if (v == LLONG_MIN) {   /* -v would be signed-overflow UB */
+                    PyErr_SetString(PyExc_ValueError, "bad block count");
+                    goto map_fail;
+                }
                 v = -v;
             }
             for (long long i = 0; i < v; i++) {

@@ -155,17 +155,18 @@ def weighted_gram(x: FeatureMatrix, w: Array, dim: int) -> Array:
             "Hessian would defeat the point of sharding theta")
     if isinstance(x, SparseFeatures):
         n, k = x.indices.shape
-        if k * k <= 4 * dim:
-            # scatter the k x k outer product of each row's nonzeros:
-            # O(n k^2) work and memory, never an [n, dim] densification
+        if k <= 64:
+            # per-slot scatter of the outer product: k scatters whose
+            # temporaries are [n, k] — the same footprint as the data —
+            # never an [n, dim] densification nor [n, k, k] blow-up
             # (the explicit-Hessian TRON path calls this per entity
-            # under vmap — a dense temp there would dwarf the data)
-            contrib = (w[:, None, None] * x.values[:, :, None]
-                       * x.values[:, None, :])                   # [n, k, k]
-            rows = jnp.broadcast_to(x.indices[:, :, None], (n, k, k))
-            cols = jnp.broadcast_to(x.indices[:, None, :], (n, k, k))
-            return jnp.zeros((dim, dim), contrib.dtype).at[
-                rows.ravel(), cols.ravel()].add(contrib.ravel())
+            # under vmap, where big temps would dwarf the block)
+            wv = w[:, None] * x.values                           # [n, k]
+            h = jnp.zeros((dim, dim), wv.dtype)
+            for j in range(k):  # k is a static ELL width, loop unrolls
+                h = h.at[x.indices[:, j][:, None], x.indices].add(
+                    wv[:, j][:, None] * x.values)
+            return h
         dense = to_dense(x, dim)
         return dense.T @ (dense * w[:, None])
     return x.T @ (x * w[:, None])

@@ -59,6 +59,58 @@ def create_mesh(
     return Mesh(devs.reshape(tuple(shape)), tuple(axis_names))
 
 
+def initialize_distributed(**kwargs) -> int:
+    """Multi-host bring-up: call once per process BEFORE any jax use on a
+    multi-host pod (the Spark-cluster-join replacement, SURVEY §5.8).
+    Returns the process count.
+
+    The multi-host decision is made from the caller's kwargs or the
+    coordinator env vars ONLY — touching jax.process_count() first would
+    initialize the local backend and doom the real initialize() call,
+    silently degrading an 8-host job to 8 independent single-host runs.
+    """
+    import os as _os
+
+    import jax
+
+    multihost = bool(kwargs) or any(
+        v in _os.environ for v in
+        ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS"))
+    if multihost:
+        jax.distributed.initialize(**kwargs)  # raises if jax already used
+    return jax.process_count()
+
+
+def create_pod_mesh(
+    model_axis_size: int = 1,
+    num_slices: int = 1,
+    axis_names: Sequence[str] = (DATA_AXIS, MODEL_AXIS),
+) -> Mesh:
+    """Global (all-hosts) mesh with DCN-aware axis layout.
+
+    The data axis is OUTERMOST and absorbs the cross-slice (DCN) factor;
+    the model axis is innermost so its per-iteration psums of partial
+    margins ride ICI only. This is the reference's treeAggregateDepth>1
+    staging re-expressed as mesh layout (SURVEY §5.8): one gradient
+    all-reduce per step crosses DCN, everything else stays on-chip
+    interconnect. With ``num_slices > 1`` the device order comes from
+    ``mesh_utils.create_hybrid_device_mesh`` so slice boundaries align
+    with the data-axis split.
+    """
+    from jax.experimental import mesh_utils
+
+    n = len(jax.devices())
+    assert n % model_axis_size == 0, (n, model_axis_size)
+    data = n // model_axis_size
+    if num_slices > 1:
+        assert data % num_slices == 0, (data, num_slices)
+        devices = mesh_utils.create_hybrid_device_mesh(
+            (data // num_slices, model_axis_size), (num_slices, 1))
+    else:
+        devices = mesh_utils.create_device_mesh((data, model_axis_size))
+    return Mesh(devices, tuple(axis_names))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Fully replicated (the broadcast-variable equivalent)."""
     return NamedSharding(mesh, P())

@@ -370,3 +370,90 @@ def test_sparse_feature_sharded_fixed_effect_parity(rng, devices8):
     th_rep = M.replicate(jnp.zeros((d,), jnp.float64), coord_dp.mesh)
     per_dev_rep = {s.data.nbytes for s in th_rep.addressable_shards}
     assert per_dev_rep == {th_rep.nbytes}
+
+
+def test_estimator_sparse_model_axis_through_public_api(rng, devices8):
+    """Sparse fixed effect + random effect trained through GameEstimator
+    on the (4, 2) mesh == (8, 1) data-parallel (the public-API version of
+    the coordinate-level sparse tp test)."""
+    from photon_tpu.estimators.game_estimator import (
+        CoordinateConfiguration,
+        FixedEffectDataConfiguration,
+        GameEstimator,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+    from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+
+    n, d, k, users, d_u = 512, 300, 6, 10, 3
+    idx = np.stack([rng.choice(d, size=k, replace=False) for _ in range(n)])
+    val = rng.normal(size=(n, k))
+    uid = rng.integers(0, users, size=n)
+    Xu = rng.normal(size=(n, d_u))
+    w = rng.normal(size=d) * 0.5
+    margins = np.asarray(
+        F.matvec(F.SparseFeatures(jnp.asarray(idx, jnp.int32),
+                                  jnp.asarray(val)), jnp.asarray(w)))
+    y = (rng.random(n) < 1 / (1 + np.exp(-margins))).astype(np.float64)
+    iu = np.arange(d_u, dtype=np.int32)
+    df = GameDataFrame(
+        num_samples=n, response=y,
+        feature_shards={
+            "g": FeatureShard([(idx[i], val[i]) for i in range(n)], d),
+            "u": FeatureShard([(iu, Xu[i]) for i in range(n)], d_u)},
+        id_tags={"userId": [str(v) for v in uid]})
+
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=50, tolerance=1e-10),
+        regularization=L2Regularization, regularization_weight=1.0)
+
+    def fit(shape):
+        mesh = M.create_mesh(8, (M.DATA_AXIS, M.MODEL_AXIS), shape)
+        est = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            {"fixed": CoordinateConfiguration(
+                FixedEffectDataConfiguration("g"), opt),
+             "per_user": CoordinateConfiguration(
+                 RandomEffectDataConfiguration("userId", "u"), opt)},
+            update_sequence=["fixed", "per_user"], num_iterations=2,
+            dtype=jnp.float64, mesh=mesh)
+        return est, est.fit(df)[-1].model
+
+    est_dp, m_dp = fit((8, 1))
+    est_tp, m_tp = fit((4, 2))
+    assert est_tp._coordinates["fixed"]._model_sharded
+    assert isinstance(est_tp._coordinates["fixed"].batch.features,
+                      F.ModelShardedSparse)
+    np.testing.assert_allclose(
+        np.asarray(m_tp["fixed"].model.coefficients.means),
+        np.asarray(m_dp["fixed"].model.coefficients.means),
+        rtol=1e-7, atol=1e-9)
+    np.testing.assert_allclose(
+        np.asarray(m_tp["per_user"].coefficients),
+        np.asarray(m_dp["per_user"].coefficients), rtol=1e-7, atol=1e-9)
+
+
+def test_create_pod_mesh_layout(devices8):
+    """Pod mesh: data outermost / model innermost; initialize_distributed
+    is a no-op single-process (SURVEY §5.8 DCN staging as mesh layout)."""
+    assert M.initialize_distributed() == 1
+    mesh = M.create_pod_mesh(model_axis_size=2)
+    assert mesh.shape == {"data": 4, "model": 2}
+    # a fit through the pod mesh matches the plain mesh
+    rng = np.random.default_rng(3)
+    batch, _, _ = make_logistic(rng, n=256)
+    prob = GlmOptimizationProblem(
+        TaskType.LOGISTIC_REGRESSION,
+        GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(max_iterations=60, tolerance=1e-12)))
+    m_pod, _ = prob.run(batch, dim=16, dtype=jnp.float64,
+                        regularization_weight=1.0, mesh=mesh)
+    prob2 = GlmOptimizationProblem(
+        TaskType.LOGISTIC_REGRESSION,
+        GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(max_iterations=60, tolerance=1e-12)))
+    m_flat, _ = prob2.run(batch, dim=16, dtype=jnp.float64,
+                          regularization_weight=1.0)
+    np.testing.assert_allclose(np.asarray(m_pod.coefficients.means),
+                               np.asarray(m_flat.coefficients.means),
+                               rtol=1e-8, atol=1e-10)
