@@ -1009,7 +1009,15 @@ def config_fe_throughput(scale: float):
     # utilization figure is achieved bytes/s against the chip's HBM peak
     # (v5e: ~819 GB/s), not MFU
     bw = evals * 2.0 * n * d * 4 / warm
-    hbm_peak = 819e9 if "v5" in kind.lower() else None
+    low_kind = kind.lower()
+    if "v5p" in low_kind:
+        hbm_peak = 2765e9
+    elif "v5" in low_kind:      # v5e / v5 lite
+        hbm_peak = 819e9
+    elif "v4" in low_kind:
+        hbm_peak = 1228e9
+    else:
+        hbm_peak = None
     log(f"fe_throughput: {n}x{d}, {evals} evals in {warm:.2f}s -> "
         f"{achieved/1e9:.1f} GFLOP/s, {bw/1e9:.0f} GB/s on {kind} "
         f"(mfu {achieved/peak:.2e})")

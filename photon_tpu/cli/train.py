@@ -297,15 +297,32 @@ def _run(args: argparse.Namespace) -> List:
             return DaysRange.from_string(days_text).to_date_range()
         return None
 
+    def read_frame(dirs, imaps):
+        """Columnar native ingest when the schema shape and C toolchain
+        allow it (io/fast_ingest.py); generic record path otherwise."""
+        from photon_tpu.io.fast_ingest import read_game_frame
+        try:
+            out = read_game_frame(dirs, shard_configs, index_maps=imaps,
+                                  id_tag_columns=id_tags)
+        except (OSError, KeyError, ValueError):
+            raise
+        except Exception as e:  # noqa: BLE001 — fast path must never be fatal
+            logger.warning("fast ingest failed (%r), using generic path", e)
+            out = None
+        if out is not None:
+            return out
+        records = read_records(dirs)
+        maps = imaps if imaps is not None else build_index_maps(
+            records, shard_configs)
+        return records_to_game_dataframe(records, shard_configs, maps,
+                                         id_tag_columns=id_tags), maps
+
     with Timed("read training data", logger):
         input_dirs = resolve_input_dirs(
             args.input_data_directories,
             date_range_of(args.input_data_date_range,
                           args.input_data_days_range))
-        records = read_records(input_dirs)
-        index_maps = build_index_maps(records, shard_configs)
-        df = records_to_game_dataframe(records, shard_configs, index_maps,
-                                       id_tag_columns=id_tags)
+        df, index_maps = read_frame(input_dirs, None)
     validation_df = None
     if args.validation_data_directories:
         with Timed("read validation data", logger):
@@ -313,9 +330,7 @@ def _run(args: argparse.Namespace) -> List:
                 args.validation_data_directories,
                 date_range_of(args.validation_data_date_range,
                               args.validation_data_days_range))
-            vrecords = read_records(val_dirs)
-            validation_df = records_to_game_dataframe(
-                vrecords, shard_configs, index_maps, id_tag_columns=id_tags)
+            validation_df, _ = read_frame(val_dirs, index_maps)
 
     with Timed("data validation", logger):
         validate_dataframe(df, task, DataValidationType(args.data_validation))

@@ -27,11 +27,47 @@ from photon_tpu.ops import features as F
 SparseRows = List[Tuple[np.ndarray, np.ndarray]]  # per-row (indices, values)
 
 
+class CsrRows:
+    """Columnar sparse rows (CSR): the zero-Python-object counterpart of
+    ``SparseRows`` produced by the native ingest path (io/fast_ingest.py).
+    Duck-types the row-list protocol (len / [i] / iteration) so generic
+    consumers keep working; hot paths branch on isinstance for the
+    vectorized form."""
+
+    __slots__ = ("indptr", "cols", "vals")
+
+    def __init__(self, indptr: np.ndarray, cols: np.ndarray,
+                 vals: np.ndarray):
+        self.indptr = np.asarray(indptr, np.int64)
+        self.cols = np.asarray(cols)
+        self.vals = np.asarray(vals)
+
+    def __len__(self) -> int:
+        return len(self.indptr) - 1
+
+    def __getitem__(self, i) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        s, e = self.indptr[i], self.indptr[i + 1]
+        return self.cols[s:e], self.vals[s:e]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def row_nnz(self) -> np.ndarray:
+        return np.diff(self.indptr)
+
+
 @dataclasses.dataclass
 class FeatureShard:
-    """One feature space: sparse rows or a dense matrix, plus its dim."""
+    """One feature space: sparse rows (list- or CSR-form) or a dense
+    matrix, plus its dim."""
 
-    rows: Union[SparseRows, np.ndarray]
+    rows: Union[SparseRows, CsrRows, np.ndarray]
     dim: int
 
     @property
@@ -41,6 +77,9 @@ class FeatureShard:
     def max_nnz(self) -> int:
         if self.is_dense:
             return self.dim
+        if isinstance(self.rows, CsrRows):
+            nnz = self.rows.row_nnz()
+            return int(nnz.max()) if len(nnz) else 0
         return max((len(r[0]) for r in self.rows), default=0)
 
 
@@ -69,6 +108,9 @@ class GameDataFrame:
         shard = self.feature_shards[shard_id]
         if shard.is_dense:
             return jnp.asarray(shard.rows, dtype)
+        if isinstance(shard.rows, CsrRows):
+            return F.from_csr_arrays(shard.rows.indptr, shard.rows.cols,
+                                     shard.rows.vals, dtype=dtype)
         return F.from_rows(shard.rows, shard.dim, dtype=dtype)
 
     def fixed_effect_batch(self, shard_id: str, dtype=np.float32) -> DataBatch:

@@ -134,7 +134,13 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
 
 
 def _csr_of(rows) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """List[(idx, val)] -> (indptr [n+1], cols, vals)."""
+    """Sparse rows -> (indptr [n+1], cols, vals); CSR-form rows from the
+    native columnar ingest pass straight through."""
+    from photon_tpu.game.dataset import CsrRows
+
+    if isinstance(rows, CsrRows):
+        return (rows.indptr, np.asarray(rows.cols, np.int64),
+                np.asarray(rows.vals, np.float64))
     nnz = np.fromiter((len(r[0]) for r in rows), np.int64, len(rows))
     indptr = np.concatenate([[0], np.cumsum(nnz)])
     if len(rows):

@@ -386,11 +386,356 @@ static PyObject *py_decode_block(PyObject *self, PyObject *args) {
     return out;
 }
 
+/* ---- columnar feature-bag decoding -------------------------------------- */
+
+/* Feature bags (array<record{name, term, value}>) decode straight into
+ * growable id/value CSR buffers with a (ptr,len)-keyed open-addressing
+ * intern table over "name<DELIM>term" byte keys — no per-feature Python
+ * objects at all. Everything else in the record decodes generically. */
+
+typedef struct {
+    uint64_t hash;
+    uint32_t off, len;   /* into arena */
+    int32_t id;          /* first-seen id; slot empty when id < 0 */
+} InternSlot;
+
+typedef struct {
+    int32_t *ids; double *vals;          /* nnz-aligned */
+    int64_t *rowptr;                     /* one per record + 1 */
+    size_t nnz, ids_cap, vals_cap, nrows, rows_cap;
+    unsigned char *arena; size_t arena_len, arena_cap;
+    uint32_t *key_off, *key_len;         /* per interned key, id order */
+    size_t nkeys, key_off_cap, key_len_cap;
+    InternSlot *slots; size_t nslots;    /* power of two */
+} Bag;
+
+static int bag_init(Bag *b) {
+    memset(b, 0, sizeof(*b));
+    b->nslots = 1u << 12;
+    b->slots = (InternSlot *)PyMem_Malloc(b->nslots * sizeof(InternSlot));
+    if (b->slots == NULL) { PyErr_NoMemory(); return -1; }
+    for (size_t i = 0; i < b->nslots; i++) b->slots[i].id = -1;
+    return 0;
+}
+
+static void bag_free(Bag *b) {
+    PyMem_Free(b->ids); PyMem_Free(b->vals); PyMem_Free(b->rowptr);
+    PyMem_Free(b->arena); PyMem_Free(b->key_off); PyMem_Free(b->key_len);
+    PyMem_Free(b->slots);
+}
+
+static int grow(void **p, size_t *cap, size_t need, size_t elem) {
+    if (need <= *cap) return 0;
+    size_t ncap = *cap ? *cap : 1024;
+    while (ncap < need) ncap *= 2;
+    void *np_ = PyMem_Realloc(*p, ncap * elem);
+    if (np_ == NULL) { PyErr_NoMemory(); return -1; }
+    *p = np_; *cap = ncap;
+    return 0;
+}
+
+static uint64_t fnv1a(const unsigned char *s, size_t n, uint64_t h) {
+    for (size_t i = 0; i < n; i++) { h ^= s[i]; h *= 1099511628211ULL; }
+    return h;
+}
+
+static int bag_rehash(Bag *b) {
+    size_t nslots = b->nslots * 2;
+    InternSlot *ns = (InternSlot *)PyMem_Malloc(nslots * sizeof(InternSlot));
+    if (ns == NULL) { PyErr_NoMemory(); return -1; }
+    for (size_t i = 0; i < nslots; i++) ns[i].id = -1;
+    for (size_t i = 0; i < b->nslots; i++) {
+        if (b->slots[i].id < 0) continue;
+        size_t j = (size_t)b->slots[i].hash & (nslots - 1);
+        while (ns[j].id >= 0) j = (j + 1) & (nslots - 1);
+        ns[j] = b->slots[i];
+    }
+    PyMem_Free(b->slots);
+    b->slots = ns; b->nslots = nslots;
+    return 0;
+}
+
+/* intern name<delim>term; returns id or -1 on error */
+static int32_t bag_intern(Bag *b, const unsigned char *name, size_t nlen,
+                          const unsigned char *delim, size_t dlen,
+                          const unsigned char *term, size_t tlen) {
+    uint64_t h = 1469598103934665603ULL;
+    h = fnv1a(name, nlen, h); h = fnv1a(delim, dlen, h);
+    h = fnv1a(term, tlen, h);
+    size_t klen = nlen + dlen + tlen;
+    size_t j = (size_t)h & (b->nslots - 1);
+    while (b->slots[j].id >= 0) {
+        InternSlot *s = &b->slots[j];
+        if (s->hash == h && s->len == klen) {
+            const unsigned char *k = b->arena + s->off;
+            if (memcmp(k, name, nlen) == 0
+                && memcmp(k + nlen, delim, dlen) == 0
+                && memcmp(k + nlen + dlen, term, tlen) == 0)
+                return s->id;
+        }
+        j = (j + 1) & (b->nslots - 1);
+    }
+    /* miss: append to arena + key table, fill slot */
+    if (grow((void **)&b->arena, &b->arena_cap, b->arena_len + klen, 1) < 0)
+        return -1;
+    memcpy(b->arena + b->arena_len, name, nlen);
+    memcpy(b->arena + b->arena_len + nlen, delim, dlen);
+    memcpy(b->arena + b->arena_len + nlen + dlen, term, tlen);
+    if (grow((void **)&b->key_off, &b->key_off_cap, b->nkeys + 1,
+             sizeof(uint32_t)) < 0)
+        return -1;
+    if (grow((void **)&b->key_len, &b->key_len_cap, b->nkeys + 1,
+             sizeof(uint32_t)) < 0)
+        return -1;
+    b->key_off[b->nkeys] = (uint32_t)b->arena_len;
+    b->key_len[b->nkeys] = (uint32_t)klen;
+    b->arena_len += klen;
+    int32_t id = (int32_t)b->nkeys++;
+    b->slots[j].hash = h; b->slots[j].off = b->key_off[id];
+    b->slots[j].len = (uint32_t)klen; b->slots[j].id = id;
+    if (b->nkeys * 4 > b->nslots * 3 && bag_rehash(b) < 0) return -1;
+    return id;
+}
+
+/* one string: varint length + bytes, returned as (ptr, len) into buf */
+static int dec_str_view(Dec *d, const unsigned char **p, size_t *n) {
+    long long v;
+    if (dec_long(d, &v) < 0) return -1;
+    if ((*p = dec_read(d, (Py_ssize_t)v)) == NULL) return -1;
+    *n = (size_t)v;
+    return 0;
+}
+
+/* decode one feature-bag array value; roles: position of name/term/value
+ * within the 3-field item record (e.g. {0,1,2}) */
+static int decode_bag_array(Dec *d, Bag *b, const int roles[3],
+                            const unsigned char *delim, size_t dlen,
+                            int nullable_union_branch, int n_branches) {
+    long long v;
+    if (nullable_union_branch >= 0) {   /* bag behind ["null", array] */
+        if (dec_long(d, &v) < 0) return -1;
+        if (v < 0 || v >= n_branches) {  /* match the generic decoder */
+            PyErr_SetString(PyExc_ValueError, "union index out of range");
+            return -1;
+        }
+        if (v != nullable_union_branch) return 0;  /* null -> empty row */
+    }
+    while (1) {
+        if (dec_long(d, &v) < 0) return -1;
+        if (v == 0) break;
+        if (v < 0) {
+            long long nb;
+            if (dec_long(d, &nb) < 0) return -1;
+            if (v == LLONG_MIN) {
+                PyErr_SetString(PyExc_ValueError, "bad block count");
+                return -1;
+            }
+            v = -v;
+        }
+        for (long long i = 0; i < v; i++) {
+            const unsigned char *name = NULL, *term = NULL;
+            size_t nlen = 0, tlen = 0;
+            double value = 0.0;
+            for (int f = 0; f < 3; f++) {
+                if (f == roles[0]) {        /* name */
+                    if (dec_str_view(d, &name, &nlen) < 0) return -1;
+                } else if (f == roles[1]) { /* term */
+                    if (dec_str_view(d, &term, &tlen) < 0) return -1;
+                } else {                    /* value: double */
+                    const unsigned char *p = dec_read(d, 8);
+                    if (p == NULL) return -1;
+                    memcpy(&value, p, 8);
+                }
+            }
+            int32_t id = bag_intern(b, name, nlen, delim, dlen, term, tlen);
+            if (id < 0) return -1;
+            if (grow((void **)&b->ids, &b->ids_cap, b->nnz + 1,
+                     sizeof(int32_t)) < 0)
+                return -1;
+            if (grow((void **)&b->vals, &b->vals_cap, b->nnz + 1,
+                     sizeof(double)) < 0)
+                return -1;
+            b->ids[b->nnz] = id;
+            b->vals[b->nnz] = value;
+            b->nnz++;
+        }
+    }
+    return 0;
+}
+
+static PyObject *py_decode_columnar(PyObject *self, PyObject *args) {
+    /* (program, buf, count, bag_specs, delim) where bag_specs is a tuple
+     * of (top_field_index, role_name, role_term, role_value,
+     * nullable_union_branch) and the program is the TOP-LEVEL RECORD. */
+    PyObject *cap, *bag_specs;
+    Py_buffer buf;
+    Py_ssize_t count;
+    const char *delim;
+    Py_ssize_t dlen;
+    if (!PyArg_ParseTuple(args, "Oy*nOs#", &cap, &buf, &count, &bag_specs,
+                          &delim, &dlen))
+        return NULL;
+    Node *root = (Node *)PyCapsule_GetPointer(cap, "photon_tpu.avrodec");
+    PyObject *records = NULL, *result = NULL;
+    Bag *bags = NULL;
+    Py_ssize_t nbags = 0;
+    int *field_mode = NULL;   /* -1 generic, else bag index */
+    int (*bag_roles)[3] = NULL;  /* loop-invariant per-bag params */
+    int *bag_nub = NULL, *bag_nbranch = NULL;
+
+    if (root == NULL || root->op != OP_RECORD) {
+        PyErr_SetString(PyExc_ValueError, "program root must be a record");
+        goto done;
+    }
+    if (!PyTuple_Check(bag_specs)) {
+        PyErr_SetString(PyExc_TypeError, "bag_specs must be a tuple");
+        goto done;
+    }
+    nbags = PyTuple_GET_SIZE(bag_specs);
+    bags = (Bag *)PyMem_Calloc((size_t)nbags ? (size_t)nbags : 1, sizeof(Bag));
+    field_mode = (int *)PyMem_Malloc((size_t)root->n * sizeof(int));
+    /* loop-invariant per-bag parameters, parsed once */
+    bag_roles = (int (*)[3])PyMem_Malloc(
+        ((size_t)nbags ? (size_t)nbags : 1) * sizeof(*bag_roles));
+    bag_nub = (int *)PyMem_Malloc(
+        ((size_t)nbags ? (size_t)nbags : 1) * sizeof(int));
+    bag_nbranch = (int *)PyMem_Malloc(
+        ((size_t)nbags ? (size_t)nbags : 1) * sizeof(int));
+    if (bags == NULL || field_mode == NULL || bag_roles == NULL
+        || bag_nub == NULL || bag_nbranch == NULL) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    for (Py_ssize_t i = 0; i < root->n; i++) field_mode[i] = -1;
+    for (Py_ssize_t bi = 0; bi < nbags; bi++) {
+        if (bag_init(&bags[bi]) < 0) goto done;
+        PyObject *spec = PyTuple_GET_ITEM(bag_specs, bi);
+        if (!PyTuple_Check(spec) || PyTuple_GET_SIZE(spec) < 6) {
+            PyErr_SetString(PyExc_ValueError,
+                            "bag spec must be a 6-tuple");
+            goto done;
+        }
+        long fidx = PyLong_AsLong(PyTuple_GET_ITEM(spec, 0));
+        if (fidx < 0 || fidx >= root->n) {
+            PyErr_SetString(PyExc_ValueError, "bag field index out of range");
+            goto done;
+        }
+        field_mode[fidx] = (int)bi;
+        bag_roles[bi][0] = (int)PyLong_AsLong(PyTuple_GET_ITEM(spec, 1));
+        bag_roles[bi][1] = (int)PyLong_AsLong(PyTuple_GET_ITEM(spec, 2));
+        bag_roles[bi][2] = (int)PyLong_AsLong(PyTuple_GET_ITEM(spec, 3));
+        bag_nub[bi] = (int)PyLong_AsLong(PyTuple_GET_ITEM(spec, 4));
+        bag_nbranch[bi] = (int)PyLong_AsLong(PyTuple_GET_ITEM(spec, 5));
+        if (PyErr_Occurred()) goto done;
+    }
+
+    records = PyList_New(count);
+    if (records == NULL) goto done;
+    Dec d = { (const unsigned char *)buf.buf, 0, buf.len };
+
+    for (Py_ssize_t r = 0; r < count; r++) {
+        PyObject *rec = PyDict_New();
+        if (rec == NULL) goto done;
+        PyList_SET_ITEM(records, r, rec);
+        for (Py_ssize_t f = 0; f < root->n; f++) {
+            if (field_mode[f] >= 0) {
+                int bi2 = field_mode[f];
+                Bag *b = &bags[bi2];
+                if (grow((void **)&b->rowptr, &b->rows_cap, b->nrows + 2,
+                         sizeof(int64_t)) < 0)
+                    goto done;
+                if (b->nrows == 0) b->rowptr[0] = 0;
+                if (decode_bag_array(&d, b, bag_roles[bi2],
+                                     (const unsigned char *)delim,
+                                     (size_t)dlen, bag_nub[bi2],
+                                     bag_nbranch[bi2]) < 0)
+                    goto done;
+                b->rowptr[++b->nrows] = (int64_t)b->nnz;
+            } else {
+                PyObject *val = decode_node(&d, root->child[f]);
+                if (val == NULL
+                    || PyDict_SetItem(rec, root->names[f], val) < 0) {
+                    Py_XDECREF(val);
+                    goto done;
+                }
+                Py_DECREF(val);
+            }
+        }
+    }
+    if (d.pos != d.len) {
+        PyErr_Format(PyExc_ValueError,
+                     "block not fully consumed (%zd of %zd bytes)",
+                     d.pos, d.len);
+        goto done;
+    }
+
+    /* package: (records, ((rowptr, ids, vals, keys), ...)) */
+    {
+        PyObject *bags_out = PyTuple_New(nbags);
+        if (bags_out == NULL) goto done;
+        for (Py_ssize_t bi = 0; bi < nbags; bi++) {
+            Bag *b = &bags[bi];
+            if (b->nrows == 0) {   /* no records decoded */
+                if (grow((void **)&b->rowptr, &b->rows_cap, 1,
+                         sizeof(int64_t)) < 0) {
+                    Py_DECREF(bags_out); goto done;
+                }
+                b->rowptr[0] = 0;
+            }
+            PyObject *rp = PyBytes_FromStringAndSize(
+                (const char *)b->rowptr,
+                (Py_ssize_t)((b->nrows + 1) * sizeof(int64_t)));
+            PyObject *ids = PyBytes_FromStringAndSize(
+                (const char *)b->ids, (Py_ssize_t)(b->nnz * sizeof(int32_t)));
+            PyObject *vals = PyBytes_FromStringAndSize(
+                (const char *)b->vals, (Py_ssize_t)(b->nnz * sizeof(double)));
+            PyObject *keys = PyList_New((Py_ssize_t)b->nkeys);
+            if (rp == NULL || ids == NULL || vals == NULL || keys == NULL) {
+                Py_XDECREF(rp); Py_XDECREF(ids); Py_XDECREF(vals);
+                Py_XDECREF(keys); Py_DECREF(bags_out);
+                goto done;
+            }
+            int ok = 1;
+            for (size_t kix = 0; kix < b->nkeys; kix++) {
+                PyObject *s = PyUnicode_DecodeUTF8(
+                    (const char *)b->arena + b->key_off[kix],
+                    (Py_ssize_t)b->key_len[kix], NULL);
+                if (s == NULL) { ok = 0; break; }
+                PyList_SET_ITEM(keys, (Py_ssize_t)kix, s);
+            }
+            if (!ok) {
+                Py_DECREF(rp); Py_DECREF(ids); Py_DECREF(vals);
+                Py_DECREF(keys); Py_DECREF(bags_out);
+                goto done;
+            }
+            PyTuple_SET_ITEM(bags_out, bi,
+                             Py_BuildValue("(NNNN)", rp, ids, vals, keys));
+        }
+        result = Py_BuildValue("(NN)", records, bags_out);
+        records = NULL;   /* ownership moved */
+    }
+
+done:
+    if (bags != NULL) {
+        for (Py_ssize_t bi = 0; bi < nbags; bi++) bag_free(&bags[bi]);
+        PyMem_Free(bags);
+    }
+    PyMem_Free(field_mode);
+    PyMem_Free(bag_roles);
+    PyMem_Free(bag_nub);
+    PyMem_Free(bag_nbranch);
+    Py_XDECREF(records);
+    PyBuffer_Release(&buf);
+    return result;
+}
+
 static PyMethodDef methods[] = {
     {"compile_program", py_compile_program, METH_VARARGS,
      "Compile a schema program tree into a decoder capsule."},
     {"decode_block", py_decode_block, METH_VARARGS,
      "Decode `count` records from a decompressed Avro block."},
+    {"decode_columnar", py_decode_columnar, METH_VARARGS,
+     "Decode a block with feature bags going straight to CSR buffers."},
     {NULL, NULL, 0, NULL},
 };
 

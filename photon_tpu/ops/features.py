@@ -231,6 +231,30 @@ def partition_by_feature_range(
             np.ascontiguousarray(out_val[:n_shards, :, :kp]), shard_size)
 
 
+def from_csr_arrays(indptr, cols, vals, max_nnz: int | None = None,
+                    dtype=np.float32) -> SparseFeatures:
+    """Host-side: raw CSR arrays -> padded ELL (vectorized; the zero-copy
+    variant of from_scipy_csr for the native columnar ingest)."""
+    indptr = np.asarray(indptr, np.int64)
+    n = len(indptr) - 1
+    row_nnz = np.diff(indptr)
+    widest = int(row_nnz.max()) if n else 0
+    k = int(max_nnz) if max_nnz is not None else widest
+    if widest > k:
+        raise ValueError(f"row has {widest} nonzeros > max_nnz={k}; "
+                         "refusing to silently truncate features")
+    indices = np.zeros((n, k), dtype=np.int32)
+    values = np.zeros((n, k), dtype=dtype)
+    if n and k:
+        slot = np.arange(k)[None, :]
+        mask = slot < row_nnz[:, None]
+        src = indptr[:-1, None] + slot
+        indices[mask] = np.asarray(cols)[src[mask]]
+        values[mask] = np.asarray(vals)[src[mask]]
+    return SparseFeatures(indices=jnp.asarray(indices),
+                          values=jnp.asarray(values))
+
+
 def from_scipy_csr(csr, max_nnz: int | None = None, dtype=np.float32) -> SparseFeatures:
     """Host-side: scipy CSR -> padded ELL arrays (vectorized).
 

@@ -1,0 +1,127 @@
+"""Columnar native ingest (io/fast_ingest.py): parity with the generic
+record path on every semantic the generic path defines — index maps,
+duplicate keys (last wins), unseen-key drops at scoring time, intercepts,
+offsets/weights/id tags — plus the fallback contract."""
+
+import numpy as np
+import pytest
+
+from photon_tpu.io import avro as A
+from photon_tpu.io.data_io import (
+    FeatureShardConfiguration,
+    build_index_maps,
+    records_to_game_dataframe,
+)
+from photon_tpu.io.fast_ingest import read_game_frame
+from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+from photon_tpu.ops import features as F
+
+
+def _write(tmp_path, recs, name="data.avro"):
+    d = tmp_path / "in"
+    d.mkdir(exist_ok=True)
+    A.write_avro(str(d / name), TRAINING_EXAMPLE_AVRO, recs)
+    return str(d)
+
+
+def _records(rng, n=400, k=8, dup_every=7):
+    recs = []
+    for i in range(n):
+        feats = [{"name": f"f{j}", "term": "t",
+                  "value": float(rng.normal())} for j in range(k)]
+        if dup_every and i % dup_every == 0:
+            feats.append({"name": "f1", "term": "t", "value": 42.0})
+        recs.append({"uid": str(i), "label": float(i % 2),
+                     "features": feats,
+                     "metadataMap": {"userId": str(i % 20)},
+                     "weight": 0.5 + (i % 3), "offset": 0.1 * (i % 5)})
+    return recs
+
+
+@pytest.fixture
+def native_available():
+    import photon_tpu.native as N
+
+    if N._load() is None:
+        pytest.skip("no C compiler for the native decoder")
+
+
+def test_fast_ingest_matches_generic_path(tmp_path, rng, native_available):
+    recs = _records(rng)
+    d = _write(tmp_path, recs)
+    shard = {"features": FeatureShardConfiguration.of("features",
+                                                      intercept=True)}
+
+    out = read_game_frame([d], shard, id_tag_columns=["userId"])
+    assert out is not None, "fast path must engage on TrainingExampleAvro"
+    df_fast, maps_fast = out
+
+    _, loaded = A.read_avro(str(tmp_path / "in" / "data.avro"))
+    maps = build_index_maps(loaded, shard)
+    df = records_to_game_dataframe(loaded, shard, maps,
+                                   id_tag_columns=["userId"])
+
+    assert dict(maps_fast["features"].items()) == dict(maps["features"].items())
+    np.testing.assert_array_equal(df_fast.response, df.response)
+    np.testing.assert_array_equal(df_fast.offsets, df.offsets)
+    np.testing.assert_array_equal(df_fast.weights, df.weights)
+    assert df_fast.id_tags["userId"] == df.id_tags["userId"]
+
+    # feature parity through compute (row-internal order is free)
+    dim = maps["features"].feature_dimension
+    theta = rng.normal(size=dim)
+    np.testing.assert_allclose(
+        np.asarray(F.matvec(df_fast.shard_features("features", np.float64),
+                            theta)),
+        np.asarray(F.matvec(df.shard_features("features", np.float64),
+                            theta)),
+        rtol=1e-9)
+    # duplicate (f1, t) must resolve last-wins = 42.0 exactly once
+    idx0, val0 = df_fast.feature_shards["features"].rows[0]
+    assert (np.asarray(val0) == 42.0).sum() == 1
+
+
+def test_fast_ingest_scoring_drops_unseen_keys(tmp_path, rng,
+                                               native_available):
+    """With a supplied index map (the scoring flow), keys absent from the
+    map are dropped — matching the generic path."""
+    train = _records(rng, n=100, k=4, dup_every=0)
+    score = _records(rng, n=50, k=6, dup_every=0)  # f4, f5 unseen
+    d1 = _write(tmp_path, train)
+    shard = {"features": FeatureShardConfiguration.of("features",
+                                                      intercept=True)}
+    _, maps = read_game_frame([d1], shard)
+
+    d2 = tmp_path / "score"
+    d2.mkdir()
+    A.write_avro(str(d2 / "s.avro"), TRAINING_EXAMPLE_AVRO, score)
+    df_fast, _ = read_game_frame([str(d2)], shard, index_maps=maps)
+    df_gen = records_to_game_dataframe(score, shard, maps)
+    dim = maps["features"].feature_dimension
+    theta = rng.normal(size=dim)
+    np.testing.assert_allclose(
+        np.asarray(F.matvec(df_fast.shard_features("features", np.float64),
+                            theta)),
+        np.asarray(F.matvec(df_gen.shard_features("features", np.float64),
+                            theta)),
+        rtol=1e-9)
+
+
+def test_fast_ingest_falls_back_on_multi_bag(tmp_path, rng,
+                                             native_available):
+    recs = _records(rng, n=20)
+    d = _write(tmp_path, recs)
+    shard = {"s": FeatureShardConfiguration.of("features", "features2")}
+    assert read_game_frame([d], shard) is None  # multi-bag -> generic path
+
+
+def test_csr_rows_duck_typing(rng):
+    from photon_tpu.game.dataset import CsrRows
+
+    rows = CsrRows(np.array([0, 2, 2, 5]), np.array([3, 1, 0, 2, 4]),
+                   np.array([1., 2., 3., 4., 5.]))
+    assert len(rows) == 3
+    idx, val = rows[0]
+    np.testing.assert_array_equal(idx, [3, 1])
+    assert list(rows.row_nnz()) == [2, 0, 3]
+    assert len(list(iter(rows))) == 3
