@@ -77,6 +77,24 @@ def build_index_maps(
     }
 
 
+def extract_id_tags(records: Sequence[dict],
+                    id_tag_columns: Sequence[str]) -> Dict[str, List[str]]:
+    """Entity-id columns from record dicts: top-level column first, then
+    metadataMap (reference: GameConverters.getGameDatumFromRow idTag
+    handling). A present-but-null top-level value does NOT fall through —
+    the single None-handling rule for every ingest path."""
+    out: Dict[str, List[str]] = {c: [None] * len(records)
+                                 for c in id_tag_columns}
+    for i, rec in enumerate(records):
+        meta = rec.get(METADATA_COLUMN) or {}
+        for col in id_tag_columns:
+            v = rec.get(col, meta.get(col))
+            if v is None:
+                raise KeyError(f"record {i} missing id tag column {col!r}")
+            out[col][i] = str(v)
+    return out
+
+
 def records_to_game_dataframe(
     records: Sequence[dict],
     shard_configs: Dict[str, FeatureShardConfiguration],
@@ -111,7 +129,7 @@ def records_to_game_dataframe(
             any_weight = True
         meta = rec.get(METADATA_COLUMN) or {}
         for col in id_tag_columns:
-            v = rec.get(col, meta.get(col))
+            v = rec.get(col, meta.get(col))  # same rule as extract_id_tags
             if v is None:
                 raise KeyError(f"record {i} missing id tag column {col!r}")
             id_tags[col][i] = str(v)

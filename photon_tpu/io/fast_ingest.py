@@ -145,10 +145,14 @@ def read_game_frame(
     index_maps: Optional[Dict[str, IndexMap]] = None,
     id_tag_columns: Sequence[str] = (),
     response_columns: Sequence[str] = RESPONSE_COLUMNS,
-) -> Optional[Tuple[GameDataFrame, Dict[str, IndexMap]]]:
+    return_records: bool = False,
+) -> Optional[Tuple]:
     """Columnar read of Avro dirs -> (GameDataFrame, index maps), or None
     when the native decoder / schema shape is unavailable (caller falls
-    back to read_records + records_to_game_dataframe)."""
+    back to read_records + records_to_game_dataframe). With
+    ``return_records`` the (bag-free) record dicts ride along as a third
+    element — drivers use them for uid passthrough and late id-tag
+    discovery."""
     from photon_tpu import native
 
     if native._load() is None:
@@ -199,6 +203,10 @@ def read_game_frame(
                     raise SchemaError("sync marker mismatch")
 
     n = len(records)
+    if n == 0:
+        # match read_records' contract: empty partitions error clearly
+        # instead of yielding a degenerate 0-sample frame
+        raise ValueError(f"no Avro records under {list(input_dirs)}")
     # scalar columns (cheap Python loop: one dict access per column)
     response = np.zeros(n)
     offsets = np.zeros(n)
@@ -269,8 +277,8 @@ def read_game_frame(
                 head = new_indptr[:-1]
                 new_cols[head] = j
                 new_vals[head] = 1.0
-                slot = np.arange(total)
-                is_data = ~np.isin(slot, head)
+                is_data = np.ones(total, bool)
+                is_data[head] = False
                 new_cols[is_data] = mapped
                 new_vals[is_data] = vals
                 indptr, mapped, vals = new_indptr, new_cols, new_vals
@@ -278,11 +286,14 @@ def read_game_frame(
         shards[sid] = FeatureShard(
             CsrRows(indptr, mapped.astype(np.int32), vals), dim)
 
-    return (GameDataFrame(
+    frame = GameDataFrame(
         num_samples=n,
         response=response,
         feature_shards=shards,
         offsets=offsets if any_offset else None,
         weights=weights if any_weight else None,
         id_tags=id_tags,
-    ), built_maps)
+    )
+    if return_records:
+        return frame, built_maps, records
+    return frame, built_maps

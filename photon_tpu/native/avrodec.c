@@ -708,8 +708,12 @@ static PyObject *py_decode_columnar(PyObject *self, PyObject *args) {
                 Py_DECREF(keys); Py_DECREF(bags_out);
                 goto done;
             }
-            PyTuple_SET_ITEM(bags_out, bi,
-                             Py_BuildValue("(NNNN)", rp, ids, vals, keys));
+            PyObject *packed = Py_BuildValue("(NNNN)", rp, ids, vals, keys);
+            if (packed == NULL) {   /* N-refs consumed even on failure */
+                Py_DECREF(bags_out);
+                goto done;
+            }
+            PyTuple_SET_ITEM(bags_out, bi, packed);
         }
         result = Py_BuildValue("(NN)", records, bags_out);
         records = NULL;   /* ownership moved */

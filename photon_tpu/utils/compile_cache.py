@@ -21,6 +21,26 @@ _DEFAULT_DIR = os.path.join(
 _enabled = False
 
 
+def _host_fingerprint() -> str:
+    """Short token for (machine, CPU features): XLA's AOT loader will load
+    an executable compiled for a different feature set with only a warning
+    ('could lead to ... SIGILL'), so the cache directory itself must be
+    host-specific."""
+    import hashlib
+    import platform
+
+    bits = [platform.machine(), platform.processor() or ""]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    bits.append(" ".join(sorted(line.split()[2:])))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
+
+
 def enable_persistent_cache(cache_dir: str | None = None) -> str:
     """Enable JAX's on-disk compilation cache (idempotent).
 
@@ -30,7 +50,8 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     global _enabled
     import jax
 
-    path = cache_dir or os.environ.get("PHOTON_TPU_XLA_CACHE", _DEFAULT_DIR)
+    base = cache_dir or os.environ.get("PHOTON_TPU_XLA_CACHE", _DEFAULT_DIR)
+    path = os.path.join(base, _host_fingerprint())
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # cache aggressively: GAME programs are many medium-sized executables
