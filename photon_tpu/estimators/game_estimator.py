@@ -107,6 +107,7 @@ class GameEstimator:
         variance_computation_type=None,
         normalization_contexts=None,
         intercept_indices=None,
+        feature_dtype=None,
     ):
         """``mesh``: a `jax.sharding.Mesh` — fixed-effect batches are
         sample-sharded and random-effect entity blocks entity-sharded over
@@ -134,6 +135,10 @@ class GameEstimator:
         self.mesh = mesh
         self.normalization_contexts = dict(normalization_contexts or {})
         self.intercept_indices = dict(intercept_indices or {})
+        # narrower on-device feature storage (e.g. jnp.bfloat16): the
+        # bandwidth-bound fixed-effect solve reads half the HBM bytes
+        # while solver math stays at `dtype` via in-register promotion
+        self.feature_dtype = feature_dtype
         from photon_tpu.types import VarianceComputationType
         self.variance_computation_type = (
             variance_computation_type or VarianceComputationType.NONE)
@@ -172,7 +177,9 @@ class GameEstimator:
                     variance_type=self.variance_computation_type,
                     norm=norm, intercept_index=icpt)
             else:
-                batch = df.fixed_effect_batch(shard_id, dtype=np.dtype(self.dtype).type)
+                batch = df.fixed_effect_batch(
+                    shard_id, dtype=np.dtype(self.dtype).type,
+                    feature_dtype=self.feature_dtype)
                 key = jax.random.PRNGKey(sampling_seed + i)
                 coordinates[cid] = FixedEffectCoordinate(
                     batch, df.feature_shards[shard_id].dim, shard_id, self.task,
@@ -229,7 +236,7 @@ class GameEstimator:
         # tuning candidates, warm re-fits) skip the host-side ingest
         # entirely; only regularization weights change between candidates
         # and those are traced arguments of the cached solves
-        prep_key = (self.dtype,
+        prep_key = (self.dtype, self.feature_dtype, self.mesh,
                     tuple((cid, cfg.data)
                           for cid, cfg in self.coordinate_configs.items()))
         cached = getattr(self, "_prep_cache", None)

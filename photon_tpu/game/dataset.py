@@ -113,11 +113,18 @@ class GameDataFrame:
                                      shard.rows.vals, dtype=dtype)
         return F.from_rows(shard.rows, shard.dim, dtype=dtype)
 
-    def fixed_effect_batch(self, shard_id: str, dtype=np.float32) -> DataBatch:
+    def fixed_effect_batch(self, shard_id: str, dtype=np.float32,
+                           feature_dtype=None) -> DataBatch:
         """Reference: FixedEffectDataset — flat uid-major batch over one
-        feature shard."""
+        feature shard.
+
+        ``feature_dtype`` stores X narrower than the solve dtype (e.g.
+        bfloat16 under an f32 solve): matvec/rmatvec promote to the
+        accumulation dtype in-register, so a bandwidth-bound solve reads
+        half the HBM bytes while the optimizer math stays full-precision.
+        """
         return DataBatch(
-            features=self.shard_features(shard_id, dtype),
+            features=self.shard_features(shard_id, feature_dtype or dtype),
             labels=jnp.asarray(self.response, dtype),
             offsets=None if self.offsets is None else jnp.asarray(self.offsets, dtype),
             weights=None if self.weights is None else jnp.asarray(self.weights, dtype),

@@ -115,7 +115,13 @@ def _ms_scatter(x: ModelShardedSparse, w: Array, square: bool) -> Array:
     shard_size = x.shard_size
 
     def f(idx, val, wl):
-        v = val[0] * val[0] if square else val[0]
+        if square:
+            # promote BEFORE squaring: bf16 storage must not round the
+            # squared Hessian terms at storage precision
+            v0 = val[0].astype(wl.dtype)
+            v = v0 * v0
+        else:
+            v = val[0]
         contrib = (v * wl[:, None]).ravel()
         g = jnp.zeros((shard_size,), dtype=contrib.dtype)
         g = g.at[idx[0].ravel()].add(contrib)
@@ -137,13 +143,17 @@ def rmatvec(x: FeatureMatrix, w: Array, dim: int) -> Array:
 
 
 def sq_rmatvec(x: FeatureMatrix, w: Array, dim: int) -> Array:
-    """``(X * X)^T w`` -> [d] (elementwise square), for Hessian diagonals."""
+    """``(X * X)^T w`` -> [d] (elementwise square), for Hessian diagonals.
+    Values promote to the weight dtype BEFORE squaring so narrow feature
+    storage (bf16) doesn't round the squared Hessian terms."""
     if isinstance(x, ModelShardedSparse):
         return _ms_scatter(x, w, square=True)
     if isinstance(x, SparseFeatures):
-        contrib = (x.values * x.values * w[:, None]).ravel()
+        v = x.values.astype(w.dtype)
+        contrib = (v * v * w[:, None]).ravel()
         return jnp.zeros((dim,), dtype=contrib.dtype).at[x.indices.ravel()].add(contrib)
-    return (x * x).T @ w
+    xf = x.astype(w.dtype)
+    return (xf * xf).T @ w
 
 
 def weighted_gram(x: FeatureMatrix, w: Array, dim: int) -> Array:

@@ -279,3 +279,35 @@ def test_random_effect_tron_matches_lbfgs(glmix):
     # both stop on FunctionValuesConverged; the optima agree to solver
     # tolerance, not bitwise (different iterates)
     np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_feature_storage_preserves_quality(glmix):
+    """Opt-in bfloat16 feature storage (halved HBM traffic on the
+    bandwidth-bound fixed-effect solve) must keep solver math at the
+    solve dtype and land within quality tolerance of f32 storage."""
+    train, val, _ = glmix
+
+    def fit(feature_dtype):
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_configs={
+                "fixed": glmix_estimator().coordinate_configs["fixed"]},
+            update_sequence=["fixed"], num_iterations=1,
+            validation_evaluators=[EvaluatorType.AUC],
+            dtype=jnp.float32, feature_dtype=feature_dtype)
+        res = est.fit(train, validation_df=val)[-1]
+        coord = est._coordinates["fixed"]
+        return res, coord
+
+    res32, coord32 = fit(None)
+    res16, coord16 = fit(jnp.bfloat16)
+
+    def feat_dtype(coord):
+        f = coord.batch.features
+        return f.values.dtype if hasattr(f, "values") else f.dtype
+
+    assert feat_dtype(coord16) == jnp.bfloat16
+    assert feat_dtype(coord32) == jnp.float32
+    # solver ran in f32 space
+    assert res16.model["fixed"].model.coefficients.means.dtype == jnp.float32
+    assert abs(res16.evaluation["AUC"] - res32.evaluation["AUC"]) < 0.01
