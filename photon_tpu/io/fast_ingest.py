@@ -31,7 +31,7 @@ from photon_tpu.io.data_io import (
     WEIGHT_COLUMN,
     FeatureShardConfiguration,
 )
-from photon_tpu.io.index_map import DELIMITER, INTERCEPT_KEY, IndexMap, feature_key
+from photon_tpu.io.index_map import DELIMITER, INTERCEPT_KEY, IndexMap
 
 logger = logging.getLogger(__name__)
 
