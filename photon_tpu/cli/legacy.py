@@ -38,11 +38,7 @@ from photon_tpu.function.objective import (
 )
 from photon_tpu.game.dataset import FeatureShard, GameDataFrame
 from photon_tpu.io import avro as avro_io
-from photon_tpu.io.data_io import (
-    FeatureShardConfiguration,
-    build_index_maps,
-    records_to_game_dataframe,
-)
+from photon_tpu.io.data_io import FeatureShardConfiguration
 from photon_tpu.io.index_map import IndexMap
 from photon_tpu.io.model_io import _vector_to_ntvs
 from photon_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_AVRO
@@ -137,10 +133,17 @@ class LegacyDriver:
             else:
                 shard = {"features": FeatureShardConfiguration.of(
                     "features", intercept=args.intercept)}
-                records = list(avro_io.iter_avro_dir(args.training_data_directory))
-                imaps = build_index_maps(records, shard)
+
+                from photon_tpu.io.fast_ingest import (
+                    read_frame_with_fallback,
+                )
+
+                def read(directory, imaps):
+                    return read_frame_with_fallback([directory], shard,
+                                                    index_maps=imaps)
+
+                df, imaps = read(args.training_data_directory, None)
                 self.index_map = imaps["features"]
-                df = records_to_game_dataframe(records, shard, imaps)
                 validate_dataframe(df, self.task,
                                    DataValidationType(args.data_validation))
                 self.train_batch = df.fixed_effect_batch("features")
@@ -149,8 +152,7 @@ class LegacyDriver:
                 self.val_labels = None
                 self.val_weights = None
                 if args.validating_data_directory:
-                    vrecs = list(avro_io.iter_avro_dir(args.validating_data_directory))
-                    vdf = records_to_game_dataframe(vrecs, shard, imaps)
+                    vdf, _ = read(args.validating_data_directory, imaps)
                     self.val_batch = vdf.shard_features("features")
                     self.val_labels = vdf.response
                     self.val_weights = vdf.weights

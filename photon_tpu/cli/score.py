@@ -20,12 +20,7 @@ from photon_tpu.cli.config import parse_feature_shard_config
 from photon_tpu.evaluation.multi import EvaluationSuite
 from photon_tpu.game.random_effect import RandomEffectDataConfiguration
 from photon_tpu.game.scoring import GameScorer
-from photon_tpu.io.data_io import (
-    build_index_maps,
-    read_records,
-    records_to_game_dataframe,
-    write_scores,
-)
+from photon_tpu.io.data_io import write_scores
 from photon_tpu.io.model_io import load_game_model
 from photon_tpu.game.model import RandomEffectModel
 from photon_tpu.utils.timing import Timed
@@ -74,21 +69,9 @@ def _run(args: argparse.Namespace) -> np.ndarray:
                          for s in args.feature_shards)
 
     with Timed("read scoring data", logger):
-        from photon_tpu.io.fast_ingest import read_game_frame
-        fast = None
-        try:
-            fast = read_game_frame(args.input_data_directories,
-                                   shard_configs, return_records=True)
-        except (OSError, KeyError, ValueError):
-            raise
-        except Exception as e:  # noqa: BLE001 — fast path must never be fatal
-            logger.warning("fast ingest failed (%r), using generic path", e)
-        if fast is not None:
-            df, index_maps, records = fast
-        else:
-            records = read_records(args.input_data_directories)
-            index_maps = build_index_maps(records, shard_configs)
-            df = None
+        from photon_tpu.io.fast_ingest import read_frame_with_fallback
+        df, index_maps, records = read_frame_with_fallback(
+            args.input_data_directories, shard_configs, return_records=True)
 
     with Timed("load model", logger):
         loaded = load_game_model(args.model_input_directory, index_maps)
@@ -101,15 +84,11 @@ def _run(args: argparse.Namespace) -> np.ndarray:
         _, _, tag = str(ev).partition(":")
         if tag:
             id_tags.add(tag)
-    if df is None:
-        df = records_to_game_dataframe(records, shard_configs, index_maps,
-                                       id_tag_columns=sorted(id_tags))
-    else:
-        # id-tag columns become known only after the model loads; extract
-        # them from the (bag-free) records the fast path carried along,
-        # with the generic path's exact None semantics
-        from photon_tpu.io.data_io import extract_id_tags
-        df.id_tags.update(extract_id_tags(records, sorted(id_tags)))
+    # id-tag columns become known only after the model loads; extract them
+    # from the (bag-free on the fast path) records with the single
+    # None-handling rule shared by every ingest path
+    from photon_tpu.io.data_io import extract_id_tags
+    df.id_tags.update(extract_id_tags(records, sorted(id_tags)))
 
     with Timed("score", logger):
         scorer = GameScorer(df.num_samples)

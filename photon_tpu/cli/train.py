@@ -43,11 +43,7 @@ from photon_tpu.hyperparameter.tuner import (
     HyperparameterTuningMode,
     run_hyperparameter_tuning,
 )
-from photon_tpu.io.data_io import (
-    build_index_maps,
-    read_records,
-    records_to_game_dataframe,
-)
+from photon_tpu.io.fast_ingest import read_frame_with_fallback
 from photon_tpu.io.model_io import save_game_model
 from photon_tpu.ops.normalization import NormalizationType
 from photon_tpu.types import TaskType, VarianceComputationType
@@ -299,23 +295,10 @@ def _run(args: argparse.Namespace) -> List:
 
     def read_frame(dirs, imaps):
         """Columnar native ingest when the schema shape and C toolchain
-        allow it (io/fast_ingest.py); generic record path otherwise."""
-        from photon_tpu.io.fast_ingest import read_game_frame
-        try:
-            out = read_game_frame(dirs, shard_configs, index_maps=imaps,
-                                  id_tag_columns=id_tags)
-        except (OSError, KeyError, ValueError):
-            raise
-        except Exception as e:  # noqa: BLE001 — fast path must never be fatal
-            logger.warning("fast ingest failed (%r), using generic path", e)
-            out = None
-        if out is not None:
-            return out
-        records = read_records(dirs)
-        maps = imaps if imaps is not None else build_index_maps(
-            records, shard_configs)
-        return records_to_game_dataframe(records, shard_configs, maps,
-                                         id_tag_columns=id_tags), maps
+        allow it, generic record path otherwise (io/fast_ingest.py)."""
+        return read_frame_with_fallback(dirs, shard_configs,
+                                        index_maps=imaps,
+                                        id_tag_columns=id_tags)
 
     with Timed("read training data", logger):
         input_dirs = resolve_input_dirs(

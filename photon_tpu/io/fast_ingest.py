@@ -297,3 +297,43 @@ def read_game_frame(
     if return_records:
         return frame, built_maps, records
     return frame, built_maps
+
+
+def read_frame_with_fallback(
+    input_dirs: Sequence[str],
+    shard_configs: Dict[str, FeatureShardConfiguration],
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+    id_tag_columns: Sequence[str] = (),
+    return_records: bool = False,
+):
+    """The drivers' shared ingest ladder: columnar native path first,
+    generic record path on any unsupported shape or non-fatal failure.
+    Genuine data errors (missing files, empty partitions, corruption)
+    raise identically on BOTH arms — behavior must never depend on
+    whether the C extension compiled."""
+    from photon_tpu.io.data_io import (
+        build_index_maps,
+        read_records,
+        records_to_game_dataframe,
+    )
+
+    out = None
+    try:
+        out = read_game_frame(input_dirs, shard_configs,
+                              index_maps=index_maps,
+                              id_tag_columns=id_tag_columns,
+                              return_records=return_records)
+    except (OSError, KeyError, ValueError):
+        raise
+    except Exception as e:  # noqa: BLE001 — the fast path must never be fatal
+        logger.warning("fast ingest failed (%r), using generic path", e)
+    if out is not None:
+        return out
+    records = read_records(list(input_dirs))  # raises on empty, both arms
+    maps = index_maps if index_maps is not None else build_index_maps(
+        records, shard_configs)
+    frame = records_to_game_dataframe(records, shard_configs, maps,
+                                      id_tag_columns=id_tag_columns)
+    if return_records:
+        return frame, maps, records
+    return frame, maps
