@@ -910,11 +910,16 @@ def config_heart_real(scale: float):
     # answer: NormalizationType.STANDARDIZATION); the oracle gets the SAME
     # train-derived affine transform so both sides solve the same problem
     X = np.asarray(to_dense(batch.features, dim))
+    from photon_tpu.data.stats import compute_feature_stats
     from photon_tpu.io.index_map import INTERCEPT_KEY
+
     iidx = imaps["features"].get_index(INTERCEPT_KEY)
-    # ddof=1 matches compute_feature_stats' sample variance so both solvers
-    # see the IDENTICAL affine transform
-    mu, sd = X.mean(axis=0), X.std(axis=0, ddof=1)
+    iidx = iidx if iidx >= 0 else None  # get_index returns -1, never None
+    # the oracle standardizes with the SAME statistics object the solver's
+    # normalization context is built from — identity by construction
+    stats = compute_feature_stats(batch.features, dim)
+    mu = np.asarray(stats.mean).copy()
+    sd = np.sqrt(np.asarray(stats.variance))
     sd[sd == 0] = 1.0
     if iidx is not None:
         mu[iidx], sd[iidx] = 0.0, 1.0
@@ -930,12 +935,10 @@ def config_heart_real(scale: float):
         oracle_best = max(oracle_best, auc_score(yv01, Xvs @ clf.coef_.ravel()))
     oracle_t = time.perf_counter() - t0
 
-    from photon_tpu.data.stats import compute_feature_stats
     from photon_tpu.ops.normalization import (
         NormalizationType,
         build_normalization_context,
     )
-    stats = compute_feature_stats(batch.features, dim)
     norm = build_normalization_context(
         NormalizationType.STANDARDIZATION, stats.mean, stats.variance,
         stats.abs_max, intercept_index=iidx)
