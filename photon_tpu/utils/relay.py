@@ -1,0 +1,48 @@
+"""Axon-relay liveness probe, shared by bench.py and __graft_entry__.
+
+The TPU chip in this environment is fronted by a local relay process
+(the "axon tunnel", ports 8082+ on the first PALLAS_AXON_POOL_IPS
+host). When the relay dies, PJRT init blocks forever on a refused
+socket, so callers TCP-preflight it before letting jax initialize the
+axon backend. One copy of the port list / probe policy lives here.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+
+RELAY_PORTS = (8082, 8083, 8087)
+PROBE_TIMEOUT_S = 2.0
+
+
+def relay_host() -> str | None:
+    """First pool IP, or None when no axon relay is configured."""
+    pool = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    return pool.split(",")[0].strip() if pool else None
+
+
+def probe_relay() -> dict[int, str]:
+    """{port: "accepted" | exception name} for each relay port.
+    Empty dict when no relay is configured."""
+    host = relay_host()
+    if host is None:
+        return {}
+    checks: dict[int, str] = {}
+    for port in RELAY_PORTS:
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=PROBE_TIMEOUT_S):
+                checks[port] = "accepted"
+        except Exception as e:  # noqa: BLE001 — any failure = not alive
+            checks[port] = type(e).__name__
+    return checks
+
+
+def relay_alive() -> bool | None:
+    """True/False for a configured relay; None when none is configured
+    (nothing to preflight — backend selection proceeds normally)."""
+    checks = probe_relay()
+    if not checks:
+        return None
+    return any(v == "accepted" for v in checks.values())
