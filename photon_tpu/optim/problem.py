@@ -164,10 +164,13 @@ class GlmOptimizationProblem:
                     explicit = opt.explicit_hessian
                     if explicit is None:
                         # auto: the d x d GEMM rebuild per outer iteration
-                        # is an MXU bargain but a CPU/BLAS loss — measured
-                        # 20x faster on TPU v5e, ~2x slower on host CPU
+                        # is an MXU bargain at any moderate dim (measured
+                        # 20x faster on TPU v5e at d=512); on host CPU the
+                        # crossover vs matrix-free Hv sits between d=256
+                        # (1.5x faster) and d=512 (1.3x slower)
                         on_tpu = jax.default_backend() not in ("cpu",)
-                        explicit = dense and dim <= 2048 and on_tpu
+                        explicit = dense and (dim <= 2048 if on_tpu
+                                              else dim <= 256)
                     if explicit:
                         hs = lambda c: obj.hessian_matrix_from_weights(
                             obj.hessian_weights(c, batch), dim, batch, hyper)

@@ -22,9 +22,11 @@ def relay_host() -> str | None:
     return pool.split(",")[0].strip() if pool else None
 
 
-def probe_relay() -> dict[int, str]:
+def probe_relay(stop_on_accept: bool = False) -> dict[int, str]:
     """{port: "accepted" | exception name} for each relay port.
-    Empty dict when no relay is configured."""
+    Empty dict when no relay is configured. ``stop_on_accept`` returns at
+    the first live port (liveness checks); the default probes every port
+    (diagnostics)."""
     host = relay_host()
     if host is None:
         return {}
@@ -34,6 +36,8 @@ def probe_relay() -> dict[int, str]:
             with socket.create_connection((host, port),
                                           timeout=PROBE_TIMEOUT_S):
                 checks[port] = "accepted"
+                if stop_on_accept:
+                    break
         except Exception as e:  # noqa: BLE001 — any failure = not alive
             checks[port] = type(e).__name__
     return checks
@@ -42,7 +46,7 @@ def probe_relay() -> dict[int, str]:
 def relay_alive() -> bool | None:
     """True/False for a configured relay; None when none is configured
     (nothing to preflight — backend selection proceeds normally)."""
-    checks = probe_relay()
+    checks = probe_relay(stop_on_accept=True)
     if not checks:
         return None
     return any(v == "accepted" for v in checks.values())
