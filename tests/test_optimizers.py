@@ -176,3 +176,61 @@ def test_solver_vmaps_over_problems(rng):
 def test_minimize_dispatch_errors():
     with pytest.raises(ValueError):
         minimize(OptimizerType.TRON, lambda x: (x @ x, 2 * x), jnp.zeros(3))
+
+
+def test_tron_explicit_matches_matrix_free(rng):
+    """The explicit d x d Gauss-Newton path and the matrix-free Hv path
+    must produce the same solve (optim/problem.py auto gate: explicit on
+    CPU up to d=256, on TPU up to d=2048 — both sides of the gate are
+    exercised here regardless of backend)."""
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        GlmOptimizationProblem,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    batch, X, y = make_logistic(rng, n=600)
+    coefs = {}
+    for explicit in (False, True):
+        cfg = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(
+                optimizer_type=OptimizerType.TRON,
+                max_iterations=60, tolerance=1e-11,
+                explicit_hessian=explicit),
+            regularization=L2Regularization, regularization_weight=0.5)
+        prob = GlmOptimizationProblem(TaskType.LOGISTIC_REGRESSION, cfg)
+        model, res = prob.run(batch, dim=D, dtype=jnp.float64)
+        coefs[explicit] = np.asarray(model.coefficients.means)
+    np.testing.assert_allclose(coefs[True], coefs[False],
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_relay_probe(monkeypatch):
+    """relay preflight: unconfigured -> None; configured-but-dead -> False
+    (uses a localhost port nothing listens on)."""
+    from photon_tpu.utils import relay
+
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    assert relay.relay_alive() is None
+    assert relay.probe_relay() == {}
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setattr(relay, "RELAY_PORTS", (1,))  # reserved port: refused
+    assert relay.relay_alive() is False
+
+    # a live listener flips it to True (stop_on_accept returns early);
+    # connect() completes via the kernel listen backlog — no accept needed
+    import socket as _socket
+
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    port = srv.getsockname()[1]
+    monkeypatch.setattr(relay, "RELAY_PORTS", (port, 1))
+    try:
+        assert relay.relay_alive() is True
+        assert relay.probe_relay(stop_on_accept=True) == {port: "accepted"}
+    finally:
+        srv.close()
