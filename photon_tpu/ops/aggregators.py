@@ -90,7 +90,19 @@ def value_and_gradient(
 
     Reference: ValueAndGradientAggregator.calculateValueAndGradient
     (:240-255 RDD path, :266-279 local path) — here one fused kernel.
+
+    With ``PHOTON_TPU_PALLAS_GLM=1`` the dense / identity-normalization /
+    f32 case runs the Pallas single-HBM-pass kernel
+    (ops/pallas_glm.py) instead of XLA's two contractions over X. The
+    flag is read at trace time: toggling it mid-process does not affect
+    already-compiled solves.
     """
+    import os
+    if os.environ.get("PHOTON_TPU_PALLAS_GLM") == "1":
+        from photon_tpu.ops import pallas_glm
+        if pallas_glm._supported(x, norm):
+            return pallas_glm.fused_dense_value_grad(
+                loss, x, labels, offsets, weights, coef)
     dim = coef.shape[0]
     margins = compute_margins(x, coef, offsets, norm)
     l, dz = loss.loss_and_dz(margins, labels)

@@ -1,0 +1,145 @@
+"""Pallas TPU kernel: fused dense GLM value+gradient in ONE pass over X.
+
+The XLA path (ops/aggregators.py value_and_gradient) lowers to two
+separate contractions over the feature matrix — ``margins = X @ coef``
+and ``grad = X^T (w * dz)`` — so every objective evaluation streams X
+from HBM twice. A GLM solve at fixed-effect shapes is HBM-bandwidth-
+bound (bench fe_throughput: ~80% of v5e HBM peak), which makes the
+second pass pure waste: dz depends only on each row's own margin, so
+the gradient contraction can consume the SAME VMEM-resident tile of X
+that just produced the margins.
+
+This kernel tiles X over rows; per grid step it computes
+``m = X_tile @ coef`` (MXU), the pointwise loss/dz (VPU), and
+accumulates ``value += sum(w*l)`` and ``grad += X_tile^T (w*dz)``
+(MXU) into carried output blocks — X is read from HBM exactly once.
+Theoretical ceiling vs the XLA path on a bandwidth-bound solve: 2x.
+
+Scope: dense [N, D] features, identity normalization, f32. The sparse
+ELL path keeps the XLA gather/scatter kernels (its bottleneck is the
+scatter, not a second stream of X). Callers opt in via
+``PHOTON_TPU_PALLAS_GLM=1`` (see ops/aggregators.py); correctness is
+pinned by interpret-mode parity tests against the XLA path
+(tests/test_pallas_glm.py) which run on every backend.
+
+Reference semantics: ValueAndGradientAggregator.scala:36-80 (the same
+fused margin/loss/grad algebra, minus the normalization prefactors).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_TILE_N = 1024
+
+
+def _supported(x, norm) -> bool:
+    """Dense 2D f32 features, identity normalization."""
+    return (isinstance(x, jax.Array) and x.ndim == 2
+            and x.dtype == jnp.float32 and norm.is_identity)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5, 6))
+def _fused(loss_and_dz, x, labels, offsets, weights, tile_n: int,
+           interpret: bool, coef):
+    from jax.experimental import pallas as pl
+
+    n, d = x.shape
+
+    def kernel(x_ref, y_ref, off_ref, w_ref, coef_ref, val_ref, grad_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            val_ref[0, 0] = jnp.float32(0.0)
+            grad_ref[:] = jnp.zeros_like(grad_ref)
+
+        # one MXU pass for margins; the tile of X stays in VMEM for the
+        # gradient contraction below — HBM reads X exactly once
+        m = jnp.dot(x_ref[:], coef_ref[:],
+                    preferred_element_type=jnp.float32)       # [T, 1]
+        z = m + off_ref[:]
+        l, dz = loss_and_dz(z, y_ref[:])
+        w = w_ref[:]
+        val_ref[0, 0] += jnp.sum(l * w)
+        # grad += X_tile^T (w * dz): contract over the row axis
+        grad_ref[:] += jax.lax.dot_general(
+            x_ref[:], w * dz,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [D, 1]
+
+    grid = (n // tile_n,)
+    value, grad = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((d, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, labels, offsets, weights, coef.reshape(d, 1))
+    return value[0, 0], grad[:, 0]
+
+
+def fused_dense_value_grad(
+    loss,
+    x: Array,
+    labels: Array,
+    offsets: Optional[Array],
+    weights: Optional[Array],
+    coef: Array,
+    *,
+    tile_n: int = _TILE_N,
+    interpret: Optional[bool] = None,
+) -> Tuple[Array, Array]:
+    """Weighted loss value and gradient, X streamed from HBM once.
+
+    Drop-in for the un-normalized dense case of
+    ``aggregators.value_and_gradient`` (no L2 term — the objective adds
+    it, as with the XLA path). Rows are padded to the tile size with
+    zero-weight samples, which contribute nothing to either output.
+    """
+    if interpret is None:
+        # the sequential-grid accumulation idiom (init on i==0, += on a
+        # revisited output block) is a TPU guarantee; every other backend
+        # gets exact interpret-mode semantics
+        interpret = jax.default_backend() != "tpu"
+    n, d = x.shape
+    if n == 0:
+        # grid=(0,) would skip the kernel entirely and return
+        # uninitialized buffers; match the XLA path's empty-sum contract
+        zero = jnp.zeros((), jnp.float32)
+        return zero, jnp.zeros((d,), jnp.float32)
+    tile = min(tile_n, max(8, n))
+    pad = (-n) % tile
+    y = jnp.asarray(labels, jnp.float32)
+    off = (jnp.zeros((n,), jnp.float32) if offsets is None
+           else jnp.asarray(offsets, jnp.float32))
+    w = (jnp.ones((n,), jnp.float32) if weights is None
+         else jnp.asarray(weights, jnp.float32))
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        y = jnp.pad(y, (0, pad))
+        off = jnp.pad(off, (0, pad))
+        w = jnp.pad(w, (0, pad))        # zero weight: no contribution
+    npad = n + pad
+    return _fused(loss.loss_and_dz, x, y.reshape(npad, 1),
+                  off.reshape(npad, 1), w.reshape(npad, 1), tile,
+                  bool(interpret), jnp.asarray(coef, jnp.float32))
