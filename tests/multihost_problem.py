@@ -1,0 +1,15 @@
+"""Shared deterministic problem for the multi-host test: every process
+(and the in-test single-host oracle) reconstructs the identical global
+dataset from the same seed, so only the runtime topology differs."""
+
+import numpy as np
+
+
+def make_global_problem():
+    n_global, d = 4096, 16
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n_global, d)).astype(np.float32)
+    w = rng.normal(size=d)
+    y = (rng.random(n_global) < 1 / (1 + np.exp(-(X @ w)))).astype(np.float32)
+    cfg_args = dict(max_iterations=100, tolerance=1e-9)
+    return X, y, cfg_args

@@ -1,0 +1,72 @@
+"""Worker process for the 2-process multi-host test (not a pytest file).
+
+Each worker is a separate OS process with its own JAX runtime: process p
+feeds its half of a deterministic global logistic problem through
+``shard_process_local_batch`` and runs the SAME public
+``GlmOptimizationProblem.run`` used single-host. The solve's gradient
+all-reduces cross the process boundary (Gloo on CPU — the DCN stand-in;
+SURVEY §5.8). Process 0 writes the solved coefficients for the parent
+test to compare against an in-process single-host solve.
+
+Usage: multihost_worker.py <pid> <nproc> <port> <out_npy>
+"""
+
+import os
+import sys
+
+
+def main():
+    pid, nproc, port, out = (int(sys.argv[1]), int(sys.argv[2]),
+                             sys.argv[3], sys.argv[4])
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=4")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+
+    from photon_tpu.parallel import mesh as M
+    assert M.initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc, process_id=pid) == nproc
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from photon_tpu.data.dataset import DataBatch
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        GlmOptimizationProblem,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+    from tests.multihost_problem import make_global_problem
+
+    Xg, yg, cfg_args = make_global_problem()
+    n_global, d = Xg.shape
+    mesh = M.create_mesh(len(jax.devices()))
+    lo = pid * (n_global // nproc)
+    hi = lo + n_global // nproc
+    batch = M.shard_process_local_batch(
+        DataBatch(Xg[lo:hi], yg[lo:hi], None, None), mesh, n_global)
+    x0 = M.replicate_from_process_local(np.zeros(d, np.float32), mesh)
+
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(**cfg_args),
+        regularization=L2Regularization, regularization_weight=1.0)
+    prob = GlmOptimizationProblem(TaskType.LOGISTIC_REGRESSION, cfg)
+    model, res = prob.run(batch, initial=x0, dim=d, dtype=jnp.float32)
+    coefs = np.asarray(
+        jax.jit(lambda c: c, out_shardings=M.replicated(mesh))(
+            model.coefficients.means).addressable_data(0))
+    print(f"proc {pid}: devices {len(jax.devices())} "
+          f"iters {int(np.asarray(res.iterations))} "
+          f"coefnorm {np.linalg.norm(coefs):.6f}", flush=True)
+    if pid == 0:
+        np.save(out, coefs)
+
+
+if __name__ == "__main__":
+    main()
