@@ -492,9 +492,9 @@ def config_glmix_logistic(scale: float):
         "model_flops_est": float(model_flops),
         "peak_flops_assumed": peak,
         "baseline": "sklearn LogisticRegression(lbfgs) one-hot flattening, same host CPU",
-        "cpu_note": "CPU fallback loses to threaded-BLAS sklearn (XLA-CPU "
-                    "matvec floor); the same config measured 1.48x vs the "
-                    "oracle on TPU v5e with the slower L-BFGS path "
+        "cpu_note": "beats sklearn even on the CPU fallback after the "
+                    "w @ X contraction fix + TRON; 1.48x measured on TPU "
+                    "v5e with the slower pre-fix L-BFGS path "
                     "(bench_r04_live.out)",
     }
 
@@ -598,15 +598,16 @@ def config_poisson_tron(scale: float):
         "elasticnet_wallclock_s": round(enet_warm, 2),
         "elasticnet_rmse": round(enet_rmse, 4),
         "baseline": "sklearn PoissonRegressor(lbfgs), same host CPU",
-        # On a CPU fallback this config loses to sklearn on wall-clock at
-        # equal iteration counts (~8 TRON iters, ~23 s vs ~1-2 s): the
-        # residual is the XLA-CPU dense matvec emitter (~2.7 GFLOP/s
-        # measured) vs sklearn's threaded BLAS (~22 GFLOP/s) — a backend
-        # floor, not solver slack. The identical solve on TPU v5e runs
-        # 0.10 s (20x FASTER than sklearn; BENCH_TPU_LIVE_r04.md), which
-        # is the deployment target this framework optimizes for.
-        "cpu_note": ("backend floor: XLA-CPU matvec vs threaded BLAS; "
-                     "same solve is 20x faster than sklearn on TPU v5e"),
+        # After the w @ X contraction-order fix (round 3's "16x slower"
+        # was the XLA-CPU strided-transpose rmatvec, not solver slack)
+        # the CPU fallback runs ~1 s vs sklearn's ~0.9 s — within the
+        # single-kernel-vs-threaded-BLAS noise at equal iteration
+        # counts. The identical solve on TPU v5e runs 0.06-0.10 s
+        # (15-20x FASTER than sklearn; BENCH_TPU_LIVE_r04.md), which is
+        # the deployment target this framework optimizes for.
+        "cpu_note": ("~parity with threaded-BLAS sklearn on CPU "
+                     "fallback; same solve is 15-20x faster than "
+                     "sklearn on TPU v5e"),
     }
 
 
