@@ -279,6 +279,9 @@ class RandomEffectCoordinate:
         opt = self.config.optimizer
         solver_cfg = opt.solver_config()
         opt_type = opt.optimizer_type
+        if opt_type == OptimizerType.DIRECT:
+            from photon_tpu.optim.problem import _validate_direct
+            _validate_direct(self.task, opt, self.config.regularization)
         has_norm = self._norm_local is not None
         has_shifts = has_norm and self._norm_local[1] is not None
 
@@ -301,7 +304,15 @@ class RandomEffectCoordinate:
                 else:
                     obj_e = obj
                 vg = lambda c: obj_e.value_and_gradient(c, batch, hyper)
-                if opt_type == OptimizerType.OWLQN:
+                if opt_type == OptimizerType.DIRECT:
+                    # one [K, K] normal-equations solve per entity; under
+                    # vmap this is a single batched [E, K, K] Cholesky
+                    # (optim/direct.py) — no sequential iterations at all
+                    from photon_tpu.optim import direct
+                    r = direct.minimize(
+                        vg, lambda c: obj_e.hessian_matrix(c, batch, hyper),
+                        x0)
+                elif opt_type == OptimizerType.OWLQN:
                     r = owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
                 elif opt_type == OptimizerType.TRON:
                     # explicit K x K Gauss-Newton per outer iteration when

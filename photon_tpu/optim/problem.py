@@ -40,6 +40,21 @@ from photon_tpu.utils import jitcache
 Array = jax.Array
 
 
+def _validate_direct(task, opt: "OptimizerConfig", regularization) -> None:
+    """DIRECT's contract is the EXACT minimizer; reject every config it
+    cannot solve exactly (shared by the fixed- and random-effect paths)."""
+    if task != TaskType.LINEAR_REGRESSION:
+        raise ValueError(
+            "OptimizerType.DIRECT is exact only for the quadratic squared "
+            f"loss (LINEAR_REGRESSION); use LBFGS/TRON for {task}")
+    if opt.lower_bounds is not None or opt.upper_bounds is not None:
+        raise ValueError("DIRECT does not support box constraints")
+    if regularization.l1_weight(1.0) != 0.0:
+        raise ValueError(
+            "DIRECT solves the L2/unregularized normal equations exactly; "
+            "L1/elastic-net needs OWLQN")
+
+
 def solver_cache_key(opt: "OptimizerConfig") -> tuple:
     """Everything in an OptimizerConfig that shapes a solver's trace."""
     return (opt.optimizer_type, opt.max_iterations, opt.tolerance,
@@ -143,10 +158,17 @@ class GlmOptimizationProblem:
         solver_cfg = opt.solver_config()
         obj = self.objective
 
+        if opt.optimizer_type == OptimizerType.DIRECT:
+            _validate_direct(self.task, opt, self.config.regularization)
+
         def build():
             def solve(x0: Array, batch: DataBatch, l2: Array, l1: Array) -> SolverResult:
                 hyper = Hyper(l2_weight=l2)
                 vg = lambda c: obj.value_and_gradient(c, batch, hyper)
+                if opt.optimizer_type == OptimizerType.DIRECT:
+                    from photon_tpu.optim import direct
+                    return direct.minimize(
+                        vg, lambda c: obj.hessian_matrix(c, batch, hyper), x0)
                 if opt.optimizer_type == OptimizerType.OWLQN:
                     return owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
                 if opt.optimizer_type == OptimizerType.TRON:

@@ -52,3 +52,9 @@ class OptimizerType(enum.Enum):
     OWLQN = "OWLQN"
     LBFGSB = "LBFGSB"
     TRON = "TRON"
+    # TPU-native extension (no reference analog): exact normal-equations
+    # solve for squared loss — one weighted-Gram contraction (MXU) plus a
+    # Cholesky factorization, batched over entities under vmap. The same
+    # minimizer the iterative solvers converge to, computed directly
+    # (sklearn Ridge's own cholesky solver is the CPU-world equivalent).
+    DIRECT = "DIRECT"

@@ -311,3 +311,61 @@ def test_bf16_feature_storage_preserves_quality(glmix):
     # solver ran in f32 space
     assert res16.model["fixed"].model.coefficients.means.dtype == jnp.float32
     assert abs(res16.evaluation["AUC"] - res32.evaluation["AUC"]) < 0.01
+
+
+def test_direct_solver_game_parity():
+    """DIRECT (batched per-entity normal equations) lands on the same GAME
+    model as tightly-converged TRON for linear regression — fixed AND
+    random effects."""
+    import numpy as np
+
+    from photon_tpu.estimators.game_estimator import (
+        CoordinateConfiguration,
+        FixedEffectDataConfiguration,
+        GameEstimator,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+    from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import OptimizerType, TaskType
+
+    rng = np.random.default_rng(3)
+    n, d, users, d_u = 500, 6, 7, 3
+    Xg = rng.normal(size=(n, d))
+    Xu = rng.normal(size=(n, d_u))
+    uid = rng.integers(0, users, size=n)
+    y = (Xg @ rng.normal(size=d)
+         + np.einsum("nk,nk->n", Xu, rng.normal(size=(users, d_u))[uid])
+         + 0.2 * rng.normal(size=n))
+    iu = np.arange(d_u, dtype=np.int32)
+    df = GameDataFrame(
+        num_samples=n, response=y,
+        feature_shards={"g": FeatureShard(Xg, d),
+                        "u": FeatureShard([(iu, Xu[i]) for i in range(n)], d_u)},
+        id_tags={"userId": [f"u{v}" for v in uid]})
+
+    def fit(opt_type, **kw):
+        opt = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(optimizer_type=opt_type, **kw),
+            regularization=L2Regularization, regularization_weight=1.0)
+        est = GameEstimator(
+            TaskType.LINEAR_REGRESSION,
+            {"fixed": CoordinateConfiguration(
+                FixedEffectDataConfiguration("g"), opt),
+             "per_user": CoordinateConfiguration(
+                 RandomEffectDataConfiguration("userId", "u"), opt)},
+            update_sequence=["fixed", "per_user"], num_iterations=3,
+            dtype=np.float64)
+        res = est.fit(df)
+        return (np.asarray(res[-1].model["fixed"].model.coefficients.means),
+                np.asarray(res[-1].model["per_user"].coefficients))
+
+    f_direct, re_direct = fit(OptimizerType.DIRECT)
+    f_tron, re_tron = fit(OptimizerType.TRON,
+                          max_iterations=100, tolerance=1e-13)
+    np.testing.assert_allclose(f_direct, f_tron, rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(re_direct, re_tron, rtol=1e-6, atol=1e-8)

@@ -234,3 +234,52 @@ def test_relay_probe(monkeypatch):
         assert relay.probe_relay(stop_on_accept=True) == {port: "accepted"}
     finally:
         srv.close()
+
+
+def test_direct_solver_matches_ridge_and_tron(rng):
+    """DIRECT (normal equations, optim/direct.py) computes the exact ridge
+    minimizer: parity vs sklearn Ridge(cholesky) and vs a tightly-converged
+    TRON on the same problem; non-quadratic tasks are rejected."""
+    from sklearn.linear_model import Ridge
+
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        GlmOptimizationProblem,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    n = 800
+    X = rng.normal(size=(n, D))
+    y = X @ rng.normal(size=D) + 0.3 * rng.normal(size=n)
+    batch = DataBatch(jnp.asarray(X), jnp.asarray(y))
+    lam = 2.5
+
+    def solve(opt_type, **kw):
+        cfg = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(optimizer_type=opt_type, **kw),
+            regularization=L2Regularization, regularization_weight=lam)
+        prob = GlmOptimizationProblem(TaskType.LINEAR_REGRESSION, cfg)
+        model, res = prob.run(batch, dim=D, dtype=jnp.float64)
+        return np.asarray(model.coefficients.means), res
+
+    c_direct, res = solve(OptimizerType.DIRECT)
+    assert int(res.iterations) == 1
+
+    sk = Ridge(alpha=lam, fit_intercept=False, solver="cholesky")
+    sk.fit(X, y)
+    # same objective: photon minimizes sum of 0.5*(m-y)^2 + 0.5*lam*||w||^2,
+    # sklearn minimizes ||Xw-y||^2 + alpha*||w||^2 — identical minimizer
+    # when alpha = lam (both quadratic forms scale together)
+    np.testing.assert_allclose(c_direct, sk.coef_, rtol=1e-8, atol=1e-10)
+
+    c_tron, _ = solve(OptimizerType.TRON, max_iterations=100, tolerance=1e-13)
+    np.testing.assert_allclose(c_direct, c_tron, rtol=1e-6, atol=1e-8)
+
+    with pytest.raises(ValueError, match="DIRECT"):
+        cfg = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(optimizer_type=OptimizerType.DIRECT),
+            regularization=L2Regularization, regularization_weight=1.0)
+        prob = GlmOptimizationProblem(TaskType.LOGISTIC_REGRESSION, cfg)
+        prob.run(batch, dim=D, dtype=jnp.float64)
