@@ -133,8 +133,14 @@ def parse_coordinate_config(text: str) -> ParsedCoordinate:
         data = FixedEffectDataConfiguration(shard)
 
     opt_type = OptimizerType(args.pop("optimizer").upper())
-    max_iter = int(args.pop("max.iter"))
-    tolerance = float(args.pop("tolerance"))
+    # optional, as in the reference's scopt grammar — OptimizerConfig's
+    # dataclass defaults stay the single source of truth (DIRECT has no
+    # meaningful iteration/tolerance knobs at all)
+    opt_kwargs = {}
+    if "max.iter" in args:
+        opt_kwargs["max_iterations"] = int(args.pop("max.iter"))
+    if "tolerance" in args:
+        opt_kwargs["tolerance"] = float(args.pop("tolerance"))
     reg_context = _regularization(args)
     weights_text = args.pop("reg.weights", None)
     reg_weights = tuple(float(w) for w in
@@ -145,9 +151,7 @@ def parse_coordinate_config(text: str) -> ParsedCoordinate:
         raise ValueError(f"unknown coordinate args for {name!r}: {sorted(args)}")
 
     opt = GLMOptimizationConfiguration(
-        optimizer=OptimizerConfig(optimizer_type=opt_type,
-                                  max_iterations=max_iter,
-                                  tolerance=tolerance),
+        optimizer=OptimizerConfig(optimizer_type=opt_type, **opt_kwargs),
         regularization=reg_context,
         regularization_weight=reg_weights[0],
         down_sampling_rate=down_sampling,
