@@ -20,8 +20,13 @@ from photon_tpu.data.dataset import DataBatch
 from photon_tpu.models.glm import GeneralizedLinearModel
 from photon_tpu.ops.normalization import NormalizationContext, no_normalization
 from photon_tpu.optim.base import SolverResult
-from photon_tpu.optim.problem import GLMOptimizationConfiguration, GlmOptimizationProblem
-from photon_tpu.types import TaskType
+from photon_tpu.optim.problem import (
+    GLMOptimizationConfiguration,
+    GlmOptimizationProblem,
+    _validate_direct,
+    norm_cache_key,
+)
+from photon_tpu.types import OptimizerType, TaskType
 
 Array = jax.Array
 
@@ -45,6 +50,16 @@ def train_generalized_linear_model(
     """
     problem = GlmOptimizationProblem(task, config, norm,
                                      intercept_index=intercept_index)
+    if (config.optimizer.optimizer_type == OptimizerType.DIRECT
+            and len(regularization_weights) > 1):
+        # the whole ridge path shares one Gram matrix: one data pass +
+        # one batched Cholesky per lambda (optim/direct.minimize_path);
+        # warm starts are irrelevant for an exact solver. Same validity
+        # contract as the per-lambda path (problem._solve_fn).
+        _validate_direct(task, config.optimizer, config.regularization)
+        return _direct_path(problem, batch, dim, regularization_weights,
+                            initial, dtype, intercept_index)
+
     models: Dict[float, GeneralizedLinearModel] = {}
     stats: Dict[float, SolverResult] = {}
     coef = initial
@@ -57,4 +72,50 @@ def train_generalized_linear_model(
             # models are published in original space; run() converts warm
             # starts back into the transformed optimization space
             coef = model.coefficients.means
+    return models, stats
+
+
+def _direct_path(problem, batch, dim, lambdas, initial, dtype,
+                 intercept_index):
+    """DIRECT over a lambda path: shared Gram, per-lambda Cholesky."""
+    from photon_tpu.function.objective import Hyper
+    from photon_tpu.models.glm import Coefficients
+    from photon_tpu.optim import direct
+    from photon_tpu.utils import jitcache
+
+    # the regularization context splits each total weight into its L2
+    # part exactly as the per-lambda path does (problem.run)
+    reg = problem.config.regularization
+    l2_weights = [reg.l2_weight(lam) for lam in lambdas]
+    obj = problem.objective
+    norm = obj.norm
+    if initial is None:
+        x0 = jnp.zeros((dim,), dtype)
+    else:
+        x0 = jnp.asarray(initial, dtype)
+        if not norm.is_identity:
+            x0 = norm.model_to_transformed_space(x0, intercept_index)
+
+    def build():
+        @jax.jit
+        def path(x0, batch, lams):
+            zero = jnp.zeros((), x0.dtype)
+            vg = lambda c: obj.value_and_gradient(c, batch, Hyper(zero))
+            hm = lambda c: obj.hessian_matrix(c, batch, Hyper(zero))
+            return direct.minimize_path(vg, hm, x0, lams)
+
+        return path
+
+    path_fn = jitcache.get_or_build(
+        ("direct_path", problem.task, norm_cache_key(norm)), build)
+    res = path_fn(x0, batch, jnp.asarray(l2_weights, dtype))
+
+    models, stats = {}, {}
+    for i, lam in enumerate(lambdas):
+        r = jax.tree.map(lambda a: a[i], res)
+        coef = r.coef
+        if not norm.is_identity:
+            coef = norm.transformed_space_to_model(coef, intercept_index)
+        models[lam] = GeneralizedLinearModel(Coefficients(coef), problem.task)
+        stats[lam] = r
     return models, stats

@@ -283,3 +283,98 @@ def test_direct_solver_matches_ridge_and_tron(rng):
             regularization=L2Regularization, regularization_weight=1.0)
         prob = GlmOptimizationProblem(TaskType.LOGISTIC_REGRESSION, cfg)
         prob.run(batch, dim=D, dtype=jnp.float64)
+
+
+def test_direct_reg_path_shared_gram(rng):
+    """The DIRECT lambda path (one data pass + per-lambda Cholesky,
+    optim/direct.minimize_path) equals per-lambda DIRECT solves, raw and
+    under STANDARDIZATION normalization, with and without a warm start."""
+    from photon_tpu.data.stats import compute_feature_stats
+    from photon_tpu.estimators.model_training import (
+        train_generalized_linear_model,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.ops.normalization import (
+        NormalizationType,
+        build_normalization_context,
+        no_normalization,
+    )
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    n = 600
+    X = rng.normal(size=(n, D)) * (1.0 + np.arange(D))
+    X[:, -1] = 1.0                                     # intercept column
+    y = X @ rng.normal(size=D) + 0.4 * rng.normal(size=n)
+    batch = DataBatch(jnp.asarray(X), jnp.asarray(y))
+    lambdas = [0.1, 1.0, 10.0]
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(optimizer_type=OptimizerType.DIRECT),
+        regularization=L2Regularization)
+
+    s = compute_feature_stats(batch.features, D)
+    norm = build_normalization_context(
+        NormalizationType.STANDARDIZATION, s.mean, s.variance, s.abs_max,
+        intercept_index=D - 1)
+    x_init = np.asarray(rng.normal(size=D) * 0.1)
+
+    for nrm, icpt in ((no_normalization(), None), (norm, D - 1)):
+        for init in (None, x_init):
+            path_models, path_stats = train_generalized_linear_model(
+                TaskType.LINEAR_REGRESSION, batch, D, cfg,
+                regularization_weights=lambdas, norm=nrm, initial=init,
+                dtype=jnp.float64, intercept_index=icpt)
+            for lam in lambdas:
+                single, sres = train_generalized_linear_model(
+                    TaskType.LINEAR_REGRESSION, batch, D, cfg,
+                    regularization_weights=[lam], norm=nrm, initial=init,
+                    dtype=jnp.float64, intercept_index=icpt)
+                np.testing.assert_allclose(
+                    np.asarray(path_models[lam].coefficients.means),
+                    np.asarray(single[lam].coefficients.means),
+                    rtol=1e-8, atol=1e-10)
+                np.testing.assert_allclose(
+                    float(path_stats[lam].value), float(sres[lam].value),
+                    rtol=1e-8)
+
+
+def test_direct_path_respects_regularization_context(rng):
+    """The shared-Gram path splits lambda through the SAME regularization
+    context as the per-lambda path: NoRegularization yields identical
+    (unregularized) solutions for every lambda, and non-quadratic tasks
+    are rejected before the path runs."""
+    from photon_tpu.estimators.model_training import (
+        train_generalized_linear_model,
+    )
+    from photon_tpu.function.objective import NoRegularization
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    n = 300
+    X = rng.normal(size=(n, D))
+    y = X @ rng.normal(size=D) + 0.1 * rng.normal(size=n)
+    batch = DataBatch(jnp.asarray(X), jnp.asarray(y))
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(optimizer_type=OptimizerType.DIRECT),
+        regularization=NoRegularization)
+    models, _ = train_generalized_linear_model(
+        TaskType.LINEAR_REGRESSION, batch, D, cfg,
+        regularization_weights=[0.5, 5.0], dtype=jnp.float64)
+    c = {lam: np.asarray(m.coefficients.means) for lam, m in models.items()}
+    np.testing.assert_allclose(c[0.5], c[5.0], rtol=1e-12)  # both raw OLS
+    single, _ = train_generalized_linear_model(
+        TaskType.LINEAR_REGRESSION, batch, D, cfg,
+        regularization_weights=[0.5], dtype=jnp.float64)
+    np.testing.assert_allclose(
+        c[0.5], np.asarray(single[0.5].coefficients.means), rtol=1e-8)
+
+    with pytest.raises(ValueError, match="DIRECT"):
+        train_generalized_linear_model(
+            TaskType.LOGISTIC_REGRESSION, batch, D, cfg,
+            regularization_weights=[0.5, 5.0], dtype=jnp.float64)
