@@ -378,3 +378,25 @@ def test_direct_path_respects_regularization_context(rng):
         train_generalized_linear_model(
             TaskType.LOGISTIC_REGRESSION, batch, D, cfg,
             regularization_weights=[0.5, 5.0], dtype=jnp.float64)
+
+
+def test_direct_singular_hessian_reports_not_converged(rng):
+    """A rank-deficient unregularized problem must keep the start point
+    AND say NOT_CONVERGED — a failed entity may not masquerade as
+    converged in the per-entity trackers."""
+    from photon_tpu.function.objective import GLMObjective, Hyper
+    from photon_tpu.ops.losses import SquaredLoss
+    from photon_tpu.optim import direct
+
+    X = np.zeros((20, 4))          # all-zero features: H = 0 at lambda=0
+    y = rng.normal(size=20)
+    batch = DataBatch(jnp.asarray(X), jnp.asarray(y))
+    obj = GLMObjective(SquaredLoss)
+    hyper = Hyper.of(0.0, dtype=jnp.float64)
+    x0 = jnp.asarray(rng.normal(size=4))
+    res = direct.minimize(
+        lambda c: obj.value_and_gradient(c, batch, hyper),
+        lambda c: obj.hessian_matrix(c, batch, hyper), x0)
+    np.testing.assert_array_equal(np.asarray(res.coef), np.asarray(x0))
+    assert int(res.reason) == ConvergenceReason.NOT_CONVERGED
+    assert np.isfinite(float(res.value))
