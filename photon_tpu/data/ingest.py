@@ -24,68 +24,133 @@ import jax.numpy as jnp
 @dataclasses.dataclass
 class LibSVMData:
     labels: np.ndarray          # [n] float, mapped to {0, 1} from {-1, +1}
-    rows: list                  # list of (indices, values)
+    rows: list                  # list of (indices, values) OR CsrRows
     dim: int
     max_nnz: int
+
+
+def _parse_libsvm_native(files, zero_based):
+    """Columnar parse via the C tokenizer (native/libsvmdec.c): zero
+    Python objects per nonzero. (labels, indptr, cols, vals) raw arrays,
+    or None when the native path is unavailable."""
+    from photon_tpu.native import libsvm_parser
+
+    parse = libsvm_parser()
+    if parse is None or not files:
+        return None    # empty dir: one empty-data contract (Python path)
+    parts = []
+    for fp in files:
+        with open(fp, "rb") as f:
+            out = parse(f.read(), int(zero_based))
+        parts.append(tuple(np.frombuffer(b, dt) for b, dt in
+                           zip(out, (np.float64, np.int64, np.int32,
+                                     np.float64))))
+    labels = np.concatenate([p[0] for p in parts])
+    # splice per-file CSRs: offsets shift each file's indptr
+    nnz_off = np.cumsum([0] + [len(p[2]) for p in parts])
+    indptr = np.concatenate(
+        [p[1][:-1] + o for p, o in zip(parts, nnz_off)]
+        + [np.asarray([nnz_off[-1]], np.int64)])
+    cols = np.concatenate([p[2] for p in parts])
+    vals = np.concatenate([p[3] for p in parts])
+    return labels, indptr, cols, vals
+
+
+def _parse_libsvm_python(files, zero_based):
+    """Pure-Python fallback with the same grammar as libsvmdec.c ('#'
+    truncates a line anywhere, blank lines are skipped) and the same
+    columnar (labels, indptr, cols, vals) output."""
+    labels: list = []
+    indptr: list = [0]
+    cols: list = []
+    vals: list = []
+    for fp in files:
+        with open(fp) as f:
+            for line in f:
+                parts = line.split("#", 1)[0].split()
+                if not parts:
+                    continue          # blank or comment line
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    cols.append(int(i) - (0 if zero_based else 1))
+                    vals.append(float(v))
+                indptr.append(len(cols))
+    return (np.asarray(labels, np.float64),
+            np.asarray(indptr, np.int64),
+            np.asarray(cols, np.int32),
+            np.asarray(vals, np.float64))
 
 
 def read_libsvm(path: str, dim: Optional[int] = None,
                 add_intercept: bool = True,
                 zero_based: bool = False) -> LibSVMData:
     """Parse LibSVM text. Labels in {-1,1} or {0,1} are mapped to {0,1}.
-    If ``add_intercept``, a constant-1 feature is appended at index dim-1."""
+    If ``add_intercept``, a constant-1 feature is appended at index dim-1.
+    Uses the native columnar tokenizer when available; both parsers emit
+    the same raw columnar arrays and share ONE finalize step, so the
+    output is identical either way (``rows`` is a CsrRows view that
+    duck-types the row-list protocol)."""
     import os
     if os.path.isdir(path):
         files = sorted(os.path.join(path, f) for f in os.listdir(path)
                        if not f.startswith("."))
     else:
         files = [path]
-    labels = []
-    rows = []
-    max_idx = -1
-    max_nnz = 0
-    for fp in files:
-        with open(fp) as f:
-            for line in f:
-                parts = line.split()
-                if not parts:
-                    continue
-                labels.append(float(parts[0]))
-                idx = []
-                val = []
-                for tok in parts[1:]:
-                    if tok.startswith("#"):
-                        break
-                    i, v = tok.split(":")
-                    j = int(i) - (0 if zero_based else 1)
-                    idx.append(j)
-                    val.append(float(v))
-                if idx:
-                    max_idx = max(max_idx, max(idx))
-                rows.append((np.asarray(idx, np.int32),
-                             np.asarray(val, np.float64)))
-                max_nnz = max(max_nnz, len(idx))
 
-    y = np.asarray(labels)
+    try:
+        parsed = _parse_libsvm_native(files, zero_based)
+    except MemoryError:
+        raise
+    except ValueError:
+        raise  # malformed input: same contract as the Python parser
+    except Exception:  # noqa: BLE001 — optional fast path, never fatal
+        parsed = None
+    if parsed is None:
+        parsed = _parse_libsvm_python(files, zero_based)
+
+    from photon_tpu.game.dataset import CsrRows
+
+    labels, indptr, cols, vals = parsed
+    if len(cols) and int(cols.min()) < 0:
+        raise ValueError("negative feature index (1-based data parsed "
+                         "with zero_based=True?)")
+    y = labels   # both parsers hand over fresh arrays; remap reallocates
     if set(np.unique(y)) <= {-1.0, 1.0}:
         y = (y + 1.0) / 2.0
-
-    d = dim if dim is not None else max_idx + 1
+    n = len(y)
+    d = dim if dim is not None else (int(cols.max()) + 1 if len(cols) else 0)
     if add_intercept:
-        rows = [(np.append(r[0], d), np.append(r[1], 1.0)) for r in rows]
+        # vectorized append of a constant-1 slot at index d to every row
+        cols = np.insert(cols, indptr[1:], d).astype(np.int32)
+        vals = np.insert(vals, indptr[1:], 1.0)
+        indptr = indptr + np.arange(n + 1, dtype=np.int64)
         d += 1
-        max_nnz += 1
-    return LibSVMData(labels=y, rows=rows, dim=d, max_nnz=max_nnz)
+    max_nnz = int(np.diff(indptr).max()) if n else (1 if add_intercept else 0)
+    return LibSVMData(labels=y, rows=CsrRows(indptr, cols, vals),
+                      dim=d, max_nnz=max_nnz)
 
 
 def to_batch(data: LibSVMData, dtype=np.float32,
              pad_to: Optional[int] = None) -> DataBatch:
     """LibSVM rows -> padded-ELL DataBatch; optionally pad the sample count
     to a multiple (pad rows get weight 0)."""
+    from photon_tpu.game.dataset import CsrRows
+
     n = len(data.rows)
     n_pad = pad_to if pad_to is not None else n
-    rows = list(data.rows) + [(np.zeros(0, np.int32), np.zeros(0))] * (n_pad - n)
-    feats = F.from_rows(rows, data.dim, dtype=dtype, max_nnz=data.max_nnz)
+    if isinstance(data.rows, CsrRows):
+        r = data.rows
+        indptr = r.indptr
+        if n_pad > n:   # pad rows are empty: repeat the final offset
+            indptr = np.concatenate(
+                [indptr, np.full(n_pad - n, indptr[-1], indptr.dtype)])
+        feats = F.from_csr_arrays(indptr, r.cols, r.vals, dtype=dtype,
+                                  max_nnz=data.max_nnz)
+    else:
+        rows = (list(data.rows)
+                + [(np.zeros(0, np.int32), np.zeros(0))] * (n_pad - n))
+        feats = F.from_rows(rows, data.dim, dtype=dtype, max_nnz=data.max_nnz)
     labels = np.zeros(n_pad, dtype=dtype)
     labels[:n] = data.labels
     weights = np.zeros(n_pad, dtype=dtype)

@@ -24,15 +24,15 @@ logger = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SENTINEL_BROKEN = object()
-_avrodec_mod: Any = None
+_mods: dict = {}          # stem -> module | _SENTINEL_BROKEN
 
 
-def _build_extension() -> Optional[str]:
-    """Compile avrodec.c -> _avrodec<ext_suffix>.so next to the source.
+def _build_extension(stem: str) -> Optional[str]:
+    """Compile <stem>.c -> _<stem><ext_suffix>.so next to the source.
     Returns the path, or None when no compiler / unwritable directory."""
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    out = os.path.join(_DIR, f"_avrodec{suffix}")
-    src = os.path.join(_DIR, "avrodec.c")
+    out = os.path.join(_DIR, f"_{stem}{suffix}")
+    src = os.path.join(_DIR, f"{stem}.c")
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
     include = sysconfig.get_paths()["include"]
@@ -46,11 +46,12 @@ def _build_extension() -> Optional[str]:
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if r.returncode != 0:
-            logger.warning("native avrodec build failed:\n%s", r.stderr[-2000:])
+            logger.warning("native %s build failed:\n%s", stem,
+                           r.stderr[-2000:])
             return None
         os.replace(tmp, out)
     except (OSError, subprocess.TimeoutExpired) as e:
-        logger.info("native avrodec build unavailable: %r", e)
+        logger.info("native %s build unavailable: %r", stem, e)
         return None
     finally:
         if os.path.exists(tmp):
@@ -61,29 +62,41 @@ def _build_extension() -> Optional[str]:
     return out
 
 
-def _load():
-    global _avrodec_mod
-    if _avrodec_mod is not None:
-        return None if _avrodec_mod is _SENTINEL_BROKEN else _avrodec_mod
+def _load_ext(stem: str):
+    cached = _mods.get(stem)
+    if cached is not None:
+        return None if cached is _SENTINEL_BROKEN else cached
     if os.environ.get("PHOTON_TPU_NO_NATIVE"):
-        _avrodec_mod = _SENTINEL_BROKEN
+        _mods[stem] = _SENTINEL_BROKEN
         return None
-    path = _build_extension()
+    path = _build_extension(stem)
     if path is None:
-        _avrodec_mod = _SENTINEL_BROKEN
+        _mods[stem] = _SENTINEL_BROKEN
         return None
     try:
         import importlib.util
         spec = importlib.util.spec_from_file_location(
-            "photon_tpu.native._avrodec", path)
+            f"photon_tpu.native._{stem}", path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        _avrodec_mod = mod
+        _mods[stem] = mod
         return mod
     except Exception as e:  # noqa: BLE001 — optional accelerator
-        logger.warning("native avrodec load failed: %r", e)
-        _avrodec_mod = _SENTINEL_BROKEN
+        logger.warning("native %s load failed: %r", stem, e)
+        _mods[stem] = _SENTINEL_BROKEN
         return None
+
+
+def _load():
+    return _load_ext("avrodec")
+
+
+def libsvm_parser():
+    """The native LibSVM tokenizer (libsvmdec.c), or None. Returns a
+    callable ``parse(data: bytes, zero_based: int) -> (labels, indptr,
+    cols, vals)`` raw little-endian buffers (f64 / i64 / i32 / f64)."""
+    mod = _load_ext("libsvmdec")
+    return None if mod is None else mod.parse
 
 
 # -- schema program compiler --------------------------------------------------

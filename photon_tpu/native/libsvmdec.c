@@ -1,0 +1,135 @@
+/* Native LibSVM text parser -> columnar CSR arrays.
+ *
+ * Python-side tokenization of LibSVM lines (data/ingest.read_libsvm)
+ * builds two Python objects per nonzero; this parser emits four flat
+ * buffers (labels f64, indptr i64, cols i32, vals f64) in one pass over
+ * the bytes, zero Python objects per feature. Grammar per line:
+ *     <label> (<index>:<value>)*  [# comment]
+ * Blank lines are skipped; a '#' truncates the line. Indices are
+ * 1-based unless zero_based is nonzero (matching the Python parser).
+ *
+ * parse(data: bytes, zero_based: int)
+ *   -> (labels: bytes, indptr: bytes, cols: bytes, vals: bytes)
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+static PyObject *
+parse(PyObject *self, PyObject *args)
+{
+    Py_buffer buf;
+    int zero_based = 0;
+    if (!PyArg_ParseTuple(args, "y*|i", &buf, &zero_based))
+        return NULL;
+    const char *p = (const char *)buf.buf;
+    const char *end = p + buf.len;
+
+    /* pass 1: count data lines and nonzeros (':' before any '#') */
+    size_t nrows = 0, nnz = 0;
+    int in_comment = 0, has_data = 0;
+    for (const char *q = p; q < end; q++) {
+        char c = *q;
+        if (c == '\n') {
+            if (has_data) nrows++;
+            in_comment = 0;
+            has_data = 0;
+        } else if (!in_comment) {
+            if (c == '#') in_comment = 1;
+            else if (c == ':') nnz++;
+            else if (c != ' ' && c != '\t' && c != '\r') has_data = 1;
+        }
+    }
+    if (has_data) nrows++;
+
+    double  *labels = (double *)malloc(sizeof(double) * (nrows ? nrows : 1));
+    int64_t *indptr = (int64_t *)malloc(sizeof(int64_t) * (nrows + 1));
+    int32_t *cols   = (int32_t *)malloc(sizeof(int32_t) * (nnz ? nnz : 1));
+    double  *vals   = (double *)malloc(sizeof(double) * (nnz ? nnz : 1));
+    if (!labels || !indptr || !cols || !vals) {
+        free(labels); free(indptr); free(cols); free(vals);
+        PyBuffer_Release(&buf);
+        return PyErr_NoMemory();
+    }
+
+    size_t r = 0, k = 0;
+    indptr[0] = 0;
+    const char *q = p;
+    int bad = 0;
+    while (q < end && !bad) {
+        /* find the line span, excluding any comment */
+        const char *eol = memchr(q, '\n', (size_t)(end - q));
+        if (!eol) eol = end;
+        const char *stop = memchr(q, '#', (size_t)(eol - q));
+        if (!stop) stop = eol;
+        /* skip leading whitespace */
+        while (q < stop && (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+        if (q >= stop) { q = eol + 1; continue; }   /* blank/comment line */
+        if (r >= nrows) { bad = 1; break; }
+        /* label */
+        char *next;
+        labels[r] = strtod(q, &next);
+        if (next == q) { bad = 1; break; }
+        q = next;
+        /* index:value pairs */
+        while (q < stop) {
+            while (q < stop && (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+            if (q >= stop) break;
+            long idx = strtol(q, &next, 10);
+            if (next == q || next >= stop || *next != ':') { bad = 1; break; }
+            q = next + 1;
+            /* the value must start immediately: strtod skips leading
+             * whitespace (even newlines past this line's end), which
+             * would silently swallow the next line on "2:\n" input */
+            if (q >= stop || *q == ' ' || *q == '\t' || *q == '\r'
+                || *q == '\n') { bad = 1; break; }
+            double v = strtod(q, &next);
+            if (next == q || next > stop) { bad = 1; break; }
+            q = next;
+            if (k >= nnz) { bad = 1; break; }
+            long j = zero_based ? idx : idx - 1;
+            if (j < 0 || j > INT32_MAX) { bad = 1; break; }
+            cols[k] = (int32_t)j;
+            vals[k] = v;
+            k++;
+        }
+        if (bad) break;
+        r++;
+        indptr[r] = (int64_t)k;
+        q = eol + 1;
+    }
+    PyBuffer_Release(&buf);
+    if (bad || r != nrows) {
+        free(labels); free(indptr); free(cols); free(vals);
+        PyErr_SetString(PyExc_ValueError, "malformed LibSVM input");
+        return NULL;
+    }
+
+    PyObject *out = Py_BuildValue(
+        "(y#y#y#y#)",
+        (const char *)labels, (Py_ssize_t)(sizeof(double) * nrows),
+        (const char *)indptr, (Py_ssize_t)(sizeof(int64_t) * (nrows + 1)),
+        (const char *)cols,   (Py_ssize_t)(sizeof(int32_t) * k),
+        (const char *)vals,   (Py_ssize_t)(sizeof(double) * k));
+    free(labels); free(indptr); free(cols); free(vals);
+    return out;
+}
+
+static PyMethodDef Methods[] = {
+    {"parse", parse, METH_VARARGS,
+     "parse(data, zero_based=0) -> (labels, indptr, cols, vals) buffers"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_libsvmdec", NULL, -1, Methods,
+};
+
+PyMODINIT_FUNC
+PyInit__libsvmdec(void)
+{
+    return PyModule_Create(&moduledef);
+}

@@ -88,3 +88,67 @@ def test_libsvm_roundtrip():
         np.testing.assert_allclose(np.asarray(batch.weights), [1, 1, 1, 0, 0, 0, 0, 0])
     finally:
         os.unlink(path)
+
+
+def test_native_libsvm_parser_parity(tmp_path, monkeypatch):
+    """The C LibSVM tokenizer (native/libsvmdec.c) must be byte-equivalent
+    to the Python parser — labels, dims, ELL materialization — including
+    comments, blank lines, and zero-based indexing; malformed input
+    raises rather than truncating."""
+    import numpy as np
+
+    from photon_tpu import native
+    from photon_tpu.data import ingest
+    from photon_tpu.game.dataset import CsrRows
+
+    if native.libsvm_parser() is None:
+        import pytest
+        pytest.skip("no C compiler in this environment")
+
+    text = (
+        "# leading comment line\n"
+        "1 1:0.5 3:-2.25 7:1e-3\n"
+        "\n"
+        "-1 2:4 # trailing comment 9:9\n"
+        "-1\n"                       # empty row (label only)
+        "1 10:0.125\n"               # no trailing newline on purpose
+    )
+    p = tmp_path / "tiny.svm"
+    p.write_text(text)
+
+    def read_both(**kw):
+        nat = ingest.read_libsvm(str(p), **kw)
+        assert isinstance(nat.rows, CsrRows)
+        monkeypatch.setenv("PHOTON_TPU_NO_NATIVE", "1")
+        native._mods.clear()
+        py = ingest.read_libsvm(str(p), **kw)
+        monkeypatch.delenv("PHOTON_TPU_NO_NATIVE")
+        native._mods.clear()
+        return nat, py
+
+    for kw in ({}, {"add_intercept": False}, {"zero_based": True},
+               {"dim": 32}):
+        nat, py = read_both(**kw)
+        assert (nat.dim, nat.max_nnz) == (py.dim, py.max_nnz), kw
+        np.testing.assert_array_equal(nat.labels, py.labels)
+        bn, bp = ingest.to_batch(nat), ingest.to_batch(py)
+        np.testing.assert_array_equal(np.asarray(bn.features.indices),
+                                      np.asarray(bp.features.indices))
+        np.testing.assert_array_equal(np.asarray(bn.features.values),
+                                      np.asarray(bp.features.values))
+
+    # malformed input raises ValueError from BOTH parsers (the native
+    # error propagates; it does not fall back)
+    import pytest
+    for content in ("1 nocolon\n",
+                    "1 2:\n5 3:1\n"):   # empty value must not swallow
+        bad = tmp_path / "bad.svm"      # the next line (strtod skips
+        bad.write_text(content)         # whitespace incl. newlines)
+        with pytest.raises(ValueError):
+            ingest.read_libsvm(str(bad))
+        monkeypatch.setenv("PHOTON_TPU_NO_NATIVE", "1")
+        native._mods.clear()
+        with pytest.raises(ValueError):
+            ingest.read_libsvm(str(bad))
+        monkeypatch.delenv("PHOTON_TPU_NO_NATIVE")
+        native._mods.clear()
