@@ -345,16 +345,22 @@ def sparse_onehot_block(ids, feats, n_entities):
 
 
 def glmix_frame(Xg, re_blocks, y, GameDataFrame, FeatureShard):
-    """re_blocks: {tag: (ids, feats)} — dense per-entity feature shards."""
+    """re_blocks: {tag: (ids, feats)} — dense per-entity feature shards,
+    handed over as columnar CsrRows (zero per-row Python objects)."""
+    from photon_tpu.game.dataset import CsrRows
+
+    n = len(y)
     shards = {"global": FeatureShard(Xg, Xg.shape[1])}
     id_tags = {}
     for tag, (ids, feats) in re_blocks.items():
+        assert feats.shape[0] == n, (tag, feats.shape, n)
         d = feats.shape[1]
-        idx = np.arange(d, dtype=np.int32)
         shards[f"per_{tag}"] = FeatureShard(
-            [(idx, feats[i]) for i in range(len(y))], d)
+            CsrRows(np.arange(n + 1, dtype=np.int64) * d,
+                    np.tile(np.arange(d, dtype=np.int32), n),
+                    feats.reshape(-1).astype(np.float64)), d)
         id_tags[tag] = [str(u) for u in ids]
-    return GameDataFrame(num_samples=len(y), response=y,
+    return GameDataFrame(num_samples=n, response=y,
                          feature_shards=shards, id_tags=id_tags)
 
 
