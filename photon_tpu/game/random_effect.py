@@ -135,12 +135,20 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
 
 def _csr_of(rows) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Sparse rows -> (indptr [n+1], cols, vals); CSR-form rows from the
-    native columnar ingest pass straight through."""
+    native columnar ingest pass straight through; a dense [n, d] matrix
+    is converted (vectorized) so dense feature shards work for random
+    effects too."""
     from photon_tpu.game.dataset import CsrRows
 
     if isinstance(rows, CsrRows):
         return (rows.indptr, np.asarray(rows.cols, np.int64),
                 np.asarray(rows.vals, np.float64))
+    if isinstance(rows, np.ndarray):
+        dense = np.asarray(rows, np.float64)
+        r, cols = np.nonzero(dense)
+        indptr = np.concatenate(
+            [[0], np.cumsum(np.bincount(r, minlength=dense.shape[0]))])
+        return indptr.astype(np.int64), cols.astype(np.int64), dense[r, cols]
     nnz = np.fromiter((len(r[0]) for r in rows), np.int64, len(rows))
     indptr = np.concatenate([[0], np.cumsum(nnz)])
     if len(rows):
@@ -169,7 +177,8 @@ def build_random_effect_dataset(
     passive split — no per-sample Python loops."""
     re_type = config.random_effect_type
     shard = df.feature_shards[config.feature_shard_id]
-    assert not shard.is_dense, "random-effect shards use sparse rows"
+    # sparse row lists, columnar CsrRows, and dense [n, d] matrices all
+    # funnel through _csr_of into the same columnar pipeline
     shard = _maybe_random_project(shard, config)
     n = df.num_samples
     D = shard.dim
@@ -390,10 +399,16 @@ def _maybe_random_project(shard, config: RandomEffectDataConfiguration):
     rp = config.random_projection(shard.dim)
     if rp is None:
         return shard
-    dense = rp.project_rows(shard.rows)
+    dense = (rp.project_dense(np.asarray(shard.rows, np.float64))
+             if shard.is_dense else rp.project_rows(shard.rows))
     pd = rp.projected_dim
-    idx = np.arange(pd, dtype=np.int32)
-    rows = [(idx, dense[i]) for i in range(len(dense))]
+    n = len(dense)
+    # columnar handover (every projected dim is observed for every row):
+    # no per-row Python tuples — _csr_of passes CsrRows straight through
+    from photon_tpu.game.dataset import CsrRows
+    rows = CsrRows(np.arange(n + 1, dtype=np.int64) * pd,
+                   np.tile(np.arange(pd, dtype=np.int32), n),
+                   np.asarray(dense, np.float64).reshape(-1))
     return FeatureShard(rows, pd)
 
 

@@ -369,3 +369,52 @@ def test_direct_solver_game_parity():
                           max_iterations=100, tolerance=1e-13)
     np.testing.assert_allclose(f_direct, f_tron, rtol=1e-6, atol=1e-8)
     np.testing.assert_allclose(re_direct, re_tron, rtol=1e-6, atol=1e-8)
+
+
+def test_random_effect_accepts_dense_shard():
+    """A dense [n, d] matrix as a random-effect feature shard trains the
+    same model as the equivalent sparse row list (previously crashed in
+    _csr_of with an obscure TypeError)."""
+    import numpy as np
+
+    from photon_tpu.estimators.game_estimator import (
+        CoordinateConfiguration,
+        GameEstimator,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+    from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(11)
+    n, d_u, users = 200, 3, 5
+    Xu = rng.normal(size=(n, d_u))
+    Xu[rng.random((n, d_u)) < 0.3] = 0.0      # real zeros: sparse != dense trap
+    uid = rng.integers(0, users, size=n)
+    y = np.einsum("nk,nk->n", Xu, rng.normal(size=(users, d_u))[uid])
+    iu = np.arange(d_u, dtype=np.int32)
+
+    def fit(shard):
+        df = GameDataFrame(num_samples=n, response=y,
+                           feature_shards={"u": shard},
+                           id_tags={"userId": [f"u{v}" for v in uid]})
+        opt = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(max_iterations=50, tolerance=1e-10),
+            regularization=L2Regularization, regularization_weight=0.5)
+        est = GameEstimator(
+            TaskType.LINEAR_REGRESSION,
+            {"per_user": CoordinateConfiguration(
+                RandomEffectDataConfiguration("userId", "u"), opt)},
+            update_sequence=["per_user"], num_iterations=1,
+            dtype=np.float64)
+        res = est.fit(df)
+        return np.asarray(res[-1].model["per_user"].coefficients)
+
+    dense = fit(FeatureShard(Xu, d_u))
+    sparse = fit(FeatureShard(
+        [(iu[Xu[i] != 0], Xu[i][Xu[i] != 0]) for i in range(n)], d_u))
+    np.testing.assert_allclose(dense, sparse, rtol=1e-8, atol=1e-10)
