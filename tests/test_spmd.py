@@ -457,3 +457,52 @@ def test_create_pod_mesh_layout(devices8):
     np.testing.assert_allclose(np.asarray(m_pod.coefficients.means),
                                np.asarray(m_flat.coefficients.means),
                                rtol=1e-8, atol=1e-10)
+
+
+def test_model_axis_explicit_hessian_tron_parity():
+    """TRON with the EXPLICIT [d, d] Gauss-Newton Hessian (the TPU-default
+    gate) under a model-sharded theta: GSPMD must partition the Gram
+    build/CG identically to the data-parallel solve. This is the
+    combination the round-4 TRON switch makes the on-chip default for
+    dense fixed effects."""
+    import numpy as np
+
+    from photon_tpu.data.dataset import DataBatch
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        GlmOptimizationProblem,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import OptimizerType, TaskType
+
+    rng = np.random.default_rng(9)
+    n, d = 512, 16
+    X = rng.normal(size=(n, d))
+    y = (rng.random(n) < 1 / (1 + np.exp(-(X @ rng.normal(size=d))))
+         ).astype(np.float64)
+
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(optimizer_type=OptimizerType.TRON,
+                                  max_iterations=60, tolerance=1e-11,
+                                  explicit_hessian=True),
+        regularization=L2Regularization, regularization_weight=0.7)
+
+    def solve(mesh, model_par):
+        prob = GlmOptimizationProblem(TaskType.LOGISTIC_REGRESSION, cfg)
+        batch = DataBatch(jnp.asarray(X), jnp.asarray(y))
+        if model_par:
+            batch = M.shard_features_model_parallel(batch, mesh)
+            init = M.shard_coef_model_parallel(
+                jnp.zeros((d,), jnp.float64), mesh)
+        else:
+            batch = M.shard_batch(batch, mesh)
+            init = M.replicate(jnp.zeros((d,), jnp.float64), mesh)
+        model, _ = prob.run(batch, initial=init, dim=d, dtype=jnp.float64)
+        return np.asarray(model.coefficients.means)
+
+    mesh_dp = M.create_mesh(8, (M.DATA_AXIS, M.MODEL_AXIS), (8, 1))
+    mesh_tp = M.create_mesh(8, (M.DATA_AXIS, M.MODEL_AXIS), (4, 2))
+    c_dp = solve(mesh_dp, model_par=False)
+    c_tp = solve(mesh_tp, model_par=True)
+    np.testing.assert_allclose(c_tp, c_dp, rtol=1e-8, atol=1e-10)
