@@ -465,16 +465,8 @@ def test_model_axis_explicit_hessian_tron_parity():
     build/CG identically to the data-parallel solve. This is the
     combination the round-4 TRON switch makes the on-chip default for
     dense fixed effects."""
-    import numpy as np
-
-    from photon_tpu.data.dataset import DataBatch
     from photon_tpu.function.objective import L2Regularization
-    from photon_tpu.optim.problem import (
-        GLMOptimizationConfiguration,
-        GlmOptimizationProblem,
-        OptimizerConfig,
-    )
-    from photon_tpu.types import OptimizerType, TaskType
+    from photon_tpu.types import OptimizerType
 
     rng = np.random.default_rng(9)
     n, d = 512, 16
