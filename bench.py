@@ -354,11 +354,8 @@ def glmix_frame(Xg, re_blocks, y, GameDataFrame, FeatureShard):
     id_tags = {}
     for tag, (ids, feats) in re_blocks.items():
         assert feats.shape[0] == n, (tag, feats.shape, n)
-        d = feats.shape[1]
-        shards[f"per_{tag}"] = FeatureShard(
-            CsrRows(np.arange(n + 1, dtype=np.int64) * d,
-                    np.tile(np.arange(d, dtype=np.int32), n),
-                    feats.reshape(-1).astype(np.float64)), d)
+        shards[f"per_{tag}"] = FeatureShard(CsrRows.from_dense(feats),
+                                            feats.shape[1])
         id_tags[tag] = [str(u) for u in ids]
     return GameDataFrame(num_samples=n, response=y,
                          feature_shards=shards, id_tags=id_tags)

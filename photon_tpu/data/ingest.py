@@ -100,10 +100,8 @@ def read_libsvm(path: str, dim: Optional[int] = None,
 
     try:
         parsed = _parse_libsvm_native(files, zero_based)
-    except MemoryError:
-        raise
-    except ValueError:
-        raise  # malformed input: same contract as the Python parser
+    except (MemoryError, ValueError):
+        raise  # malformed input / OOM: same contract as the Python parser
     except Exception:  # noqa: BLE001 — optional fast path, never fatal
         parsed = None
     if parsed is None:

@@ -54,6 +54,15 @@ class CsrRows:
         s, e = self.indptr[i], self.indptr[i + 1]
         return self.cols[s:e], self.vals[s:e]
 
+    @staticmethod
+    def from_dense(x: np.ndarray) -> "CsrRows":
+        """Dense [n, d] -> fully-populated CsrRows (every slot observed,
+        explicit zeros kept): the columnar handover for dense blocks."""
+        n, d = x.shape
+        return CsrRows(np.arange(n + 1, dtype=np.int64) * d,
+                       np.tile(np.arange(d, dtype=np.int32), n),
+                       np.asarray(x, np.float64).reshape(-1))
+
     def __iter__(self):
         for i in range(len(self)):
             yield self[i]

@@ -401,15 +401,11 @@ def _maybe_random_project(shard, config: RandomEffectDataConfiguration):
         return shard
     dense = (rp.project_dense(np.asarray(shard.rows, np.float64))
              if shard.is_dense else rp.project_rows(shard.rows))
-    pd = rp.projected_dim
-    n = len(dense)
+    from photon_tpu.game.dataset import CsrRows
+
     # columnar handover (every projected dim is observed for every row):
     # no per-row Python tuples — _csr_of passes CsrRows straight through
-    from photon_tpu.game.dataset import CsrRows
-    rows = CsrRows(np.arange(n + 1, dtype=np.int64) * pd,
-                   np.tile(np.arange(pd, dtype=np.int32), n),
-                   np.asarray(dense, np.float64).reshape(-1))
-    return FeatureShard(rows, pd)
+    return FeatureShard(CsrRows.from_dense(dense), rp.projected_dim)
 
 
 def _pearson_scores_vectorized(uniq, pair, keep_nz, vals, s_nz, entity_idx,

@@ -29,6 +29,34 @@ from photon_tpu.optim.base import ConvergenceReason, SolverResult
 Array = jax.Array
 
 
+def _newton_step(x0: Array, f0: Array, g: Array, h: Array) -> SolverResult:
+    """One exact Newton step on a quadratic with value f0 / gradient g /
+    Hessian h at x0. The solution-point value and gradient follow from
+    already-materialized quantities — no second data pass:
+    g(x) = g + H step;  f(x) = f0 + g.step + 0.5 step.H.step.
+
+    Singular/degenerate curvature (rank-deficient features at lambda=0,
+    or an empty vmap lane) keeps the start point and SAYS SO — a failed
+    entity must not read as converged in the per-entity trackers."""
+    chol = jax.scipy.linalg.cho_factor(h)
+    step = -jax.scipy.linalg.cho_solve(chol, g)
+    ok = jnp.all(jnp.isfinite(step))
+    step = jnp.where(ok, step, 0.0)
+    hs = h @ step
+    return SolverResult(
+        coef=x0 + step,
+        value=f0 + jnp.dot(g, step) + 0.5 * jnp.dot(step, hs),
+        gradient=g + hs,
+        iterations=jnp.asarray(1, jnp.int32),
+        reason=jnp.where(
+            ok,
+            jnp.asarray(ConvergenceReason.GRADIENT_CONVERGED, jnp.int32),
+            jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32)),
+        num_fun_evals=jnp.asarray(1, jnp.int32),
+        loss_history=None, gnorm_history=None,
+    )
+
+
 def minimize_path(value_and_grad_noreg, hessian_matrix_noreg, x0: Array,
                   lambdas: Array) -> SolverResult:
     """Solve the ENTIRE L2 regularization path in one data pass.
@@ -47,26 +75,9 @@ def minimize_path(value_and_grad_noreg, hessian_matrix_noreg, x0: Array,
     eye = jnp.eye(x0.shape[0], dtype=x0.dtype)
 
     def one(lam):
-        h = gram + lam * eye
-        g = g0 + lam * x0                       # full-objective gradient
-        chol = jax.scipy.linalg.cho_factor(h)
-        step = -jax.scipy.linalg.cho_solve(chol, g)
-        ok = jnp.all(jnp.isfinite(step))
-        step_ok = jnp.where(ok, step, 0.0)
-        x = x0 + step_ok
-        hs = h @ step_ok
-        f_l = (f0 + 0.5 * lam * jnp.dot(x0, x0)
-               + jnp.dot(g, step_ok) + 0.5 * jnp.dot(step_ok, hs))
-        return SolverResult(
-            coef=x, value=f_l, gradient=g + hs,
-            iterations=jnp.asarray(1, jnp.int32),
-            reason=jnp.where(
-                ok,
-                jnp.asarray(ConvergenceReason.GRADIENT_CONVERGED, jnp.int32),
-                jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32)),
-            num_fun_evals=jnp.asarray(1, jnp.int32),
-            loss_history=None, gnorm_history=None,
-        )
+        # full-objective value/gradient at x0 for this lambda
+        return _newton_step(x0, f0 + 0.5 * lam * jnp.dot(x0, x0),
+                            g0 + lam * x0, gram + lam * eye)
 
     return jax.vmap(one)(lambdas)
 
@@ -75,28 +86,4 @@ def minimize(value_and_grad, hessian_matrix, x0: Array) -> SolverResult:
     """``value_and_grad(x) -> (f, g)``; ``hessian_matrix(x) -> [d, d]``
     constant in ``x`` for a quadratic objective (evaluated at ``x0``)."""
     f0, g0 = value_and_grad(x0)
-    h = hessian_matrix(x0)
-    chol = jax.scipy.linalg.cho_factor(h)
-    step = -jax.scipy.linalg.cho_solve(chol, g0)
-    # singular/degenerate curvature (rank-deficient features at lambda=0,
-    # or an empty vmap lane): keep the start point and SAY SO — a failed
-    # entity must not read as converged in the per-entity trackers
-    ok = jnp.all(jnp.isfinite(step))
-    step = jnp.where(ok, step, 0.0)
-    x = x0 + step
-    # the objective is quadratic, so the solution-point value/gradient
-    # follow from already-materialized quantities — no second data pass:
-    #   g(x) = g0 + H step;  f(x) = f0 + g0.step + 0.5 step.H.step
-    hs = h @ step
-    g = g0 + hs
-    f = f0 + jnp.dot(g0, step) + 0.5 * jnp.dot(step, hs)
-    return SolverResult(
-        coef=x, value=f, gradient=g,
-        iterations=jnp.asarray(1, jnp.int32),
-        reason=jnp.where(
-            ok,
-            jnp.asarray(ConvergenceReason.GRADIENT_CONVERGED, jnp.int32),
-            jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32)),
-        num_fun_evals=jnp.asarray(1, jnp.int32),
-        loss_history=None, gnorm_history=None,
-    )
+    return _newton_step(x0, f0, g0, hessian_matrix(x0))
