@@ -248,3 +248,43 @@ def test_validators_reject_bad_data(tmp_path):
     # disabled mode never raises
     validate_dataframe(df, TaskType.LOGISTIC_REGRESSION,
                        DataValidationType.VALIDATE_DISABLED)
+
+
+def test_legacy_driver_direct_lambda_path(tmp_path):
+    """The legacy driver's lambda sweep with optimizer=DIRECT runs the
+    shared-Gram path (optim/direct.minimize_path) end-to-end on the
+    reference's linear-regression Avro fixture, and matches a TRON sweep
+    model-for-model."""
+    import shutil
+
+    from photon_tpu.cli import legacy
+
+    src = ("/root/reference/photon-client/src/integTest/resources/"
+           "DriverIntegTest/input/linear_regression_train.avro")
+    if not os.path.isfile(src):
+        import pytest
+        pytest.skip("reference fixture not mounted")
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    shutil.copy(src, data_dir / "train.avro")
+
+    def run(opt, out_name):
+        out = str(tmp_path / out_name)
+        legacy.main([
+            "--training-data-directory", str(data_dir),
+            "--validating-data-directory", str(data_dir),
+            "--output-directory", out,
+            "--task", "LINEAR_REGRESSION",
+            "--optimizer", opt,
+            "--regularization-weights", "0.1,1,10",
+        ])
+        _, models = read_avro(os.path.join(out, "models.avro"))
+        return models
+
+    m_direct = run("DIRECT", "out_direct")
+    m_tron = run("TRON", "out_tron")
+    assert len(m_direct) == 3
+    for md, mt in zip(m_direct, m_tron):
+        cd = np.asarray([x["value"] for x in md["means"]])
+        ct = np.asarray([x["value"] for x in mt["means"]])
+        np.testing.assert_allclose(cd, ct, rtol=1e-3, atol=1e-5)
