@@ -274,6 +274,34 @@ class RandomEffectCoordinate:
                 jnp.asarray(islot))
 
     @functools.cached_property
+    def _dense_local_blocks(self) -> Tuple[bool, ...]:
+        """Per-block static flag: the ELL slots are exactly the local
+        feature space (every nonzero sits at slot == its local index and
+        the ELL width equals the projected dim), so the block's per-entity
+        solves can treat values as a DENSE [S, K] matrix — margins/Gram/
+        gradient become plain dot_generals (MXU) instead of gather/scatter
+        kernels. Common case: per-entity feature vectors observed in full
+        (the MovieLens-style GLMix workload). Computed once from the host
+        copy at solve-build time; trace-time static."""
+        import numpy as np
+
+        D = self.dataset.projected_dim
+        flags = []
+        for blk in self.dataset.blocks:
+            k = blk.features.values.shape[-1]
+            if k != D or not getattr(blk.features.indices,
+                                     "is_fully_addressable", True):
+                # multi-host entity sharding: the host copy isn't
+                # reachable — skip the optimization, never crash
+                flags.append(False)
+                continue
+            idx = np.asarray(blk.features.indices)
+            val = np.asarray(blk.features.values)
+            slot = np.broadcast_to(np.arange(k, dtype=idx.dtype), idx.shape)
+            flags.append(bool(np.all((val == 0) | (idx == slot))))
+        return tuple(flags)
+
+    @functools.cached_property
     def _solve_fn(self):
         obj = self.objective
         opt = self.config.optimizer
@@ -282,16 +310,16 @@ class RandomEffectCoordinate:
         if opt_type == OptimizerType.DIRECT:
             from photon_tpu.optim.problem import _validate_direct
             _validate_direct(self.task, opt, self.config.regularization)
+        dense_flags = self._dense_local_blocks
         has_norm = self._norm_local is not None
         has_shifts = has_norm and self._norm_local[1] is not None
 
         def build():
             from photon_tpu.ops.normalization import NormalizationContext
 
-            def solve_one(feat_idx, feat_val, labels, offsets, weights, x0,
-                          l2, l1, f_row=None, s_row=None, islot=None):
-                batch = DataBatch(F.SparseFeatures(feat_idx, feat_val),
-                                  labels, offsets, weights)
+            def solve_core(feats, labels, offsets, weights, x0,
+                           l2, l1, f_row=None, s_row=None, islot=None):
+                batch = DataBatch(feats, labels, offsets, weights)
                 hyper = Hyper(l2_weight=l2)
                 if f_row is not None:
                     # per-entity transformed space (NormalizationContext
@@ -346,6 +374,14 @@ class RandomEffectCoordinate:
                         coef, islot if s_row is not None else None)
                 return coef, r.iterations, r.reason
 
+            def solve_sparse(feat_idx, feat_val, *rest):
+                return solve_core(F.SparseFeatures(feat_idx, feat_val), *rest)
+
+            def solve_dense(feat_val, *rest):
+                # dense-local block: ELL slot == local index everywhere,
+                # so values ARE the entity's dense [S, K] design matrix
+                return solve_core(feat_val, *rest)
+
             # the dataset enters as a pytree argument, never a closure (a
             # closed-over array would be baked into the HLO as a constant);
             # the Python loop over size buckets unrolls into one program
@@ -360,7 +396,7 @@ class RandomEffectCoordinate:
                 # per-entity solver stats (-1 = entity never trained)
                 iters = jnp.full((E,), -1, jnp.int32)
                 reasons = jnp.full((E,), -1, jnp.int32)
-                for blk in ds.blocks:
+                for blk, dense in zip(ds.blocks, dense_flags):
                     offsets = blk.offsets
                     if residual_flat is not None:
                         # gather residuals by flat row; pad rows -> fill 0
@@ -368,9 +404,16 @@ class RandomEffectCoordinate:
                             mode="fill", fill_value=0.0)
                         offsets = offsets + res
                     x0 = coef0.at[blk.entity_rows].get(mode="fill", fill_value=0.0)
-                    args = [blk.features.indices, blk.features.values,
-                            blk.labels, offsets, blk.weights, x0, l2, l1]
-                    axes = [0, 0, 0, 0, 0, 0, None, None]
+                    if dense:
+                        fn = solve_dense
+                        args = [blk.features.values,
+                                blk.labels, offsets, blk.weights, x0, l2, l1]
+                        axes = [0, 0, 0, 0, 0, None, None]
+                    else:
+                        fn = solve_sparse
+                        args = [blk.features.indices, blk.features.values,
+                                blk.labels, offsets, blk.weights, x0, l2, l1]
+                        axes = [0, 0, 0, 0, 0, 0, None, None]
                     if norm_f is not None:
                         args.append(norm_f.at[blk.entity_rows].get(
                             mode="fill", fill_value=1.0))
@@ -382,7 +425,7 @@ class RandomEffectCoordinate:
                                 mode="fill", fill_value=-1))
                             axes.extend([0, 0])
                     solved, it_b, reason_b = jax.vmap(
-                        solve_one, in_axes=tuple(axes))(*args)
+                        fn, in_axes=tuple(axes))(*args)
                     out = out.at[blk.entity_rows].set(solved, mode="drop")
                     iters = iters.at[blk.entity_rows].set(it_b, mode="drop")
                     reasons = reasons.at[blk.entity_rows].set(reason_b, mode="drop")
@@ -391,7 +434,7 @@ class RandomEffectCoordinate:
             return solve_all
 
         key = ("re_solve", self.task, solver_cache_key(opt),
-               has_norm, has_shifts)
+               has_norm, has_shifts, dense_flags)
         return jitcache.get_or_build(key, build)
 
     def update_model(

@@ -40,7 +40,18 @@ _TILE_N = 1024
 
 
 def _supported(x, norm) -> bool:
-    """Dense 2D f32 features, identity normalization."""
+    """Dense 2D f32 features, identity normalization, NOT under vmap —
+    the kernel's sequential-grid accumulation (init on program_id 0,
+    += into a revisited output block) assumes it owns the whole grid,
+    which a batching transform breaks (the random-effect path vmaps the
+    objective over dense-local entity blocks)."""
+    try:
+        from jax.interpreters.batching import BatchTracer
+        if isinstance(x, BatchTracer):
+            return False
+    except ImportError:  # pragma: no cover — jax internals moved
+        if type(x).__name__ == "BatchTracer":
+            return False
     return (isinstance(x, jax.Array) and x.ndim == 2
             and x.dtype == jnp.float32 and norm.is_identity)
 

@@ -122,3 +122,56 @@ def test_flag_solve_parity(problem, monkeypatch):
     c1 = solve()
     jitcache.clear()
     np.testing.assert_allclose(c1, c0, rtol=5e-4, atol=5e-5)
+
+
+def test_flag_does_not_break_vmapped_re_solves(monkeypatch):
+    """PHOTON_TPU_PALLAS_GLM=1 must NOT route vmapped per-entity
+    objectives (dense-local random-effect blocks) through the kernel —
+    its sequential-grid accumulation is not vmap-safe. The solve must
+    produce identical results with the flag on and off."""
+    from photon_tpu.estimators.game_estimator import (
+        CoordinateConfiguration,
+        GameEstimator,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.dataset import CsrRows, FeatureShard, GameDataFrame
+    from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+    from photon_tpu.utils import jitcache
+
+    rng = np.random.default_rng(2)
+    n, d_u, users = 300, 4, 6
+    Xu = rng.normal(size=(n, d_u)).astype(np.float32)
+    uid = rng.integers(0, users, size=n)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    df = GameDataFrame(
+        num_samples=n, response=y,
+        feature_shards={"u": FeatureShard(CsrRows.from_dense(Xu), d_u)},
+        id_tags={"userId": [f"u{v}" for v in uid]})
+
+    def fit():
+        jitcache.clear()
+        opt = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-8),
+            regularization=L2Regularization, regularization_weight=0.5)
+        est = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            {"per_user": CoordinateConfiguration(
+                RandomEffectDataConfiguration("userId", "u"), opt)},
+            update_sequence=["per_user"], num_iterations=1,
+            dtype=jnp.float32)
+        res = est.fit(df)
+        # the dense-local fast path must actually be active
+        assert all(est._coordinates["per_user"]._dense_local_blocks)
+        return np.asarray(res[-1].model["per_user"].coefficients)
+
+    c_off = fit()
+    monkeypatch.setenv("PHOTON_TPU_PALLAS_GLM", "1")
+    c_on = fit()
+    jitcache.clear()
+    np.testing.assert_allclose(c_on, c_off, rtol=1e-6, atol=1e-7)
+    assert np.all(np.isfinite(c_on))
