@@ -543,28 +543,35 @@ class RandomEffectCoordinate:
     @functools.cached_property
     def _score_fn(self):
         n = self.n
+        dense_flags = self._dense_local_blocks
 
         def build():
-            return jax.jit(_re_score_builder(n))
+            return jax.jit(_re_score_builder(n, dense_flags))
 
-        return jitcache.get_or_build(("re_score", n), build)
+        return jitcache.get_or_build(("re_score", n, dense_flags), build)
 
     def score(self, model: RandomEffectModel) -> Array:
         return self._score_fn(self.dataset,
                               self._pad_entity_rows(model.coefficients))
 
 
-def _re_score_builder(n: int):
+def _re_score_builder(n: int, dense_flags=()):
     def score(ds: RandomEffectDataset, coef_block: Array) -> Array:
         flat = jnp.zeros((n,), coef_block.dtype)
+        flags = (dense_flags if len(dense_flags) == len(ds.blocks)
+                 else (False,) * len(ds.blocks))
         # active blocks: per-entity margins, scattered to flat rows
-        for blk in ds.blocks:
+        for blk, dense in zip(ds.blocks, flags):
             rows = coef_block.at[blk.entity_rows].get(mode="fill", fill_value=0.0)
-            margins = jnp.sum(
-                blk.features.values
-                * jax.vmap(lambda c, i: c[i])(rows, blk.features.indices),
-                axis=-1,
-            )
+            if dense:
+                # dense-local block: one batched [S, K] x [K] contraction
+                margins = jnp.einsum("esk,ek->es", blk.features.values, rows)
+            else:
+                margins = jnp.sum(
+                    blk.features.values
+                    * jax.vmap(lambda c, i: c[i])(rows, blk.features.indices),
+                    axis=-1,
+                )
             flat = flat.at[blk.sample_rows.ravel()].add(
                 margins.ravel(), mode="drop")
         # passive: gather entity coef rows (out-of-range entity -> 0)

@@ -420,3 +420,25 @@ def test_random_effect_accepts_dense_shard():
     sparse = fit(FeatureShard(
         [(iu[Xu[i] != 0], Xu[i][Xu[i] != 0]) for i in range(n)], d_u))
     np.testing.assert_allclose(dense, sparse, rtol=1e-8, atol=1e-10)
+
+
+def test_dense_local_score_matches_sparse_path(glmix):
+    """The dense-local einsum score branch must equal the gather/scatter
+    branch on the same dataset (guards the einsum subscripts directly,
+    not just via downstream AUC thresholds)."""
+    import numpy as np
+
+    from photon_tpu.game.coordinate import _re_score_builder
+
+    train, val, _ = glmix
+    est = glmix_estimator()
+    result = est.fit(train, val)[0]
+    coord = est._coordinates["per-user"]
+    flags = coord._dense_local_blocks
+    assert any(flags)   # user_feats rows are observed in full
+    coefs = coord._pad_entity_rows(result.model["per-user"].coefficients)
+    s_dense = _re_score_builder(coord.n, flags)(coord.dataset, coefs)
+    s_sparse = _re_score_builder(coord.n, (False,) * len(flags))(
+        coord.dataset, coefs)
+    np.testing.assert_allclose(np.asarray(s_dense), np.asarray(s_sparse),
+                               rtol=1e-6, atol=1e-8)
