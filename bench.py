@@ -76,7 +76,9 @@ def summary_record():
     per_config = {
         r["metric"]: {k: r[k] for k in
                       ("value", "vs_baseline", "mfu", "wallclock_warm_s",
-                       "wallclock_cold_s", "parity", "auc", "baseline_auc",
+                       "wallclock_cold_s", "baseline_wallclock_s",
+                       "achieved_bandwidth_gb_s", "hbm_fraction",
+                       "parity", "auc", "baseline_auc",
                        "rmse", "baseline_rmse") if k in r}
         for r in ok
     }
@@ -94,15 +96,23 @@ def summary_record():
         "configs_skipped": [r["metric"] for r in _RESULTS if r.get("skipped")],
         "parity_all": all(r.get("parity", True) for r in ok) if ok else False,
         "wallclock_total_s": round(time.time() - _T0, 1),
+        "loadavg_1m": _loadavg(),
     }
     if head is not None:
         rec.update({k: head[k] for k in
                     ("value", "vs_baseline", "mfu", "auc", "baseline_auc")
                     if k in head})
     if _STATE["tpu_unavailable"]:
-        # embed the diagnostic trail so a CPU fallback is self-explaining
-        rec["plugin_diagnostics"] = _STATE.get("plugin_diagnostics")
-        rec["probe_log_tail"] = _STATE.get("probe_log_tail")
+        # embed a BOUNDED diagnostic trail so a CPU fallback is
+        # self-explaining without bloating the record: round-4's uncapped
+        # tail pushed the per-config numbers outside the driver's parse
+        # window (BENCH_r04.json came back "parsed": null). Full logs stay
+        # in bench_probe.err on disk; the record carries <=500 chars.
+        diag = _STATE.get("plugin_diagnostics") or {}
+        rec["plugin_diagnostics"] = {
+            k: v for k, v in diag.items() if k != "TPU_ENV"}
+        tail = _STATE.get("probe_log_tail") or ""
+        rec["probe_log_tail"] = tail[-500:]
         import glob as _glob
         here = os.path.dirname(os.path.abspath(__file__))
         evidence = sorted(_glob.glob(os.path.join(here, "BENCH_TPU_LIVE_r*.md")))[-1:]
@@ -128,7 +138,17 @@ def finish(rc_reason=None):
         _DONE.set()
         if rc_reason:
             _STATE["error"] = rc_reason
-        emit(summary_record())
+        rec = summary_record()
+        # belt-and-suspenders: the summary also lands on disk, so even a
+        # driver that truncates stdout finds the full record
+        try:
+            here = os.path.dirname(os.path.abspath(__file__))
+            with open(os.path.join(here, "BENCH_SUMMARY.json"), "w") as f:
+                json.dump(rec, f, indent=1)
+                f.write("\n")
+        except OSError as e:  # pragma: no cover - disk full etc.
+            log(f"BENCH_SUMMARY.json write failed: {e!r}")
+        emit(rec)
 
 
 def start_watchdog(deadline_s: float):
@@ -371,6 +391,64 @@ def _mfu(model_flops: float, seconds: float):
     return round(model_flops / seconds / peak, 8), peak
 
 
+def _loadavg():
+    try:
+        return round(os.getloadavg()[0], 2)
+    except OSError:  # pragma: no cover - non-POSIX
+        return None
+
+
+def timed_median(fn, k=3, budget_s=120.0):
+    """Median-of-k oracle timing: one-shot wall-clocks on this shared host
+    have swung ~3x between captures (multi-RE oracle: 35.6 s vs 113.0 s),
+    so every oracle is now run up to k times and the artifact records the
+    median AND the individual runs. Stops early when another run would
+    blow the budget — a loaded host degrades to fewer samples, never to a
+    stalled bench. Returns (median_seconds, last_result, times)."""
+    times, out = [], None
+    for _ in range(k):
+        t0 = time.perf_counter()
+        out = fn()
+        times.append(time.perf_counter() - t0)
+        if sum(times) + times[-1] > budget_s:
+            break
+    return float(np.median(times)), out, [round(t, 3) for t in times]
+
+
+def _hbm_peak(low_kind: str):
+    """HBM bandwidth peak by device kind (public figures)."""
+    if "v6" in low_kind:
+        return 1640e9
+    if "v5p" in low_kind:
+        return 2765e9
+    if "v5" in low_kind:          # v5e / "TPU v5 lite"
+        return 819e9
+    if "v4" in low_kind:
+        return 1228e9
+    return None
+
+
+def bandwidth_fields(model_flops: float, seconds: float):
+    """Per-config achieved bandwidth: GLM aggregator passes are
+    HBM-bandwidth-bound, so bytes-streamed/s against the chip's HBM peak
+    is the honest utilization figure for EVERY solve config (MFU at 1e-5
+    on small solves is noise). Bytes estimate: each f32 feature slot read
+    is 4 bytes and contributes 2 flops (multiply+add), so streamed bytes
+    ~= model_flops * 2 assuming X streams from HBM on each aggregator
+    pass — exact for the matvec solvers, an upper bound for Gram/DIRECT
+    paths that reuse tiles on-chip (their hbm_fraction reads high, their
+    wall-clock is the proof either way)."""
+    import jax
+
+    bw = model_flops * 2.0 / max(seconds, 1e-9)
+    kind = (getattr(jax.devices()[0], "device_kind", "") or "").lower()
+    hbm = _hbm_peak(kind)
+    return {
+        "achieved_bandwidth_gb_s": round(bw / 1e9, 2),
+        "hbm_fraction": None if hbm is None else round(bw / hbm, 4),
+    }
+
+
 # --------------------------------------------------------------------------
 # config 1+3: GLMix logistic (HEADLINE)
 # --------------------------------------------------------------------------
@@ -422,11 +500,10 @@ def config_glmix_logistic(scale: float):
     Xv = sp.hstack([sp.csr_matrix(Xg_v),
                     sparse_onehot_block(users_v, Xu_v, n_users)], format="csr")
     clf = LogisticRegression(C=1.0, solver="lbfgs", max_iter=100, tol=1e-7)
-    t0 = time.perf_counter()
-    clf.fit(X, y)
-    oracle_t = time.perf_counter() - t0
+    oracle_t, _, oracle_times = timed_median(lambda: clf.fit(X, y))
     oracle_auc = auc_score(y_v, clf.decision_function(Xv))
-    log(f"glmix_logistic oracle: {oracle_t:.2f}s AUC {oracle_auc:.4f}")
+    log(f"glmix_logistic oracle: median {oracle_t:.2f}s of {oracle_times} "
+        f"AUC {oracle_auc:.4f}")
 
     df = glmix_frame(Xg, {"userId": (users, Xu)}, y, GameDataFrame, FeatureShard)
     dfv = glmix_frame(Xg_v, {"userId": (users_v, Xu_v)}, y_v,
@@ -488,10 +565,13 @@ def config_glmix_logistic(scale: float):
         "wallclock_cold_s": round(cold, 2),
         "wallclock_ingest_s": round(ingest, 2),
         "baseline_wallclock_s": round(oracle_t, 2),
+        "baseline_wallclock_runs_s": oracle_times,
+        "loadavg_1m": _loadavg(),
         "auc": round(float(our_auc), 4),
         "baseline_auc": round(float(oracle_auc), 4),
         "parity": bool(our_auc >= oracle_auc - 0.005),
         "mfu": mfu,
+        **bandwidth_fields(model_flops, warm),
         "model_flops_est": float(model_flops),
         "peak_flops_assumed": peak,
         "baseline": "sklearn LogisticRegression(lbfgs) one-hot flattening, same host CPU",
@@ -542,11 +622,10 @@ def config_poisson_tron(scale: float):
 
     reg = PoissonRegressor(alpha=1.0 / n, fit_intercept=False,
                            max_iter=100, tol=1e-7)
-    t0 = time.perf_counter()
-    reg.fit(X, y)
-    oracle_t = time.perf_counter() - t0
+    oracle_t, _, oracle_times = timed_median(lambda: reg.fit(X, y))
     oracle_rmse = rmse(yv, reg.predict(Xv))
-    log(f"poisson oracle: {oracle_t:.2f}s RMSE {oracle_rmse:.4f}")
+    log(f"poisson oracle: median {oracle_t:.2f}s of {oracle_times} "
+        f"RMSE {oracle_rmse:.4f}")
 
     batch = DataBatch(jax.numpy.asarray(X), jax.numpy.asarray(y, jax.numpy.float32))
     # TRON is L2-only by reference contract (OptimizerFactory.scala:71-72)
@@ -586,7 +665,8 @@ def config_poisson_tron(scale: float):
     log(f"poisson TRON warm {warm:.2f}s RMSE {our_rmse:.4f}; "
         f"enet OWLQN warm {enet_warm:.2f}s RMSE {enet_rmse:.4f}")
 
-    mfu, _ = _mfu(fixed_effect_flops(coord_like), warm)
+    poisson_flops = fixed_effect_flops(coord_like)
+    mfu, _ = _mfu(poisson_flops, warm)
     return {
         "metric": "poisson_tron_train_samples_per_sec",
         "value": round(n / warm, 1),
@@ -594,6 +674,9 @@ def config_poisson_tron(scale: float):
         "vs_baseline": round(oracle_t / warm, 3),
         "wallclock_warm_s": round(warm, 2),
         "baseline_wallclock_s": round(oracle_t, 2),
+        "baseline_wallclock_runs_s": oracle_times,
+        "loadavg_1m": _loadavg(),
+        **bandwidth_fields(poisson_flops, warm),
         "rmse": round(our_rmse, 4),
         "baseline_rmse": round(oracle_rmse, 4),
         "parity": bool(our_rmse <= oracle_rmse * 1.02),
@@ -674,12 +757,11 @@ def config_glmix_multi_re(scale: float):
                     sparse_onehot_block(users_v, Xu_v, n_users),
                     sparse_onehot_block(movies_v, Xm_v, n_movies)], format="csr")
     ridge = Ridge(alpha=1.0, solver="lsqr", tol=1e-7)
-    t0 = time.perf_counter()
-    ridge.fit(X, y)
-    oracle_t = time.perf_counter() - t0
+    oracle_t, _, oracle_times = timed_median(lambda: ridge.fit(X, y),
+                                             budget_s=180.0)
     oracle_rmse = rmse(y_v, ridge.predict(Xv))
-    log(f"glmix_multi_re oracle(Ridge lsqr): {oracle_t:.2f}s "
-        f"RMSE {oracle_rmse:.4f}")
+    log(f"glmix_multi_re oracle(Ridge lsqr): median {oracle_t:.2f}s of "
+        f"{oracle_times} RMSE {oracle_rmse:.4f}")
 
     df = glmix_frame(with_intercept(Xg),
                      {"userId": (users, Xu), "movieId": (movies, Xm)},
@@ -744,7 +826,8 @@ def config_glmix_multi_re(scale: float):
         }
     log("RE telemetry:", json.dumps(telemetry))
 
-    mfu, _ = _mfu(estimator_sweep_flops(est) * cd_iters, warm)
+    mre_flops = estimator_sweep_flops(est) * cd_iters
+    mfu, _ = _mfu(mre_flops, warm)
     return {
         "metric": "glmix_multi_re_train_samples_per_sec",
         "value": round(n * cd_iters / warm, 1),
@@ -754,6 +837,9 @@ def config_glmix_multi_re(scale: float):
         "wallclock_cold_s": round(cold, 2),
         "wallclock_ingest_s": round(ingest, 2),
         "baseline_wallclock_s": round(oracle_t, 2),
+        "baseline_wallclock_runs_s": oracle_times,
+        "loadavg_1m": _loadavg(),
+        **bandwidth_fields(mre_flops, warm),
         "rmse": round(our_rmse, 4),
         "baseline_rmse": round(oracle_rmse, 4),
         "parity": bool(our_rmse <= oracle_rmse * 1.02),
@@ -808,16 +894,18 @@ def config_svm_bayesian(scale: float):
     # equal candidate counts with the Bayesian loop (VERDICT r3 weak #5):
     # 6 grid points spanning the same 1e-3..1e3 search range
     grid = list(np.logspace(-3, 3, n_tuning))
-    t0 = time.perf_counter()
-    oracle_best = 0.0
-    for C in grid:
-        svc = LinearSVC(C=C, loss="hinge", max_iter=2000, tol=1e-6)
-        svc.fit(X, y)
-        oracle_best = max(oracle_best,
-                          auc_score(yv, svc.decision_function(Xv)))
-    oracle_t = time.perf_counter() - t0
-    log(f"svm oracle grid({len(grid)}): {oracle_t:.2f}s best AUC "
-        f"{oracle_best:.4f}")
+
+    def run_grid():
+        best = 0.0
+        for C in grid:
+            svc = LinearSVC(C=C, loss="hinge", max_iter=2000, tol=1e-6)
+            svc.fit(X, y)
+            best = max(best, auc_score(yv, svc.decision_function(Xv)))
+        return best
+
+    oracle_t, oracle_best, oracle_times = timed_median(run_grid)
+    log(f"svm oracle grid({len(grid)}): median {oracle_t:.2f}s of "
+        f"{oracle_times} best AUC {oracle_best:.4f}")
 
     df = GameDataFrame(num_samples=n, response=y,
                        feature_shards={"global": FeatureShard(X, d)},
@@ -859,6 +947,8 @@ def config_svm_bayesian(scale: float):
         "vs_baseline": round(per_fit_oracle / per_fit, 3),
         "wallclock_tuning_s": round(tuning_t, 2),
         "baseline_wallclock_s": round(oracle_t, 2),
+        "baseline_wallclock_runs_s": oracle_times,
+        "loadavg_1m": _loadavg(),
         "candidates": n_tuning,
         "baseline_candidates": len(grid),
         "auc": round(float(our_best), 4),
@@ -897,6 +987,7 @@ def config_heart_real(scale: float):
         build_index_maps,
         records_to_game_dataframe,
     )
+    from photon_tpu.utils.flops import _nnz_slots as _nnz
     from photon_tpu.optim.problem import (
         GLMOptimizationConfiguration,
         OptimizerConfig,
@@ -948,14 +1039,17 @@ def config_heart_real(scale: float):
     Xs, Xvs = (X - mu) / sd, (Xv - mu) / sd
 
     from sklearn.linear_model import LogisticRegression
-    t0 = time.perf_counter()
-    oracle_best = 0.0
-    for lam in lambdas:
-        clf = LogisticRegression(C=1.0 / lam, solver="lbfgs", max_iter=50,
-                                 tol=1e-7, fit_intercept=False)
-        clf.fit(Xs, y01)
-        oracle_best = max(oracle_best, auc_score(yv01, Xvs @ clf.coef_.ravel()))
-    oracle_t = time.perf_counter() - t0
+
+    def run_sweep():
+        best = 0.0
+        for lam in lambdas:
+            clf = LogisticRegression(C=1.0 / lam, solver="lbfgs", max_iter=50,
+                                     tol=1e-7, fit_intercept=False)
+            clf.fit(Xs, y01)
+            best = max(best, auc_score(yv01, Xvs @ clf.coef_.ravel()))
+        return best
+
+    oracle_t, oracle_best, oracle_times = timed_median(run_sweep)
 
     from photon_tpu.ops.normalization import (
         NormalizationType,
@@ -973,7 +1067,7 @@ def config_heart_real(scale: float):
         regularization_weights=lambdas, norm=norm, intercept_index=iidx)
     jax.block_until_ready(models[lambdas[-1]].coefficients.means)
     t0 = time.perf_counter()
-    models, _ = train_generalized_linear_model(
+    models, sweep_stats = train_generalized_linear_model(
         TaskType.LOGISTIC_REGRESSION, batch, dim, cfg,
         regularization_weights=lambdas, norm=norm, intercept_index=iidx)
     jax.block_until_ready(models[lambdas[-1]].coefficients.means)
@@ -990,6 +1084,11 @@ def config_heart_real(scale: float):
         "vs_baseline": round(oracle_t / warm, 3),
         "wallclock_warm_s": round(warm, 3),
         "baseline_wallclock_s": round(oracle_t, 3),
+        "baseline_wallclock_runs_s": oracle_times,
+        "loadavg_1m": _loadavg(),
+        **bandwidth_fields(
+            sum(4.0 * _nnz(batch.features) * int(np.asarray(r.num_fun_evals))
+                for r in sweep_stats.values()), warm),
         "auc": round(float(our_best), 4),
         "baseline_auc": round(float(oracle_best), 4),
         "parity": bool(our_best >= oracle_best - 0.01),
@@ -1018,6 +1117,7 @@ def config_a9a_real(scale: float):
     from photon_tpu.estimators.model_training import (
         train_generalized_linear_model,
     )
+    from photon_tpu.utils.flops import _nnz_slots as _nnz
     from photon_tpu.function.objective import L2Regularization
     from photon_tpu.optim.problem import (
         GLMOptimizationConfiguration,
@@ -1051,16 +1151,20 @@ def config_a9a_real(scale: float):
 
     X, Xv = to_csr(tr), to_csr(te)
     lambdas = [0.1, 1.0, 10.0, 100.0]
-    t0 = time.perf_counter()
-    oracle_best = 0.0
-    for lam in lambdas:
-        clf = LogisticRegression(C=1.0 / lam, solver="lbfgs", max_iter=50,
-                                 tol=1e-7, fit_intercept=False)
-        clf.fit(X, y)
-        oracle_best = max(oracle_best, auc_score(yv, Xv @ clf.coef_.ravel()))
-    oracle_t = time.perf_counter() - t0
-    log(f"a9a oracle: {oracle_t:.2f}s AUC {oracle_best:.4f} "
-        f"(n={X.shape[0]}, d={tr.dim}, ingest {ingest_s:.2f}s)")
+
+    def run_sweep():
+        best = 0.0
+        for lam in lambdas:
+            clf = LogisticRegression(C=1.0 / lam, solver="lbfgs", max_iter=50,
+                                     tol=1e-7, fit_intercept=False)
+            clf.fit(X, y)
+            best = max(best, auc_score(yv, Xv @ clf.coef_.ravel()))
+        return best
+
+    oracle_t, oracle_best, oracle_times = timed_median(run_sweep)
+    log(f"a9a oracle: median {oracle_t:.2f}s of {oracle_times} AUC "
+        f"{oracle_best:.4f} (n={X.shape[0]}, d={tr.dim}, "
+        f"ingest {ingest_s:.2f}s)")
 
     cfg = GLMOptimizationConfiguration(
         optimizer=OptimizerConfig(max_iterations=50, tolerance=1e-7),
@@ -1070,7 +1174,7 @@ def config_a9a_real(scale: float):
         regularization_weights=lambdas)
     jax.block_until_ready(models[lambdas[-1]].coefficients.means)
     t0 = time.perf_counter()
-    models, _ = train_generalized_linear_model(
+    models, sweep_stats = train_generalized_linear_model(
         TaskType.LOGISTIC_REGRESSION, batch, tr.dim, cfg,
         regularization_weights=lambdas)
     jax.block_until_ready(models[lambdas[-1]].coefficients.means)
@@ -1089,6 +1193,11 @@ def config_a9a_real(scale: float):
         "wallclock_warm_s": round(warm, 3),
         "wallclock_ingest_s": round(ingest_s, 3),
         "baseline_wallclock_s": round(oracle_t, 3),
+        "baseline_wallclock_runs_s": oracle_times,
+        "loadavg_1m": _loadavg(),
+        **bandwidth_fields(
+            sum(4.0 * _nnz(batch.features) * int(np.asarray(r.num_fun_evals))
+                for r in sweep_stats.values()), warm),
         "auc": round(float(our_best), 4),
         "baseline_auc": round(float(oracle_best), 4),
         "parity": bool(our_best >= oracle_best - 0.005),
@@ -1153,15 +1262,7 @@ def config_fe_throughput(scale: float):
     # utilization figure is achieved bytes/s against the chip's HBM peak
     # (v5e: ~819 GB/s), not MFU
     bw = evals * 2.0 * n * d * 4 / warm
-    low_kind = kind.lower()
-    if "v5p" in low_kind:
-        hbm_peak = 2765e9
-    elif "v5" in low_kind:      # v5e / v5 lite
-        hbm_peak = 819e9
-    elif "v4" in low_kind:
-        hbm_peak = 1228e9
-    else:
-        hbm_peak = None
+    hbm_peak = _hbm_peak(kind.lower())
     log(f"fe_throughput: {n}x{d}, {evals} evals in {warm:.2f}s -> "
         f"{achieved/1e9:.1f} GFLOP/s, {bw/1e9:.0f} GB/s on {kind} "
         f"(mfu {achieved/peak:.2e})")
@@ -1259,6 +1360,7 @@ def config_fe_throughput(scale: float):
         "mfu": round(achieved / peak, 8),
         "peak_flops_assumed": peak,
         "shape": [n, d],
+        "loadavg_1m": _loadavg(),
         "parity": True,
         "baseline": "device peak (GLM solves are HBM-bandwidth-bound; "
                     "see achieved_bandwidth_gb_s)",
@@ -1288,11 +1390,13 @@ def main():
                     help="first probe stage timeout; cold TPU init can "
                          "take 9+ minutes (round-2 evidence)")
     ap.add_argument("--deadline", type=float,
-                    default=float(os.environ.get("BENCH_DEADLINE", "1800")),
+                    default=float(os.environ.get("BENCH_DEADLINE", "2100")),
                     help="hard wall-clock cap; watchdog emits partial summary")
     ap.add_argument("--soft-budget", type=float,
-                    default=float(os.environ.get("BENCH_SOFT_BUDGET", "1350")),
-                    help="stop starting new configs past this elapsed time")
+                    default=float(os.environ.get("BENCH_SOFT_BUDGET", "1600")),
+                    help="stop starting new configs past this elapsed time "
+                         "(raised with the median-of-3 oracle protocol, "
+                         "which adds up to ~5 min of baseline reruns)")
     args = ap.parse_args()
 
     start_watchdog(args.deadline)
