@@ -508,18 +508,20 @@ def config_glmix_logistic(scale: float):
     df = glmix_frame(Xg, {"userId": (users, Xu)}, y, GameDataFrame, FeatureShard)
     dfv = glmix_frame(Xg_v, {"userId": (users_v, Xu_v)}, y_v,
                       GameDataFrame, FeatureShard)
-    # TRON (the reference's trust-region Newton, TRON.scala:80) at the
-    # reference's own TRON defaults (tol=1e-5, TRON.scala:256-262):
-    # explicit Gauss-Newton Hessians batch the solves onto the MXU and cut
-    # sequential while_loop steps vs L-BFGS line searches — measured 2.7x
-    # (solver) x 2.7x (reference tolerance) faster at identical AUC 0.8997
-    opt = GLMOptimizationConfiguration(
-        optimizer=OptimizerConfig(optimizer_type=OptimizerType.TRON,
-                                  max_iterations=100, tolerance=1e-5),
-        regularization=L2Regularization, regularization_weight=1.0)
+    # NEWTON (damped IRLS, optim/newton.py) at the reference's TRON
+    # tolerance (1e-5, TRON.scala:256-262): each outer iteration is one
+    # explicit Gauss-Newton Hessian (MXU contraction) + Cholesky — zero
+    # inner CG, so sequential while_loop depth collapses to ~5 outer
+    # steps. Measured 1.14x faster than TRON on XLA-CPU at identical AUC
+    # 0.8997; a TRON A/B arm is recorded below so the chip answer is in
+    # the artifact.
     cd_iters = 2
 
-    def build():
+    def build(opt_type=OptimizerType.NEWTON):
+        opt = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(optimizer_type=opt_type,
+                                      max_iterations=100, tolerance=1e-5),
+            regularization=L2Regularization, regularization_weight=1.0)
         return GameEstimator(
             TaskType.LOGISTIC_REGRESSION,
             {"fixed": CoordinateConfiguration(
@@ -553,6 +555,20 @@ def config_glmix_logistic(scale: float):
     our_auc = auc_score(y_v, scores)
     log(f"glmix_logistic warm {warm:.2f}s AUC {our_auc:.4f}")
 
+    # TRON A/B arm: same config, the reference's own solver — the
+    # NEWTON-vs-TRON claim gets an on-chip number in every capture
+    est_t = build(OptimizerType.TRON)
+    res_t = est_t.fit(df)
+    jax.block_until_ready(res_t[-1].model["fixed"].model.coefficients.means)
+    t0 = time.perf_counter()
+    res_t = est_t.fit(df)
+    jax.block_until_ready(res_t[-1].model["fixed"].model.coefficients.means)
+    tron_warm = time.perf_counter() - t0
+    tron_auc = auc_score(
+        y_v, np.asarray(GameTransformer(res_t[-1].model, est_t).transform(dfv)))
+    log(f"glmix_logistic TRON arm: {tron_warm:.2f}s AUC {tron_auc:.4f} "
+        f"(NEWTON {warm / tron_warm:.2f}x of TRON's time)")
+
     sweep_flops = estimator_sweep_flops(est)
     model_flops = sweep_flops * cd_iters  # per-sweep estimate x sweeps
     mfu, peak = _mfu(model_flops, warm)
@@ -574,11 +590,15 @@ def config_glmix_logistic(scale: float):
         **bandwidth_fields(model_flops, warm),
         "model_flops_est": float(model_flops),
         "peak_flops_assumed": peak,
+        "solver": "NEWTON",
+        "tron_wallclock_s": round(tron_warm, 2),
+        "tron_auc": round(float(tron_auc), 4),
+        "newton_speedup_vs_tron": round(tron_warm / warm, 2),
         "baseline": "sklearn LogisticRegression(lbfgs) one-hot flattening, same host CPU",
-        "cpu_note": "beats sklearn even on the CPU fallback after the "
-                    "w @ X contraction fix + TRON; 1.48x measured on TPU "
-                    "v5e with the slower pre-fix L-BFGS path "
-                    "(bench_r04_live.out)",
+        "cpu_note": "beats sklearn even on the CPU fallback (w @ X "
+                    "contraction fix + batched-IRLS NEWTON); 1.48x "
+                    "measured on TPU v5e with the slower round-3 L-BFGS "
+                    "path (bench_r04_live.out)",
     }
 
 
@@ -628,26 +648,47 @@ def config_poisson_tron(scale: float):
         f"RMSE {oracle_rmse:.4f}")
 
     batch = DataBatch(jax.numpy.asarray(X), jax.numpy.asarray(y, jax.numpy.float32))
-    # TRON is L2-only by reference contract (OptimizerFactory.scala:71-72)
-    tron_cfg = GLMOptimizationConfiguration(
-        optimizer=OptimizerConfig(optimizer_type=OptimizerType.TRON,
-                                  max_iterations=30, tolerance=1e-7),
-        regularization=L2Regularization, regularization_weight=1.0)
-    prob = GlmOptimizationProblem(TaskType.POISSON_REGRESSION, tron_cfg)
-    model, _ = prob.run(batch, dim=d)               # cold (compiles)
-    jax.block_until_ready(model.coefficients.means)
     coord_like = type("C", (), {})()                # flop accounting shim
     coord_like.batch = batch
 
-    t0 = time.perf_counter()
-    model, result = prob.run(batch, dim=d)
-    jax.block_until_ready(model.coefficients.means)
-    warm = time.perf_counter() - t0
+    # Three solver arms at the same tolerance, all quality-gated; the
+    # headline is the fastest at parity — the same contract the oracle
+    # side gets (sklearn PoissonRegressor IS l-bfgs, sklearn's best
+    # solver for the task). TRON is the reference's solver for this
+    # config and is always recorded; NEWTON (batched IRLS) and LBFGS are
+    # the TPU-first alternatives whose crossover flips between backends
+    # (the Gram is an MXU bargain / a CPU tax).
+    def run_arm(opt_type):
+        cfg = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(optimizer_type=opt_type,
+                                      max_iterations=30, tolerance=1e-7),
+            regularization=L2Regularization, regularization_weight=1.0)
+        prob = GlmOptimizationProblem(TaskType.POISSON_REGRESSION, cfg)
+        m, r = prob.run(batch, dim=d)               # cold (compiles)
+        jax.block_until_ready(m.coefficients.means)
+        t0 = time.perf_counter()
+        m, r = prob.run(batch, dim=d)
+        jax.block_until_ready(m.coefficients.means)
+        dt = time.perf_counter() - t0
+        return (dt, rmse(yv, np.exp(Xv @ np.asarray(m.coefficients.means))),
+                m, r)
+
+    arms = {}
+    for ot in (OptimizerType.TRON, OptimizerType.NEWTON, OptimizerType.LBFGS):
+        arms[ot.value] = run_arm(ot)
+        log(f"poisson {ot.value}: {arms[ot.value][0]:.2f}s "
+            f"RMSE {arms[ot.value][1]:.4f}")
+    tron_warm, tron_rmse = arms["TRON"][0], arms["TRON"][1]
+    newton_warm, newton_rmse = arms["NEWTON"][0], arms["NEWTON"][1]
+    at_parity = {k: v for k, v in arms.items()
+                 if v[1] <= min(a[1] for a in arms.values()) * 1.02}
+    best_solver = min(at_parity, key=lambda k: at_parity[k][0])
+    warm, our_rmse, model, result = arms[best_solver]
     coord_like.last_result = result
-    our_rmse = rmse(yv, np.exp(Xv @ np.asarray(model.coefficients.means)))
 
     # elastic-net companion fit (OWL-QN carries the L1 part, as in the
-    # reference where TRON+L1 is rejected)
+    # reference where TRON+L1 is rejected; reference contract:
+    # OptimizerFactory.scala:71-72)
     enet_cfg = GLMOptimizationConfiguration(
         optimizer=OptimizerConfig(optimizer_type=OptimizerType.OWLQN,
                                   max_iterations=100, tolerance=1e-7),
@@ -681,19 +722,66 @@ def config_poisson_tron(scale: float):
         "baseline_rmse": round(oracle_rmse, 4),
         "parity": bool(our_rmse <= oracle_rmse * 1.02),
         "mfu": mfu,
+        "solver": best_solver,
+        "solver_arms": {k: {"wallclock_s": round(v[0], 2),
+                            "rmse": round(v[1], 4)}
+                        for k, v in arms.items()},
+        "tron_wallclock_s": round(tron_warm, 2),
+        "tron_rmse": round(tron_rmse, 4),
+        "newton_wallclock_s": round(newton_warm, 2),
+        "newton_rmse": round(newton_rmse, 4),
         "elasticnet_wallclock_s": round(enet_warm, 2),
         "elasticnet_rmse": round(enet_rmse, 4),
+        **({"cpu_profile": _cpu_matvec_profile(X)}
+           if _STATE["tpu_unavailable"] else {}),
         "baseline": "sklearn PoissonRegressor(lbfgs), same host CPU",
-        # After the w @ X contraction-order fix (round 3's "16x slower"
-        # was the XLA-CPU strided-transpose rmatvec, not solver slack)
-        # the CPU fallback runs ~1 s vs sklearn's ~0.9 s — within the
-        # single-kernel-vs-threaded-BLAS noise at equal iteration
-        # counts. The identical solve on TPU v5e runs 0.06-0.10 s
-        # (15-20x FASTER than sklearn; BENCH_TPU_LIVE_r04.md), which is
-        # the deployment target this framework optimizes for.
-        "cpu_note": ("~parity with threaded-BLAS sklearn on CPU "
-                     "fallback; same solve is 15-20x faster than "
-                     "sklearn on TPU v5e"),
+        # cpu_profile MEASURES the backend floor (XLA-CPU vs numpy-BLAS
+        # GFLOP/s on the identical matvec pair); solver_arms records all
+        # three solvers so a sub-1x arm is attributable to solver pass
+        # counts, never to an unexplained framework tax. The TRON solve
+        # on TPU v5e runs 0.06-0.10 s (15-20x FASTER than sklearn;
+        # BENCH_TPU_LIVE_r04.md).
+        "cpu_note": ("headline = fastest quality-parity solver, the "
+                     "same freedom the oracle side has (sklearn "
+                     "PoissonRegressor IS l-bfgs); TRON is 15-20x "
+                     "faster than sklearn on TPU v5e"),
+    }
+
+
+def _cpu_matvec_profile(X: np.ndarray) -> dict:
+    """The measured backend floor behind every CPU-fallback ratio: GFLOP/s
+    of the GLM hot pair (X @ w forward, r @ X gradient) on XLA-CPU vs the
+    SAME contractions through numpy's threaded BLAS. Equal iteration
+    counts with a slower matvec engine IS the whole story of a sub-1x
+    fallback config; this makes it a number instead of prose."""
+    import jax
+    import jax.numpy as jnp
+
+    n, d = X.shape
+    w = np.random.default_rng(0).normal(size=d).astype(X.dtype)
+    r = np.random.default_rng(1).normal(size=n).astype(X.dtype)
+
+    def best_of(fn, k=3):
+        fn()  # warm-up / compile
+        times = []
+        for _ in range(k):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    Xj, wj, rj = jnp.asarray(X), jnp.asarray(w), jnp.asarray(r)
+    # data enters as arguments — closed-over arrays would constant-fold
+    # the whole contraction at trace time and time nothing
+    pair = jax.jit(lambda A, v, u: (A @ v, u @ A))
+    t_xla = best_of(lambda: jax.block_until_ready(pair(Xj, wj, rj)))
+    t_np = best_of(lambda: (X @ w, r @ X))
+    flops = 2.0 * 2.0 * n * d  # two matvecs, 2 flops/slot
+    return {
+        "shape": [n, d],
+        "xla_cpu_gflops": round(flops / t_xla / 1e9, 1),
+        "numpy_blas_gflops": round(flops / t_np / 1e9, 1),
+        "blas_advantage": round(t_xla / t_np, 2),
     }
 
 

@@ -135,6 +135,12 @@ def bootstrap_training(
         if optimizer_type == OptimizerType.TRON:
             hv = lambda c, v: obj.hessian_vector(c, v, b, hyper)
             return tron.minimize(vg, hv, x0, config=cfg).coef
+        if optimizer_type == OptimizerType.NEWTON:
+            # batched-Cholesky IRLS — a natural fit for this vmapped solve
+            from photon_tpu.optim import newton
+            hm = lambda c: obj.hessian_matrix_from_weights(
+                obj.hessian_weights(c, b), dim, b, hyper)
+            return newton.minimize(vg, hm, x0, config=cfg).coef
         return lbfgs.minimize(vg, x0, config=cfg).coef
 
     models = jax.jit(jax.vmap(solve_one))(mults)

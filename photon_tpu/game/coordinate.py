@@ -310,6 +310,20 @@ class RandomEffectCoordinate:
         if opt_type == OptimizerType.DIRECT:
             from photon_tpu.optim.problem import _validate_direct
             _validate_direct(self.task, opt, self.config.regularization)
+        if opt_type == OptimizerType.NEWTON:
+            from photon_tpu.optim.problem import _validate_newton
+            _validate_newton(self.task, opt, self.config.regularization)
+            if (opt.explicit_hessian is not True
+                    and self.dataset.projected_dim > 64):
+                # same bound as TRON's explicit gate below: an [E, K, K]
+                # Hessian block at large K (IDENTITY projectors / fat
+                # entities) would dwarf the data itself — NEWTON has no
+                # matrix-free mode, so refuse instead of OOMing
+                raise ValueError(
+                    f"NEWTON builds explicit [E, K, K] Hessians; projected "
+                    f"dim {self.dataset.projected_dim} > 64 would dwarf the "
+                    f"data. Use TRON (matrix-free above K=64) or set "
+                    f"explicit_hessian=True to override")
         dense_flags = self._dense_local_blocks
         has_norm = self._norm_local is not None
         has_shifts = has_norm and self._norm_local[1] is not None
@@ -340,6 +354,21 @@ class RandomEffectCoordinate:
                     r = direct.minimize(
                         vg, lambda c: obj_e.hessian_matrix(c, batch, hyper),
                         x0)
+                elif opt_type == OptimizerType.NEWTON:
+                    # damped Newton/IRLS: DIRECT's [E, K, K] batched
+                    # Cholesky machinery for logistic/Poisson — a handful
+                    # of outer iterations, each one batched weighted-Gram
+                    # contraction + factorization, zero inner CG
+                    # (optim/newton.py; replaces per-entity iterative TRON,
+                    # SingleNodeOptimizationProblem.scala:40)
+                    from photon_tpu.optim import newton
+                    K = x0.shape[0]
+                    r = newton.minimize(
+                        vg,
+                        lambda c: obj_e.hessian_matrix_from_weights(
+                            obj_e.hessian_weights(c, batch), K, batch,
+                            hyper),
+                        x0, config=solver_cfg)
                 elif opt_type == OptimizerType.OWLQN:
                     r = owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
                 elif opt_type == OptimizerType.TRON:

@@ -9,7 +9,7 @@ from photon_tpu.optim.base import (  # noqa: F401
     SolverConfig,
     SolverResult,
 )
-from photon_tpu.optim import lbfgs, owlqn, tron  # noqa: F401
+from photon_tpu.optim import lbfgs, newton, owlqn, tron  # noqa: F401
 from photon_tpu.types import OptimizerType
 
 
@@ -19,6 +19,7 @@ def minimize(
     x0,
     *args,
     hess_vec=None,
+    hess_matrix=None,
     l1_weight=0.0,
     config: SolverConfig = SolverConfig(),
 ) -> SolverResult:
@@ -36,4 +37,13 @@ def minimize(
         if hess_vec is None:
             raise ValueError("TRON requires hess_vec")
         return tron.minimize(value_and_grad, hess_vec, x0, *args, config=config)
+    if optimizer_type == OptimizerType.NEWTON:
+        if hess_matrix is None:
+            raise ValueError("NEWTON requires hess_matrix")
+        # newton.minimize takes arg-free closures; bind the extra
+        # objective args here to honor the facade's *args contract
+        return newton.minimize(
+            (lambda x: value_and_grad(x, *args)) if args else value_and_grad,
+            (lambda x: hess_matrix(x, *args)) if args else hess_matrix,
+            x0, config=config)
     raise ValueError(f"unknown optimizer type {optimizer_type}")

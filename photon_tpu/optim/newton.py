@@ -1,0 +1,151 @@
+"""Damped Newton (IRLS) with explicit Hessian factorization.
+
+TPU-native extension of DIRECT (optim/direct.py) past quadratic losses:
+for twice-differentiable GLM losses (logistic, Poisson, squared) the
+minimizer is reached by a handful of Newton steps, each one
+
+    H(x) s = -g(x);   x <- x + t s      (t from Armijo backtracking)
+
+where H is the explicit [d, d] GLM Hessian — one curvature-weighted Gram
+contraction (MXU) — and the solve is a Cholesky factorization. Under vmap
+over entity blocks this is a batched [E, K, K] potrf/trsm pipeline per
+OUTER iteration: a logistic GLMix per-entity solve costs ~5 batched
+factorizations total, versus TRON's nested outer x CG sequential
+while_loop steps (the reference runs full iterative TRON/L-BFGS per
+entity: SingleNodeOptimizationProblem.scala:40, TRON.scala:278-338).
+
+This is classic IRLS re-shaped for the hardware: all sequential depth
+that XLA cannot batch is collapsed into the one place it is algorithmically
+irreducible (the outer Newton iteration), and everything inside an
+iteration is a dense contraction or factorization the MXU executes
+natively.
+
+Safeguards:
+  * non-PD / singular curvature (lambda = 0 with rank-deficient data)
+    produces a non-finite Cholesky step -> fall back to steepest descent
+    for that iteration (never silently stop at the start point);
+  * Armijo backtracking rejects divergent steps (Poisson's exp margins
+    can overflow on an overconfident Newton step: a non-finite trial
+    value fails the acceptance test and the step halves);
+  * tolerance semantics match the other solvers (absolute-from-relative
+    at the initial state, Optimizer.scala:36-190 convention), so NEWTON
+    drops into any config where LBFGS/TRON run today.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.optim.base import (
+    ConvergenceReason,
+    SolverConfig,
+    SolverResult,
+    StateTracking,
+    absolute_tolerances,
+    convergence_reason,
+)
+
+Array = jax.Array
+
+_ARMIJO_C1 = 1e-4
+
+
+class _Carry(NamedTuple):
+    x: Array
+    f: Array
+    g: Array
+    it: Array
+    n_evals: Array
+    reason: Array
+    tracking: Optional[StateTracking]
+
+
+def minimize(
+    value_and_grad,
+    hess_matrix,
+    x0: Array,
+    config: SolverConfig = SolverConfig(max_iterations=25, tolerance=1e-7),
+) -> SolverResult:
+    """``value_and_grad(x) -> (f, g)``; ``hess_matrix(x) -> [d, d]`` full
+    (regularized) Hessian at x. Both are re-evaluated every outer
+    iteration — unlike DIRECT, no quadratic assumption is made."""
+    f0, g0 = value_and_grad(x0)
+    tols = absolute_tolerances(f0, g0, config.tolerance)
+
+    def linesearch(x, f, g, direction):
+        """Armijo backtracking from t=1 (the Newton-natural step). The
+        acceptance test carries a machine-epsilon slack (approximate-Wolfe
+        style): near the optimum the true decrease underflows f's ulp, and
+        a strict test would burn linesearch_max_iterations full data
+        passes rejecting a perfectly converged step."""
+        gdot = jnp.dot(g, direction)
+        slack = 4.0 * jnp.finfo(x.dtype).eps * jnp.abs(f)
+
+        def cond(c):
+            t, f_new, _, k, done = c
+            return (~done) & (k < config.linesearch_max_iterations)
+
+        def body(c):
+            t, _, _, k, _ = c
+            f_t, g_t = value_and_grad(x + t * direction)
+            ok = jnp.isfinite(f_t) & (f_t <= f + _ARMIJO_C1 * t * gdot + slack)
+            return (jnp.where(ok, t, 0.5 * t), f_t, g_t, k + 1, ok)
+
+        t0 = jnp.asarray(1.0, x.dtype)
+        t, f_new, g_new, k, ok = jax.lax.while_loop(
+            cond, body, (t0, f, g, jnp.asarray(0, jnp.int32),
+                         jnp.asarray(False)))
+        return t, f_new, g_new, k, ok
+
+    def cond(c: _Carry):
+        return c.reason == ConvergenceReason.NOT_CONVERGED
+
+    def body(c: _Carry):
+        h = hess_matrix(c.x)
+        chol = jax.scipy.linalg.cho_factor(h)
+        step = -jax.scipy.linalg.cho_solve(chol, c.g)
+        # descent safeguard: a non-PD factorization yields NaN/inf or an
+        # ascent direction; steepest descent keeps the iteration alive
+        newton_ok = (jnp.all(jnp.isfinite(step))
+                     & (jnp.dot(c.g, step) < 0.0))
+        direction = jnp.where(newton_ok, step, -c.g)
+        t, f_new, g_new, ls_evals, accepted = linesearch(
+            c.x, c.f, c.g, direction)
+        x_new = jnp.where(accepted, c.x + t * direction, c.x)
+        f_new = jnp.where(accepted, f_new, c.f)
+        g_new = jnp.where(accepted, g_new, c.g)
+        it = c.it + 1
+        reason = convergence_reason(it, c.f, f_new, g_new, tols,
+                                    config.max_iterations, improved=accepted)
+        # an exhausted line search means no further progress is possible
+        # (TRON reports the analogous state as OBJECTIVE_NOT_IMPROVING)
+        reason = jnp.where(
+            (reason == ConvergenceReason.NOT_CONVERGED) & ~accepted,
+            jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
+            reason)
+        tracking = (None if c.tracking is None
+                    else c.tracking.record(c.it, f_new, g_new))
+        return _Carry(x_new, f_new, g_new, it,
+                      c.n_evals + ls_evals, reason, tracking)
+
+    # sentinel f_prev far from f0 so the initial check can only fire on
+    # the gradient (an already-stationary start) or max_iterations=0
+    f_far = f0 + 2.0 * tols.value_tol + 1.0
+    init = _Carry(
+        x=x0, f=f0, g=g0,
+        it=jnp.asarray(0, jnp.int32),
+        n_evals=jnp.asarray(1, jnp.int32),
+        reason=jnp.asarray(
+            convergence_reason(jnp.asarray(0, jnp.int32), f_far, f0, g0,
+                               tols, config.max_iterations), jnp.int32),
+        tracking=StateTracking.init(config.track_states, x0.dtype))
+    out = jax.lax.while_loop(cond, body, init)
+    return SolverResult(
+        coef=out.x, value=out.f, gradient=out.g,
+        iterations=out.it, reason=out.reason, num_fun_evals=out.n_evals,
+        loss_history=None if out.tracking is None else out.tracking.loss,
+        gnorm_history=None if out.tracking is None else out.tracking.gnorm,
+    )

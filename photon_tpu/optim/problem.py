@@ -46,13 +46,30 @@ def _validate_direct(task, opt: "OptimizerConfig", regularization) -> None:
     if task != TaskType.LINEAR_REGRESSION:
         raise ValueError(
             "OptimizerType.DIRECT is exact only for the quadratic squared "
-            f"loss (LINEAR_REGRESSION); use LBFGS/TRON for {task}")
+            f"loss (LINEAR_REGRESSION); use NEWTON for logistic/Poisson or "
+            f"LBFGS/TRON for {task}")
     if opt.lower_bounds is not None or opt.upper_bounds is not None:
         raise ValueError("DIRECT does not support box constraints")
     if regularization.l1_weight(1.0) != 0.0:
         raise ValueError(
             "DIRECT solves the L2/unregularized normal equations exactly; "
             "L1/elastic-net needs OWLQN")
+
+
+def _validate_newton(task, opt: "OptimizerConfig", regularization) -> None:
+    """NEWTON needs second derivatives and a smooth objective (shared by
+    the fixed- and random-effect paths)."""
+    from photon_tpu.ops.losses import loss_for_task
+    if not loss_for_task(task).has_hessian:
+        raise ValueError(
+            f"OptimizerType.NEWTON needs a twice-differentiable loss; "
+            f"{task} has no Hessian — use LBFGS")
+    if opt.lower_bounds is not None or opt.upper_bounds is not None:
+        raise ValueError("NEWTON does not support box constraints; "
+                         "use LBFGSB")
+    if regularization.l1_weight(1.0) != 0.0:
+        raise ValueError("NEWTON needs a smooth objective; L1/elastic-net "
+                         "needs OWLQN")
 
 
 def solver_cache_key(opt: "OptimizerConfig") -> tuple:
@@ -160,6 +177,8 @@ class GlmOptimizationProblem:
 
         if opt.optimizer_type == OptimizerType.DIRECT:
             _validate_direct(self.task, opt, self.config.regularization)
+        if opt.optimizer_type == OptimizerType.NEWTON:
+            _validate_newton(self.task, opt, self.config.regularization)
 
         def build():
             def solve(x0: Array, batch: DataBatch, l2: Array, l1: Array) -> SolverResult:
@@ -169,6 +188,28 @@ class GlmOptimizationProblem:
                     from photon_tpu.optim import direct
                     return direct.minimize(
                         vg, lambda c: obj.hessian_matrix(c, batch, hyper), x0)
+                if opt.optimizer_type == OptimizerType.NEWTON:
+                    # explicit Hessian via the curvature-weights split: one
+                    # weighted-Gram MXU contraction per outer iteration
+                    # (same operator TRON's explicit gate builds)
+                    from photon_tpu.optim import newton
+                    dim = x0.shape[0]
+                    if opt.explicit_hessian is not True and dim > 8192:
+                        # 8192^2 f32 = 256 MB per Hessian; beyond that the
+                        # explicit build stops being an MXU bargain even
+                        # on chip — NEWTON has no matrix-free mode, so
+                        # refuse instead of OOMing (trace-time check:
+                        # shapes are static under jit)
+                        raise ValueError(
+                            f"NEWTON builds an explicit [{dim}, {dim}] "
+                            f"Hessian; use TRON (matrix-free) above "
+                            f"d=8192, or set explicit_hessian=True to "
+                            f"override")
+                    return newton.minimize(
+                        vg,
+                        lambda c: obj.hessian_matrix_from_weights(
+                            obj.hessian_weights(c, batch), dim, batch, hyper),
+                        x0, config=solver_cfg)
                 if opt.optimizer_type == OptimizerType.OWLQN:
                     return owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
                 if opt.optimizer_type == OptimizerType.TRON:

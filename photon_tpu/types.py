@@ -58,3 +58,9 @@ class OptimizerType(enum.Enum):
     # minimizer the iterative solvers converge to, computed directly
     # (sklearn Ridge's own cholesky solver is the CPU-world equivalent).
     DIRECT = "DIRECT"
+    # TPU-native extension (no reference analog): damped Newton / IRLS
+    # with an explicit Hessian Cholesky per outer iteration — DIRECT's
+    # batched [E, K, K] machinery extended to logistic/Poisson, replacing
+    # TRON's nested outer x CG sequential loop with ~5 batched
+    # factorizations (optim/newton.py).
+    NEWTON = "NEWTON"

@@ -442,3 +442,63 @@ def test_dense_local_score_matches_sparse_path(glmix):
         coord.dataset, coefs)
     np.testing.assert_allclose(np.asarray(s_dense), np.asarray(s_sparse),
                                rtol=1e-6, atol=1e-8)
+
+
+def test_newton_solver_game_parity_logistic():
+    """NEWTON (batched per-entity IRLS, optim/newton.py) lands on the same
+    GAME model as tightly-converged TRON for LOGISTIC regression — fixed
+    AND random effects (the flagship GLMix workload the reference solves
+    with per-entity iterative TRON, SingleNodeOptimizationProblem.scala:40)."""
+    import numpy as np
+
+    from photon_tpu.estimators.game_estimator import (
+        CoordinateConfiguration,
+        FixedEffectDataConfiguration,
+        GameEstimator,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+    from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import OptimizerType, TaskType
+
+    rng = np.random.default_rng(5)
+    n, d, users, d_u = 600, 6, 7, 3
+    Xg = rng.normal(size=(n, d))
+    Xu = rng.normal(size=(n, d_u))
+    uid = rng.integers(0, users, size=n)
+    logits = (Xg @ rng.normal(size=d)
+              + np.einsum("nk,nk->n", Xu, rng.normal(size=(users, d_u))[uid]))
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float64)
+    iu = np.arange(d_u, dtype=np.int32)
+    df = GameDataFrame(
+        num_samples=n, response=y,
+        feature_shards={"g": FeatureShard(Xg, d),
+                        "u": FeatureShard([(iu, Xu[i]) for i in range(n)], d_u)},
+        id_tags={"userId": [f"u{v}" for v in uid]})
+
+    def fit(opt_type, **kw):
+        opt = GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(optimizer_type=opt_type, **kw),
+            regularization=L2Regularization, regularization_weight=1.0)
+        est = GameEstimator(
+            TaskType.LOGISTIC_REGRESSION,
+            {"fixed": CoordinateConfiguration(
+                FixedEffectDataConfiguration("g"), opt),
+             "per_user": CoordinateConfiguration(
+                 RandomEffectDataConfiguration("userId", "u"), opt)},
+            update_sequence=["fixed", "per_user"], num_iterations=3,
+            dtype=np.float64)
+        res = est.fit(df)
+        return (np.asarray(res[-1].model["fixed"].model.coefficients.means),
+                np.asarray(res[-1].model["per_user"].coefficients))
+
+    f_newton, re_newton = fit(OptimizerType.NEWTON,
+                              max_iterations=30, tolerance=1e-12)
+    f_tron, re_tron = fit(OptimizerType.TRON,
+                          max_iterations=100, tolerance=1e-13)
+    np.testing.assert_allclose(f_newton, f_tron, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(re_newton, re_tron, rtol=1e-5, atol=1e-7)
