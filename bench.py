@@ -1455,6 +1455,182 @@ def config_fe_throughput(scale: float):
     }
 
 
+# --------------------------------------------------------------------------
+# config 8: billion-coefficient-shaped sparse model-parallel theta
+# --------------------------------------------------------------------------
+
+def _sparse_tp_child():
+    """Child-process body for config_sparse_tp (own process so the
+    8-virtual-device CPU mesh can be forced without touching the parent's
+    backend). Trains a d = 10^7 sparse logistic fixed effect with theta
+    RANGE-SHARDED over the mesh model axis (ops/features.ModelShardedSparse
+    — the TPU answer to the reference's partitioned PalDB index feeding
+    "hundreds of billions of coefficients", PalDBIndexMap.scala:43,
+    README.md:56), asserts each device holds exactly theta/P_model bytes,
+    and checks the solved coefficients against the replicated-theta
+    data-parallel solve of the SAME problem. Emits one JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # beats the axon sitecustomize
+    import jax.numpy as jnp
+
+    from photon_tpu.data.dataset import DataBatch
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.coordinate import FixedEffectCoordinate
+    from photon_tpu.ops import features as F
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.parallel import mesh as M
+    from photon_tpu.types import TaskType
+
+    assert jax.device_count() == 8, f"need 8 virtual devices, got {jax.device_count()}"
+    n, d, k = 200_000, 10_000_000, 16
+    rng = np.random.default_rng(17)
+    idx = rng.integers(0, d, size=(n, k), dtype=np.int64).astype(np.int32)
+    val = (rng.normal(size=(n, k)) / np.sqrt(k)).astype(np.float32)
+    # planted sparse truth so the solve has signal
+    w_true = np.zeros(d, np.float32)
+    hot = rng.choice(d, size=4096, replace=False)
+    w_true[hot] = rng.normal(size=4096).astype(np.float32)
+    margins = np.einsum("nk,nk->n", val, w_true[idx])
+    y = (rng.random(n) < 1.0 / (1.0 + np.exp(-margins))).astype(np.float32)
+    sf = F.SparseFeatures(jnp.asarray(idx), jnp.asarray(val))
+    batch = DataBatch(sf, jnp.asarray(y))
+
+    # tolerance 0 = both meshes run the identical 30 iterations, so the
+    # parity comparison sees pure layout/reduction-order effects, not
+    # stopping-rule noise (f32 value_tol at this scale is ~2 ulps of f)
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=30, tolerance=0.0),
+        regularization=L2Regularization, regularization_weight=1.0)
+
+    def fit(shape):
+        mesh = M.create_mesh(8, (M.DATA_AXIS, M.MODEL_AXIS), shape)
+        t0 = time.perf_counter()
+        coord = FixedEffectCoordinate(batch, d, "g",
+                                      TaskType.LOGISTIC_REGRESSION,
+                                      cfg, mesh=mesh)
+        ingest = time.perf_counter() - t0
+        model = coord.update_model(None, None)   # cold (compiles)
+        jax.block_until_ready(model.model.coefficients.means)
+        t0 = time.perf_counter()
+        model = coord.update_model(None, None)
+        jax.block_until_ready(model.model.coefficients.means)
+        warm = time.perf_counter() - t0
+        return coord, model, ingest, warm
+
+    coord_tp, m_tp, ingest_tp, warm_tp = fit((2, 4))    # theta over model=4
+    coord_dp, m_dp, _, warm_dp = fit((8, 1))            # replicated theta
+    assert coord_tp._model_sharded and not coord_dp._model_sharded
+
+    # memory proof: each device holds exactly theta/4 (model axis), and
+    # the ELL nonzeros are range-partitioned, never replicated
+    th0 = M.shard_coef_model_parallel(
+        jnp.zeros((d,), jnp.float32), coord_tp.mesh,
+        padded_dim=coord_tp._dim_padded)
+    per_dev = {s.data.nbytes for s in th0.addressable_shards}
+    assert per_dev == {th0.nbytes // 4}, per_dev
+
+    c_tp = np.asarray(m_tp.model.coefficients.means)
+    c_dp = np.asarray(m_dp.model.coefficients.means)
+    rel = float(np.linalg.norm(c_tp - c_dp) / max(np.linalg.norm(c_dp), 1e-30))
+    # parity gate on the OBJECTIVE: at d = 1e7 in f32 the ridge problem is
+    # hugely underdetermined and two solves that differ only in reduction
+    # order legitimately stop ~1e-3 apart in coefficient space while
+    # agreeing on the loss; exact coef parity (rtol 1e-7, f64) is pinned
+    # by tests/test_spmd.py at test scale
+    f_tp = float(np.asarray(coord_tp.last_result.value))
+    f_dp = float(np.asarray(coord_dp.last_result.value))
+    value_rel = abs(f_tp - f_dp) / max(abs(f_dp), 1e-30)
+    evals = int(np.asarray(coord_tp.last_result.num_fun_evals))
+
+    # exact-parity companion at a dtype that can express it: the same
+    # TP-vs-replicated comparison in f64 at d = 1e6 must agree to 1e-7
+    # (the d = 1e7 f32 runs above stall at the f32 progress floor along
+    # different reduction orders — floor-level agreement is the most f32
+    # can certify)
+    jax.config.update("jax_enable_x64", True)
+    n64, d64 = 50_000, 1_000_000
+    idx64 = rng.integers(0, d64, size=(n64, k), dtype=np.int64).astype(np.int32)
+    val64 = rng.normal(size=(n64, k)) / np.sqrt(k)
+    y64 = (rng.random(n64) < 0.5).astype(np.float64)
+    batch64 = DataBatch(F.SparseFeatures(jnp.asarray(idx64),
+                                         jnp.asarray(val64)),
+                        jnp.asarray(y64))
+
+    def fit64(shape):
+        mesh = M.create_mesh(8, (M.DATA_AXIS, M.MODEL_AXIS), shape)
+        coord = FixedEffectCoordinate(batch64, d64, "g",
+                                      TaskType.LOGISTIC_REGRESSION,
+                                      cfg, mesh=mesh)
+        return np.asarray(coord.update_model(None, None)
+                          .model.coefficients.means)
+
+    c64_tp, c64_dp = fit64((2, 4)), fit64((8, 1))
+    rel64 = float(np.linalg.norm(c64_tp - c64_dp)
+                  / max(np.linalg.norm(c64_dp), 1e-30))
+
+    # where replication actually breaks (the regime this path exists for):
+    # L-BFGS state = coef + grad + 2m history pairs (m=10) = 22 f32 copies
+    state_bytes = lambda dim: 22 * 4 * dim
+    v5e_hbm = 16 * 2**30
+    d_break = int(v5e_hbm / (22 * 4))
+    print(json.dumps({
+        "metric": "sparse_tp_nnz_per_sec",
+        "value": round(n * k * evals / warm_tp, 1),
+        "unit": "nnz/s",
+        "vs_baseline": 1.0,
+        "wallclock_warm_s": round(warm_tp, 2),
+        "wallclock_ingest_s": round(ingest_tp, 2),
+        "replicated_wallclock_s": round(warm_dp, 2),
+        "vs_replicated_wallclock": round(warm_dp / warm_tp, 3),
+        "dim": d, "nnz": n * k, "evals": evals,
+        "theta_bytes_per_device": int(th0.nbytes // 4),
+        "theta_bytes_total": int(th0.nbytes),
+        "coef_rel_err_vs_replicated": round(rel, 8),
+        "objective_rel_err_vs_replicated": round(value_rel, 10),
+        "f64_coef_rel_err_d1e6": round(rel64, 12),
+        "parity": bool(value_rel < 1e-3 and rel < 1e-2 and rel64 < 1e-7),
+        "mesh": "(data=2, model=4), 8 virtual CPU devices",
+        "replication_break_even": {
+            "lbfgs_state_bytes_at_this_d": state_bytes(d),
+            "v5e_hbm_bytes": v5e_hbm,
+            "d_where_replicated_lbfgs_exceeds_v5e_hbm": d_break,
+            "sharded_per_device_at_that_d_P8": state_bytes(d_break) // 8,
+        },
+        "note": ("scale-capability config: theta range-sharded via "
+                 "ModelShardedSparse (local ids, psum margins); virtual "
+                 "8-device mesh is the sanctioned multi-chip stand-in "
+                 "(single-chip relay). vs_baseline is self-referential — "
+                 "the bar is parity with replicated theta plus the "
+                 "per-device-bytes assertion; vs_replicated_wallclock "
+                 "records what the memory headroom costs in time"),
+    }))
+
+
+def config_sparse_tp(scale: float):
+    """Parent wrapper: run _sparse_tp_child in a subprocess with 8 virtual
+    CPU devices (VERDICT r4 item 4 — the d >= 1e7 regime the sparse-TP
+    capability exists for, measured)."""
+    del scale  # fixed shape: the dim IS the point
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    here = os.path.abspath(__file__)
+    r = subprocess.run([sys.executable, here, "--sparse-tp-child"],
+                       stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                       text=True, timeout=900, env=env)
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip().startswith("{")]
+    if r.returncode != 0 or not lines:
+        return {"metric": "sparse_tp_nnz_per_sec", "value": 0.0,
+                "unit": "nnz/s", "vs_baseline": 0.0,
+                "error": f"child rc={r.returncode}: {r.stderr[-400:]}"}
+    return json.loads(lines[-1])
+
+
 CONFIGS = [
     ("glmix_logistic", config_glmix_logistic),
     ("poisson_tron", config_poisson_tron),
@@ -1463,10 +1639,14 @@ CONFIGS = [
     ("heart_real", config_heart_real),
     ("a9a_real", config_a9a_real),
     ("fe_throughput", config_fe_throughput),
+    ("sparse_tp", config_sparse_tp),
 ]
 
 
 def main():
+    if "--sparse-tp-child" in sys.argv:
+        _sparse_tp_child()
+        return
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float,
                     default=float(os.environ.get("BENCH_SCALE", "1.0")))
