@@ -26,11 +26,32 @@ parse(PyObject *self, PyObject *args)
     if (!PyArg_ParseTuple(args, "y*|i", &buf, &zero_based))
         return NULL;
     const char *p = (const char *)buf.buf;
-    const char *end = p + buf.len;
+    /* strtod/strtol scan until a non-numeric byte; a number token ending
+     * exactly at the buffer end would let them read past it (the "y*"
+     * converter accepts bytearray/memoryview/mmap, which are NOT
+     * NUL-terminated). Guaranteeing a trailing '\n' bounds every scan
+     * inside the buffer: copy only when the last byte isn't already one. */
+    char *owned = NULL;
+    Py_ssize_t len = buf.len;
+    if (len == 0 || p[len - 1] != '\n') {
+        owned = (char *)malloc((size_t)len + 1);
+        if (!owned) {
+            PyBuffer_Release(&buf);
+            return PyErr_NoMemory();
+        }
+        memcpy(owned, p, (size_t)len);
+        owned[len] = '\n';
+        len += 1;
+        p = owned;
+    }
+    const char *end = p + len;
 
-    /* pass 1: count data lines and nonzeros (':' before any '#') */
+    /* pass 1: count data lines and nonzeros (':' before any '#').
+     * Both passes touch only raw buffers — the GIL is released so the
+     * Python side can fan chunks of one file across threads. */
     size_t nrows = 0, nnz = 0;
     int in_comment = 0, has_data = 0;
+    Py_BEGIN_ALLOW_THREADS
     for (const char *q = p; q < end; q++) {
         char c = *q;
         if (c == '\n') {
@@ -44,6 +65,7 @@ parse(PyObject *self, PyObject *args)
         }
     }
     if (has_data) nrows++;
+    Py_END_ALLOW_THREADS
 
     double  *labels = (double *)malloc(sizeof(double) * (nrows ? nrows : 1));
     int64_t *indptr = (int64_t *)malloc(sizeof(int64_t) * (nrows + 1));
@@ -51,6 +73,7 @@ parse(PyObject *self, PyObject *args)
     double  *vals   = (double *)malloc(sizeof(double) * (nnz ? nnz : 1));
     if (!labels || !indptr || !cols || !vals) {
         free(labels); free(indptr); free(cols); free(vals);
+        free(owned);
         PyBuffer_Release(&buf);
         return PyErr_NoMemory();
     }
@@ -59,6 +82,7 @@ parse(PyObject *self, PyObject *args)
     indptr[0] = 0;
     const char *q = p;
     int bad = 0;
+    Py_BEGIN_ALLOW_THREADS
     while (q < end && !bad) {
         /* find the line span, excluding any comment */
         const char *eol = memchr(q, '\n', (size_t)(end - q));
@@ -101,6 +125,8 @@ parse(PyObject *self, PyObject *args)
         indptr[r] = (int64_t)k;
         q = eol + 1;
     }
+    Py_END_ALLOW_THREADS
+    free(owned);
     PyBuffer_Release(&buf);
     if (bad || r != nrows) {
         free(labels); free(indptr); free(cols); free(vals);
