@@ -162,7 +162,10 @@ class FixedEffectCoordinate:
             batch, initial=init, dim=self.dim, dtype=batch.labels.dtype,
             # read the weight from the coordinate's (possibly sweep-updated)
             # config, not the problem's construction-time copy
-            regularization_weight=self.config.regularization_weight)
+            regularization_weight=self.config.regularization_weight,
+            # this coordinate's batch was sharded at construction; the
+            # pallas kernel must not trace over mesh-placed arrays
+            pallas_ok=self.mesh is None)
         from photon_tpu.optim.tracking import OptimizationStatesTracker
         self.last_result = result
         self.last_tracker = OptimizationStatesTracker.from_result(result)
@@ -296,9 +299,15 @@ class RandomEffectCoordinate:
                 flags.append(False)
                 continue
             idx = np.asarray(blk.features.indices)
-            val = np.asarray(blk.features.values)
             slot = np.broadcast_to(np.arange(k, dtype=idx.dtype), idx.shape)
-            flags.append(bool(np.all((val == 0) | (idx == slot))))
+            idx_ok = idx == slot
+            if idx_ok.all():
+                # the common from_dense layout: indices alone prove it —
+                # skip the device-to-host copy of the (much larger) values
+                flags.append(True)
+                continue
+            val = np.asarray(blk.features.values)
+            flags.append(bool(np.all((val == 0) | idx_ok)))
         return tuple(flags)
 
     @functools.cached_property

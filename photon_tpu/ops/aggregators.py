@@ -100,7 +100,7 @@ def value_and_gradient(
     import os
     if os.environ.get("PHOTON_TPU_PALLAS_GLM") == "1":
         from photon_tpu.ops import pallas_glm
-        if pallas_glm._supported(x, norm):
+        if pallas_glm._supported(x, norm, coef):
             return pallas_glm.fused_dense_value_grad(
                 loss, x, labels, offsets, weights, coef)
     dim = coef.shape[0]

@@ -28,6 +28,8 @@ fused margin/loss/grad algebra, minus the normalization prefactors).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import functools
 from typing import Optional, Tuple
 
@@ -38,22 +40,46 @@ Array = jax.Array
 
 _TILE_N = 1024
 
+# trace-time kill switch: pallas_call carries no sharding annotations, so
+# a mesh-sharded SPMD solve must never pick the kernel up (it would force
+# replication of X or fail at lowering). GlmOptimizationProblem wraps
+# mesh solves in ``disabled()``; the flag is a ContextVar so it binds at
+# TRACE time, exactly like the env flag it refines.
+_TRACE_DISABLED = contextvars.ContextVar("pallas_glm_disabled",
+                                         default=False)
 
-def _supported(x, norm) -> bool:
-    """Dense 2D f32 features, identity normalization, NOT under vmap —
-    the kernel's sequential-grid accumulation (init on program_id 0,
-    += into a revisited output block) assumes it owns the whole grid,
-    which a batching transform breaks (the random-effect path vmaps the
-    objective over dense-local entity blocks)."""
+
+@contextlib.contextmanager
+def disabled():
+    token = _TRACE_DISABLED.set(True)
+    try:
+        yield
+    finally:
+        _TRACE_DISABLED.reset(token)
+
+
+def _supported(x, norm, coef) -> bool:
+    """Dense 2D f32 features AND f32 coefficients, identity
+    normalization, NOT under vmap, NOT inside a ``disabled()`` (mesh)
+    region. The vmap exclusion: the kernel's sequential-grid accumulation
+    (init on program_id 0, += into a revisited output block) assumes it
+    owns the whole grid, which a batching transform breaks (the
+    random-effect path vmaps the objective over dense-local entity
+    blocks). The coef-dtype exclusion: an f64 solve over f32 features
+    promotes in the XLA path, while the kernel would silently return f32
+    and break the while_loop carry dtype at trace time."""
+    if _TRACE_DISABLED.get():
+        return False
     try:
         from jax.interpreters.batching import BatchTracer
-        if isinstance(x, BatchTracer):
+        if isinstance(x, BatchTracer) or isinstance(coef, BatchTracer):
             return False
     except ImportError:  # pragma: no cover — jax internals moved
         if type(x).__name__ == "BatchTracer":
             return False
     return (isinstance(x, jax.Array) and x.ndim == 2
-            and x.dtype == jnp.float32 and norm.is_identity)
+            and x.dtype == jnp.float32 and coef.dtype == jnp.float32
+            and norm.is_identity)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 5, 6))

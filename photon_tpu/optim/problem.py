@@ -169,8 +169,14 @@ class GlmOptimizationProblem:
 
     # -- solving ------------------------------------------------------------
 
-    @functools.cached_property
+    @property
     def _solve_fn(self):
+        """Default solve (non-mesh callers / HLO inspection in tests)."""
+        import os
+        return self._solve_fn_for(
+            os.environ.get("PHOTON_TPU_PALLAS_GLM") == "1")
+
+    def _solve_fn_for(self, use_pallas: bool):
         opt = self.config.optimizer
         solver_cfg = opt.solver_config()
         obj = self.objective
@@ -249,9 +255,12 @@ class GlmOptimizationProblem:
             return jax.jit(solve)
 
         # share the compiled solve across problem instances with identical
-        # trace-shaping state (re-fits, sweep candidates, fresh estimators)
+        # trace-shaping state (re-fits, sweep candidates, fresh
+        # estimators). use_pallas is trace-shaping too: a mesh solve and
+        # a single-device solve with the flag set must not share a trace
+        # (the kernel carries no sharding annotations).
         key = ("glm_solve", self.task, solver_cache_key(opt),
-               norm_cache_key(self.objective.norm))
+               norm_cache_key(self.objective.norm), use_pallas)
         return jitcache.get_or_build(key, build)
 
     def run(
@@ -262,6 +271,7 @@ class GlmOptimizationProblem:
         dtype=None,
         regularization_weight: Optional[float] = None,
         mesh=None,
+        pallas_ok: Optional[bool] = None,
     ) -> Tuple[GeneralizedLinearModel, SolverResult]:
         """Solve and return (model, solver stats). Variances are computed
         separately via ``compute_variances`` (reference behavior: variances
@@ -292,7 +302,21 @@ class GlmOptimizationProblem:
                if regularization_weight is None else regularization_weight)
         l2 = jnp.asarray(self.config.regularization.l2_weight(lam), initial.dtype)
         l1 = jnp.asarray(self.config.regularization.l1_weight(lam), initial.dtype)
-        result = self._solve_fn(initial, batch, l2, l1)
+        import os
+        flag = os.environ.get("PHOTON_TPU_PALLAS_GLM") == "1"
+        # mesh here OR a caller-declared sharded batch (FixedEffect
+        # Coordinate pre-shards at construction and passes pallas_ok=False)
+        use_pallas = flag and mesh is None and pallas_ok is not False
+        solve = self._solve_fn_for(use_pallas)
+        if flag and not use_pallas:
+            # the fused kernel has no sharding annotations: under a mesh
+            # it would force replication of X or fail at lowering, so the
+            # SPMD solve traces with the kernel hard-disabled
+            from photon_tpu.ops import pallas_glm
+            with pallas_glm.disabled():
+                result = solve(initial, batch, l2, l1)
+        else:
+            result = solve(initial, batch, l2, l1)
         coef = result.coef
         if not norm.is_identity:
             coef = norm.transformed_space_to_model(coef, self.intercept_index)

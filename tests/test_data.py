@@ -94,7 +94,15 @@ def test_native_libsvm_parser_parity(tmp_path, monkeypatch):
     """The C LibSVM tokenizer (native/libsvmdec.c) must be byte-equivalent
     to the Python parser — labels, dims, ELL materialization — including
     comments, blank lines, and zero-based indexing; malformed input
-    raises rather than truncating."""
+    raises rather than truncating.
+
+    Known grammar divergence (explicit contract, ADVICE r4): on EXOTIC
+    numeric literals the two parsers differ — C strtod accepts hex floats
+    ("0x1p-2") and inf/nan spellings that Python float() rejects, while
+    Python float() accepts underscore separators ("1_0") that strtod
+    truncates at. No LibSVM writer emits either form; files that do are
+    outside the format and may parse differently depending on which
+    parser a machine has available."""
     import numpy as np
 
     from photon_tpu import native

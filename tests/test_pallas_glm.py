@@ -18,6 +18,8 @@ from photon_tpu.ops.losses import LogisticLoss, PoissonLoss, SquaredLoss
 from photon_tpu.ops.normalization import no_normalization
 from photon_tpu.ops.pallas_glm import fused_dense_value_grad
 
+_IDN = no_normalization()
+
 
 @pytest.fixture
 def problem():
@@ -175,3 +177,62 @@ def test_flag_does_not_break_vmapped_re_solves(monkeypatch):
     jitcache.clear()
     np.testing.assert_allclose(c_on, c_off, rtol=1e-6, atol=1e-7)
     assert np.all(np.isfinite(c_on))
+
+
+def test_flag_mesh_solve_gated_off(monkeypatch, devices8):
+    """ADVICE r4: with PHOTON_TPU_PALLAS_GLM=1, a mesh-sharded SPMD solve
+    must NOT trace the kernel (pallas_call has no sharding annotations) —
+    the solve runs the XLA path, matches the flag-off result, and the
+    single-device solve with the flag still uses its own (separate) cache
+    entry."""
+    import jax
+
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        GlmOptimizationProblem,
+        OptimizerConfig,
+    )
+    from photon_tpu.parallel import mesh as M
+    from photon_tpu.types import TaskType
+    from photon_tpu.utils import jitcache
+
+    rng = np.random.default_rng(5)
+    n, d = 256, 16
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    batch = DataBatch(jnp.asarray(X), jnp.asarray(y))
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-8),
+        regularization=L2Regularization, regularization_weight=1.0)
+    mesh = M.create_mesh(8, (M.DATA_AXIS,), (8,))
+
+    def run_mesh():
+        prob = GlmOptimizationProblem(TaskType.LOGISTIC_REGRESSION, cfg)
+        m, _ = prob.run(batch, dim=d, dtype=jnp.float32, mesh=mesh)
+        return np.asarray(m.coefficients.means)
+
+    jitcache.clear()
+    c_off = run_mesh()
+    monkeypatch.setenv("PHOTON_TPU_PALLAS_GLM", "1")
+    jitcache.clear()
+    c_on = run_mesh()
+    # bitwise: the same (XLA) trace must have been used
+    np.testing.assert_array_equal(c_on, c_off)
+    # and the kernel is hard-disabled at trace time inside disabled()
+    from photon_tpu.ops import pallas_glm
+    with pallas_glm.disabled():
+        assert not pallas_glm._supported(
+            jnp.zeros((8, 4), jnp.float32), _IDN, jnp.zeros(4, jnp.float32))
+    jitcache.clear()
+
+
+def test_supported_rejects_f64_coef():
+    """ADVICE r4: an f64 solve over f32 features must not take the fused
+    path (it would silently return f32 and break the while_loop carry
+    dtype); the XLA path promotes instead."""
+    from photon_tpu.ops import pallas_glm
+
+    x = jnp.zeros((8, 4), jnp.float32)
+    assert pallas_glm._supported(x, _IDN, jnp.zeros(4, jnp.float32))
+    assert not pallas_glm._supported(x, _IDN, jnp.zeros(4, jnp.float64))
