@@ -42,6 +42,8 @@ from photon_tpu.data.dataset import DataBatch
 from photon_tpu.ops import features as F
 
 DATA_AXIS = "data"
+# cross-slice (DCN) factor of a two-level data axis; see staged_psum
+DCN_AXIS = "dcn"
 ENTITY_AXIS = "entity"
 MODEL_AXIS = "model"
 
@@ -114,6 +116,38 @@ def create_pod_mesh(
 def replicated(mesh: Mesh) -> NamedSharding:
     """Fully replicated (the broadcast-variable equivalent)."""
     return NamedSharding(mesh, P())
+
+
+def create_two_level_mesh(
+    n_devices: int,
+    dcn_factor: int,
+    model_axis_size: int = 1,
+    axis_names: Sequence[str] = (DCN_AXIS, DATA_AXIS, MODEL_AXIS),
+) -> Mesh:
+    """(dcn, data, model) mesh: the data dimension split into a cross-
+    slice (DCN) factor and a within-slice (ICI) factor. Gradient
+    reductions staged with ``staged_psum`` then ride ICI first and cross
+    DCN once — the reference's treeAggregateDepth>1 two-stage aggregation
+    (GameEstimator.scala:100) as mesh layout. On real pods, pass device
+    order from ``mesh_utils.create_hybrid_device_mesh`` so the dcn axis
+    aligns with actual slice boundaries; virtually (CPU) any order
+    demonstrates the staged collective structure."""
+    assert n_devices % (dcn_factor * model_axis_size) == 0, \
+        (n_devices, dcn_factor, model_axis_size)
+    data = n_devices // (dcn_factor * model_axis_size)
+    devices = np.array(jax.devices()[:n_devices]).reshape(
+        dcn_factor, data, model_axis_size)
+    return Mesh(devices, tuple(axis_names))
+
+
+def staged_psum(x, ici_axis: str = DATA_AXIS, dcn_axis: str = DCN_AXIS):
+    """Two-stage all-reduce for shard_map bodies on a two-level mesh:
+    reduce within the slice (ICI) first, then across slices (DCN) — one
+    collective per stage with replica groups aligned to each axis (the
+    treeAggregateDepth>1 analog; reference: GameEstimator.scala:100,
+    treeAggregate depth on the gradient RDD). Equal to a single psum
+    over both axes; the staging is the communication-topology win."""
+    return jax.lax.psum(jax.lax.psum(x, ici_axis), dcn_axis)
 
 
 def axis_size(mesh: Mesh, axis: str) -> int:

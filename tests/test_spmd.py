@@ -498,3 +498,43 @@ def test_model_axis_explicit_hessian_tron_parity():
     c_dp = solve(mesh_dp, model_par=False)
     c_tp = solve(mesh_tp, model_par=True)
     np.testing.assert_allclose(c_tp, c_dp, rtol=1e-8, atol=1e-10)
+
+
+def test_dcn_staged_psum_two_collectives(rng, devices8):
+    """treeAggregateDepth>1 analog (GameEstimator.scala:100): on a
+    (dcn, data, model) two-level mesh, staged_psum reduces the gradient
+    with TWO collectives — replica groups within the slice first, then
+    across slices — and equals the flat joint-axis reduction."""
+    from jax.sharding import NamedSharding
+
+    mesh = M.create_two_level_mesh(8, dcn_factor=2, model_axis_size=2)
+    assert mesh.shape == {"dcn": 2, "data": 2, "model": 2}
+    n, d = 48, 10
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    r = rng.normal(size=n).astype(np.float32)
+    spec_x = P((M.DCN_AXIS, M.DATA_AXIS), None)
+    spec_r = P((M.DCN_AXIS, M.DATA_AXIS))
+    Xs = jax.device_put(jnp.asarray(X), NamedSharding(mesh, spec_x))
+    rs = jax.device_put(jnp.asarray(r), NamedSharding(mesh, spec_r))
+
+    staged = jax.jit(jax.shard_map(
+        lambda xb, rb: M.staged_psum(xb.T @ rb),
+        mesh=mesh, in_specs=(spec_x, spec_r), out_specs=P()))
+    flat = jax.jit(jax.shard_map(
+        lambda xb, rb: jax.lax.psum(xb.T @ rb, (M.DCN_AXIS, M.DATA_AXIS)),
+        mesh=mesh, in_specs=(spec_x, spec_r), out_specs=P()))
+
+    np.testing.assert_allclose(np.asarray(staged(Xs, rs)), X.T @ r,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(staged(Xs, rs)),
+                               np.asarray(flat(Xs, rs)), rtol=1e-6)
+
+    # structure: two distinct all-reduce ops, replica groups of size 2
+    # each (stage 1: the data pairs, stage 2: the dcn pairs) — vs the
+    # flat reduction's single size-4 groups
+    hlo = staged.lower(Xs, rs).compile().as_text()
+    ars = [l for l in hlo.splitlines() if "all-reduce(" in l]
+    assert len(ars) >= 2, hlo
+    hlo_flat = flat.lower(Xs, rs).compile().as_text()
+    ars_flat = [l for l in hlo_flat.splitlines() if "all-reduce(" in l]
+    assert len(ars_flat) == 1
