@@ -198,6 +198,13 @@ class GlmOptimizationProblem:
                     # explicit Hessian via the curvature-weights split: one
                     # weighted-Gram MXU contraction per outer iteration
                     # (same operator TRON's explicit gate builds)
+                    from photon_tpu.ops.features import ModelShardedSparse
+                    if isinstance(batch.features, ModelShardedSparse):
+                        raise ValueError(
+                            "NEWTON builds an explicit d x d Hessian, "
+                            "which contradicts model-axis sharding of a "
+                            "sparse theta; use LBFGS or TRON (matrix-"
+                            "free) for this coordinate")
                     from photon_tpu.optim import newton
                     dim = x0.shape[0]
                     if opt.explicit_hessian is not True and dim > 8192:
