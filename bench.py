@@ -1459,6 +1459,43 @@ def config_fe_throughput(scale: float):
         log(f"fe_throughput bf16 storage: {warm16:.2f}s, {evals16} evals "
             f"({per_eval_speedup:.2f}x per-eval vs f32 storage), "
             f"coef rel err {rel:.1e}")
+        # combined arm: bf16 storage THROUGH the fused kernel — the two
+        # HBM levers (single pass + half-width reads) should stack to a
+        # theoretical 4x over the two-pass f32 baseline
+        if "pallas_error" not in pallas_arm:
+            try:
+                os.environ["PHOTON_TPU_PALLAS_GLM"] = "1"
+                _jc.clear()
+                prob_pb = GlmOptimizationProblem(
+                    TaskType.LOGISTIC_REGRESSION, cfg)
+                mpb, rpb = prob_pb.run(batch16, dim=d, dtype=jnp.float32)
+                jax.block_until_ready(mpb.coefficients.means)
+                t0 = time.perf_counter()
+                mpb, rpb = prob_pb.run(batch16, dim=d, dtype=jnp.float32)
+                jax.block_until_ready(mpb.coefficients.means)
+                warm_pb = time.perf_counter() - t0
+                evals_pb = int(np.asarray(rpb.num_fun_evals))
+                relb = float(np.linalg.norm(
+                    np.asarray(mpb.coefficients.means) - coef_f32)
+                    / max(np.linalg.norm(coef_f32), 1e-30))
+                bf16.update({
+                    "wallclock_warm_pallas_bf16_s": round(warm_pb, 3),
+                    "pallas_bf16_speedup_per_eval": round(
+                        (warm / evals) / (warm_pb / evals_pb), 2),
+                    "achieved_bandwidth_pallas_bf16_gb_s": round(
+                        evals_pb * 1.0 * n * d * 2 / warm_pb / 1e9, 1),
+                    "pallas_bf16_coef_rel_err": round(relb, 5),
+                })
+                log(f"fe_throughput pallas+bf16: {warm_pb:.2f}s, "
+                    f"{evals_pb} evals "
+                    f"({(warm / evals) / (warm_pb / evals_pb):.2f}x "
+                    f"per-eval vs two-pass f32)")
+            except Exception as e:  # opt-in combo: report, don't fail
+                bf16["pallas_bf16_error"] = repr(e)
+                log(f"fe_throughput pallas+bf16 arm failed: {e!r}")
+            finally:
+                os.environ.pop("PHOTON_TPU_PALLAS_GLM", None)
+                _jc.clear()
     return {
         **bf16,
         **pallas_arm,

@@ -78,7 +78,8 @@ def _supported(x, norm, coef) -> bool:
         if type(x).__name__ == "BatchTracer":
             return False
     return (isinstance(x, jax.Array) and x.ndim == 2
-            and x.dtype == jnp.float32 and coef.dtype == jnp.float32
+            and x.dtype in (jnp.float32, jnp.bfloat16)
+            and coef.dtype == jnp.float32
             and norm.is_identity)
 
 
@@ -98,16 +99,20 @@ def _fused(loss_and_dz, x, labels, offsets, weights, tile_n: int,
             grad_ref[:] = jnp.zeros_like(grad_ref)
 
         # one MXU pass for margins; the tile of X stays in VMEM for the
-        # gradient contraction below — HBM reads X exactly once
+        # gradient contraction below — HBM reads X exactly once. bf16
+        # feature storage composes: the tile is read at half the bytes
+        # and the MXU accumulates in f32 (preferred_element_type).
         m = jnp.dot(x_ref[:], coef_ref[:],
                     preferred_element_type=jnp.float32)       # [T, 1]
         z = m + off_ref[:]
         l, dz = loss_and_dz(z, y_ref[:])
         w = w_ref[:]
         val_ref[0, 0] += jnp.sum(l * w)
-        # grad += X_tile^T (w * dz): contract over the row axis
+        # grad += X_tile^T (w * dz): contract over the row axis. The
+        # VMEM-resident tile upcasts in-register for bf16 storage
+        # (lax.dot_general is strict about operand dtypes).
         grad_ref[:] += jax.lax.dot_general(
-            x_ref[:], w * dz,
+            x_ref[:].astype(jnp.float32), w * dz,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # [D, 1]
 
