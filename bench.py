@@ -1695,14 +1695,20 @@ def config_sparse_tp(scale: float):
     return json.loads(lines[-1])
 
 
+# Order = on-chip capture priority (each config emits its JSON line the
+# moment it completes, so when the flaky relay dies mid-run the most
+# decision-relevant numbers are already on disk): the NEWTON flagship,
+# the DIRECT multi-RE, the real-data parity fix, the Pallas/bf16 A/B
+# arms, then the rest. sparse_tp runs in a CPU subprocess regardless and
+# goes last.
 CONFIGS = [
     ("glmix_logistic", config_glmix_logistic),
-    ("poisson_tron", config_poisson_tron),
     ("glmix_multi_re", config_glmix_multi_re),
-    ("svm_bayesian", config_svm_bayesian),
     ("heart_real", config_heart_real),
-    ("a9a_real", config_a9a_real),
     ("fe_throughput", config_fe_throughput),
+    ("poisson_tron", config_poisson_tron),
+    ("a9a_real", config_a9a_real),
+    ("svm_bayesian", config_svm_bayesian),
     ("sparse_tp", config_sparse_tp),
 ]
 
