@@ -19,7 +19,7 @@ from photon_tpu.optim.problem import (
     GLMOptimizationConfiguration,
     OptimizerConfig,
 )
-from photon_tpu.types import TaskType
+from photon_tpu.types import OptimizerType, TaskType
 
 
 def _frame(rng, n=600, d=12, users=8, d_u=3):
@@ -36,9 +36,10 @@ def _frame(rng, n=600, d=12, users=8, d_u=3):
         id_tags={"userId": [str(v) for v in uid]})
 
 
-def _estimator(down_sampling_rate=1.0):
+def _estimator(down_sampling_rate=1.0, optimizer_type=None):
+    kw = {} if optimizer_type is None else {"optimizer_type": optimizer_type}
     opt = GLMOptimizationConfiguration(
-        optimizer=OptimizerConfig(max_iterations=30, tolerance=1e-9),
+        optimizer=OptimizerConfig(max_iterations=30, tolerance=1e-9, **kw),
         regularization=L2Regularization, regularization_weight=1.0,
         down_sampling_rate=down_sampling_rate)
     return GameEstimator(
@@ -51,23 +52,28 @@ def _estimator(down_sampling_rate=1.0):
         dtype=jnp.float64)
 
 
-@pytest.mark.parametrize("down_sampling_rate", [1.0, 0.7])
-def test_kill_and_resume_bitwise_equal(rng, tmp_path, down_sampling_rate):
+# the NEWTON case pins the new batched-IRLS solver to the same bitwise
+# kill/resume contract as the default solver (SURVEY §5.3)
+@pytest.mark.parametrize("down_sampling_rate,opt_type",
+                         [(1.0, None), (0.7, None),
+                          (1.0, OptimizerType.NEWTON)])
+def test_kill_and_resume_bitwise_equal(rng, tmp_path, down_sampling_rate,
+                                       opt_type):
     df = _frame(rng)
     ckdir = str(tmp_path / "ck")
 
     # uninterrupted 4-sweep run (no checkpointing involved)
-    full = _estimator(down_sampling_rate).fit(df)[-1].model
+    full = _estimator(down_sampling_rate, opt_type).fit(df)[-1].model
 
     # "killed" run: only 2 of 4 sweeps, checkpointing each
-    killed = _estimator(down_sampling_rate)
+    killed = _estimator(down_sampling_rate, opt_type)
     killed.num_iterations = 2
     killed.fit(df, checkpoint_dir=ckdir)
     state = ckpt.load_latest(str(tmp_path / "ck" / "config_000"))
     assert state is not None and state.sweep == 1
 
     # fresh process-equivalent: new estimator resumes and finishes
-    resumed = _estimator(down_sampling_rate)
+    resumed = _estimator(down_sampling_rate, opt_type)
     res = resumed.fit(df, checkpoint_dir=ckdir, resume=True)[-1].model
 
     for cid in ("fixed", "per_user"):
