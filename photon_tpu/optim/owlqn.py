@@ -102,23 +102,35 @@ def minimize(
         pgnorm = jnp.linalg.norm(c.pg)
         step0 = jnp.where(first, jnp.minimum(1.0, 1.0 / jnp.maximum(pgnorm, 1e-12)), 1.0)
 
-        # orthant-projected backtracking Armijo line search
+        # orthant-projected backtracking Armijo line search. Flat-exit
+        # guard (same floor problem linesearch.wolfe solves with
+        # approximate-Wolfe acceptance): when a trial lands within
+        # machine rounding of f after at least one halving, further
+        # halvings can only get flatter — stop probing instead of
+        # burning linesearch_max_iterations full data passes. The exit
+        # keeps ok=False, so the improvement gate below still classifies
+        # the iterate as not-improving (the honest terminal state).
+        slack = 8.0 * jnp.finfo(dtype).eps * jnp.abs(c.f)
+
         def ls_cond(s):
-            alpha, f_new, _x, _g, k, ok = s
-            return (~ok) & (k < config.linesearch_max_iterations)
+            alpha, f_new, _x, _g, k, ok, stop = s
+            return (~stop) & (k < config.linesearch_max_iterations)
 
         def ls_body(s):
-            alpha, _f, _x, _g, k, _ok = s
+            alpha, _f, _x, _g, k, _ok, _stop = s
             alpha = jnp.where(k == 0, alpha, alpha * 0.5)
             x_new = _project_orthant(c.x + alpha * direction, orthant)
             f_s, g_new = value_and_grad(x_new, *args)
             f_new = full_value(x_new, f_s)
             ok = f_new <= c.f + c1 * jnp.dot(c.pg, x_new - c.x)
-            return alpha, f_new, x_new, g_new, k + 1, ok
+            flat = (~ok) & (k >= 1) & (jnp.abs(f_new - c.f) <= slack)
+            return alpha, f_new, x_new, g_new, k + 1, ok, ok | flat
 
         init_ls = (step0.astype(dtype), c.f, c.x, c.g,
-                   jnp.asarray(0, jnp.int32), jnp.asarray(False))
-        _alpha, f_new, x_new, g_new, k, ok = lax.while_loop(ls_cond, ls_body, init_ls)
+                   jnp.asarray(0, jnp.int32), jnp.asarray(False),
+                   jnp.asarray(False))
+        _alpha, f_new, x_new, g_new, k, ok, _ = lax.while_loop(
+            ls_cond, ls_body, init_ls)
 
         decreased = ok & (f_new < c.f)
         x_kept = jnp.where(decreased, x_new, c.x)
