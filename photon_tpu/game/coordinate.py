@@ -87,12 +87,19 @@ class FixedEffectCoordinate:
                 # bigger than one chip's HBM (SURVEY §5.7). Dense: X placed
                 # P(data, model), theta P(model); XLA turns the partial
                 # dots of matvec/rmatvec into all-reduces over the model
-                # axis. Sparse (ELL): nonzeros are re-partitioned at ingest
-                # into per-feature-range blocks with LOCAL ids — the
-                # billion-coefficient workload the reference serves with
-                # partitioned PalDB indexes (PalDBIndexMap.scala:43) —
-                # and margins/gradients psum over model/data axes via
-                # shard_map (ops/features.ModelShardedSparse).
+                # axis. Sparse: nonzeros are re-partitioned at ingest into
+                # per-feature-range blocks with LOCAL ids — the billion-
+                # coefficient workload the reference serves with
+                # partitioned PalDB indexes (PalDBIndexMap.scala:43) — in a
+                # DUAL layout: ELL rows for the margin gather (matvec) and
+                # a column-sorted CSC plan for contiguous-segment gradient
+                # reductions (rmatvec), built once here at construction
+                # (ops/features.ModelShardedSparse; mesh.shard_sparse_
+                # features_model_parallel). Margins/gradients psum over the
+                # model/data axes via shard_map, staging the gradient
+                # all-reduce ICI-then-DCN on a two-level mesh, and the
+                # L-BFGS solve itself runs margin-resident
+                # (optim/lbfgs.minimize_directional via problem.run).
                 if isinstance(batch.features, F.SparseFeatures):
                     if self.variance_type == VarianceComputationType.FULL:
                         raise ValueError(

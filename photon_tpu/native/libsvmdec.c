@@ -26,25 +26,48 @@ parse(PyObject *self, PyObject *args)
     if (!PyArg_ParseTuple(args, "y*|i", &buf, &zero_based))
         return NULL;
     const char *p = (const char *)buf.buf;
+    Py_ssize_t len = buf.len;
+    const char *end = p + len;
     /* strtod/strtol scan until a non-numeric byte; a number token ending
      * exactly at the buffer end would let them read past it (the "y*"
      * converter accepts bytearray/memoryview/mmap, which are NOT
-     * NUL-terminated). Guaranteeing a trailing '\n' bounds every scan
-     * inside the buffer: copy only when the last byte isn't already one. */
+     * NUL-terminated). Every line that ends in '\n' is already bounded
+     * inside the original buffer, so the token-parsing pass walks the
+     * input as up to two segments: the buffer up to (and including) its
+     * last '\n', then — only when the blob lacks a trailing newline — a
+     * SMALL owned copy of just the final partial line with a '\n'
+     * appended. The previous implementation duplicated the entire blob
+     * for that one missing byte (2x peak RSS on a multi-GB mmap). */
+    const char *last_nl = NULL;
+    for (const char *t = end; t > p; ) {
+        t--;
+        if (*t == '\n') { last_nl = t; break; }
+    }
+    size_t safe_len = last_nl ? (size_t)(last_nl - p) + 1 : 0;
+    size_t tail_len = (size_t)len - safe_len;
     char *owned = NULL;
-    Py_ssize_t len = buf.len;
-    if (len == 0 || p[len - 1] != '\n') {
-        owned = (char *)malloc((size_t)len + 1);
+    if (tail_len) {
+        owned = (char *)malloc(tail_len + 1);
         if (!owned) {
             PyBuffer_Release(&buf);
             return PyErr_NoMemory();
         }
-        memcpy(owned, p, (size_t)len);
-        owned[len] = '\n';
-        len += 1;
-        p = owned;
+        memcpy(owned, p + safe_len, tail_len);
+        owned[tail_len] = '\n';
     }
-    const char *end = p + len;
+    const char *segs[2];
+    const char *seg_ends[2];
+    int nsegs = 0;
+    if (safe_len) {
+        segs[nsegs] = p;
+        seg_ends[nsegs] = p + safe_len;
+        nsegs++;
+    }
+    if (owned) {
+        segs[nsegs] = owned;
+        seg_ends[nsegs] = owned + tail_len + 1;
+        nsegs++;
+    }
 
     /* pass 1: count data lines and nonzeros (':' before any '#').
      * Both passes touch only raw buffers — the GIL is released so the
@@ -80,13 +103,16 @@ parse(PyObject *self, PyObject *args)
 
     size_t r = 0, k = 0;
     indptr[0] = 0;
-    const char *q = p;
     int bad = 0;
     Py_BEGIN_ALLOW_THREADS
-    while (q < end && !bad) {
-        /* find the line span, excluding any comment */
-        const char *eol = memchr(q, '\n', (size_t)(end - q));
-        if (!eol) eol = end;
+    for (int s = 0; s < nsegs && !bad; s++) {
+    const char *q = segs[s];
+    const char *seg_end = seg_ends[s];
+    while (q < seg_end && !bad) {
+        /* find the line span, excluding any comment; every segment ends
+         * with '\n', so the scan below never leaves the segment */
+        const char *eol = memchr(q, '\n', (size_t)(seg_end - q));
+        if (!eol) eol = seg_end;
         const char *stop = memchr(q, '#', (size_t)(eol - q));
         if (!stop) stop = eol;
         /* skip leading whitespace */
@@ -124,6 +150,7 @@ parse(PyObject *self, PyObject *args)
         r++;
         indptr[r] = (int64_t)k;
         q = eol + 1;
+    }
     }
     Py_END_ALLOW_THREADS
     free(owned);

@@ -115,6 +115,55 @@ def value_and_gradient(
     return value, grad
 
 
+def _weighted_loss_and_dz(
+    loss: PointwiseLoss,
+    labels: Array,
+    weights: Optional[Array],
+    margins: Array,
+) -> Tuple[Array, Array]:
+    l, dz = loss.loss_and_dz(margins, labels)
+    if weights is not None:
+        l = l * weights
+        dz = dz * weights
+    return jnp.sum(l), dz
+
+
+def margin_value_and_gradient(
+    loss: PointwiseLoss,
+    x: FeatureMatrix,
+    labels: Array,
+    weights: Optional[Array],
+    margins: Array,
+    norm: NormalizationContext,
+    dim: int,
+) -> Tuple[Array, Array]:
+    """``value_and_gradient`` at a point whose margins are already resident.
+
+    Skips the matvec a classic evaluation would pay: the margin-resident
+    L-BFGS path (optim/lbfgs.minimize_directional) keeps margins updated
+    affinely across iterations, so a full evaluation at the accepted point
+    is ONE rmatvec over the feature nnz instead of two passes."""
+    value, dz = _weighted_loss_and_dz(loss, labels, weights, margins)
+    grad = _apply_factor_and_shift(rmatvec(x, dz, dim), jnp.sum(dz), norm)
+    return value, grad
+
+
+def margin_trial(
+    loss: PointwiseLoss,
+    labels: Array,
+    weights: Optional[Array],
+    margins: Array,
+    dir_margins: Array,
+    step: Array,
+) -> Tuple[Array, Array]:
+    """(phi(a), phi'(a)) of the data term's 1-D restriction along a
+    direction whose margins are precomputed: margins are linear in coef,
+    so a trial point is O(n_samples) pointwise work — no feature pass."""
+    value, dz = _weighted_loss_and_dz(
+        loss, labels, weights, margins + step * dir_margins)
+    return value, jnp.dot(dz, dir_margins)
+
+
 def hessian_weights(
     loss: PointwiseLoss,
     x: FeatureMatrix,

@@ -119,6 +119,7 @@ def convergence_reason(
     tols: Tolerances,
     max_iterations: int,
     improved: Optional[Array] = None,
+    gnorm: Optional[Array] = None,
 ) -> Array:
     """Priority-ordered convergence decision, matching the reference order
     MaxIterations -> FunctionValuesConverged -> GradientConverged
@@ -131,8 +132,13 @@ def convergence_reason(
     iterate as ObjectiveNotImproving before checking function values
     (Optimizer.scala:140-142); here the solver's own failure counting
     handles that, so the function-values check is simply gated off.
+
+    ``gnorm`` lets a solver that already holds g . g (e.g. the Gram-based
+    directional L-BFGS) pass ||g|| in instead of paying one more full pass
+    over a sharded 10^7-dim gradient here.
     """
-    gnorm = jnp.linalg.norm(g)
+    if gnorm is None:
+        gnorm = jnp.linalg.norm(g)
     f_conv = jnp.abs(f_prev - f) <= tols.value_tol
     if improved is not None:
         f_conv = f_conv & improved
@@ -156,6 +162,21 @@ def convergence_reason(
 # (second order) hv(x, v, data, hyper) -> Hv.
 ValueAndGrad = Callable[..., Tuple[Array, Array]]
 HessVec = Callable[..., Array]
+
+
+def jit_donating(fn, donate_argnums=(0,)):
+    """``jax.jit`` with solver-state buffers donated on accelerator backends.
+
+    Donating x0 lets XLA alias the initial coefficients straight into the
+    while-loop carry instead of round-tripping a fresh HBM buffer per
+    solve — at model-sharded scale that buffer is the full per-device θ
+    shard. The CPU backend ignores donation (and warns about it), so the
+    gate keeps host runs quiet; callers must still never hand a donated
+    position a caller-owned array they intend to reuse (see
+    GlmOptimizationProblem.run's defensive copy for warm starts)."""
+    if jax.default_backend() == "cpu":
+        return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=donate_argnums)
 
 
 def project_box(x: Array, config: SolverConfig) -> Array:

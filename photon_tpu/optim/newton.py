@@ -114,9 +114,15 @@ def minimize(
         direction = jnp.where(newton_ok, step, -c.g)
         t, f_new, g_new, ls_evals, accepted = linesearch(
             c.x, c.f, c.g, direction)
-        x_new = jnp.where(accepted, c.x + t * direction, c.x)
-        f_new = jnp.where(accepted, f_new, c.f)
-        g_new = jnp.where(accepted, g_new, c.g)
+        # the slack is a CLASSIFICATION device only: a step it admits with
+        # f_new > f is a rounding-level ascent — keep `accepted` (the solve
+        # is converged to the dtype's resolution and classifies as
+        # FUNCTION_VALUES_CONVERGED below) but never move the iterate
+        # uphill (same contract as linesearch.LineSearchResult)
+        take = accepted & (f_new <= c.f)
+        x_new = jnp.where(take, c.x + t * direction, c.x)
+        f_new = jnp.where(take, f_new, c.f)
+        g_new = jnp.where(take, g_new, c.g)
         it = c.it + 1
         reason = convergence_reason(it, c.f, f_new, g_new, tols,
                                     config.max_iterations, improved=accepted)

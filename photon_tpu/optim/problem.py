@@ -33,7 +33,7 @@ from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.ops.losses import loss_for_task
 from photon_tpu.ops.normalization import NormalizationContext, no_normalization
 from photon_tpu.optim import lbfgs, owlqn, tron
-from photon_tpu.optim.base import SolverConfig, SolverResult
+from photon_tpu.optim.base import SolverConfig, SolverResult, jit_donating
 from photon_tpu.types import OptimizerType, TaskType, VarianceComputationType
 from photon_tpu.utils import jitcache
 
@@ -257,9 +257,27 @@ class GlmOptimizationProblem:
                             d2, v, batch, hyper)
                     return tron.minimize(vg, None, x0, config=solver_cfg,
                                          hess_setup=hs, hess_apply=ha)
+                from photon_tpu.ops.features import ModelShardedSparse
+                if (isinstance(batch.features, ModelShardedSparse)
+                        and batch.features.csc_ptr is not None
+                        and opt.lower_bounds is None
+                        and opt.upper_bounds is None):
+                    # margin-resident directional L-BFGS: on the sharded
+                    # path every feature pass is the wallclock, so the
+                    # solve keeps margins resident and pays exactly one
+                    # matvec + one rmatvec per iteration instead of one
+                    # full evaluation per line-search trial. Gated on the
+                    # CSC plan: a plan-less ModelShardedSparse is the
+                    # legacy compatibility layout, and gets the legacy
+                    # (classic line-search) solver with the scatter kernels
+                    dp = obj.directional_problem(batch, hyper)
+                    return lbfgs.minimize_directional(dp, x0,
+                                                      config=solver_cfg)
                 return lbfgs.minimize(vg, x0, config=solver_cfg)
 
-            return jax.jit(solve)
+            # donate x0 into the while-loop carry (accelerator backends
+            # only — see optim/base.jit_donating)
+            return jit_donating(solve, donate_argnums=(0,))
 
         # share the compiled solve across problem instances with identical
         # trace-shaping state (re-fits, sweep candidates, fresh
@@ -301,6 +319,14 @@ class GlmOptimizationProblem:
             # warm starts arrive in original space; optimize in transformed
             initial = norm.model_to_transformed_space(
                 jnp.asarray(initial), self.intercept_index)
+        else:
+            initial = jnp.asarray(initial)
+            if mesh is None and jax.default_backend() != "cpu":
+                # the jitted solve donates x0; this is the only path where
+                # the caller's own array would reach the donated position
+                # unwrapped (coordinate descent reuses the previous model
+                # as the warm start across outer iterations)
+                initial = initial.copy()
         if mesh is not None:
             from photon_tpu.parallel import mesh as M
             batch = M.shard_batch(batch, mesh)

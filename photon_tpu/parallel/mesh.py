@@ -41,6 +41,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from photon_tpu.data.dataset import DataBatch
 from photon_tpu.ops import features as F
 
+# jax.shard_map only exists from 0.5; this tree pins 0.4.x where the
+# implementation lives under jax.experimental. Re-exported so shard_map
+# callers (tests, bench bodies) have one version-stable spelling.
+try:
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
 DATA_AXIS = "data"
 # cross-slice (DCN) factor of a two-level data axis; see staged_psum
 DCN_AXIS = "dcn"
@@ -132,8 +140,11 @@ def create_two_level_mesh(
     order from ``mesh_utils.create_hybrid_device_mesh`` so the dcn axis
     aligns with actual slice boundaries; virtually (CPU) any order
     demonstrates the staged collective structure."""
-    assert n_devices % (dcn_factor * model_axis_size) == 0, \
-        (n_devices, dcn_factor, model_axis_size)
+    if n_devices % (dcn_factor * model_axis_size) != 0:
+        raise ValueError(
+            f"two-level mesh needs n_devices divisible by dcn_factor * "
+            f"model_axis_size, got (n_devices, dcn_factor, model_axis_size)"
+            f" = {(n_devices, dcn_factor, model_axis_size)}")
     data = n_devices // (dcn_factor * model_axis_size)
     devices = np.array(jax.devices()[:n_devices]).reshape(
         dcn_factor, data, model_axis_size)
@@ -398,7 +409,14 @@ def shard_coef_model_parallel(coef: jax.Array, mesh: Mesh,
     d_pad = padded_dim if padded_dim is not None else pad_to_multiple(d, d_mult)
     if d_pad != d:
         coef = jnp.pad(coef, [(0, d_pad - d)])
-    return jax.device_put(coef, NamedSharding(mesh, P(model_axis)))
+    sharding = NamedSharding(mesh, P(model_axis))
+    if jax.process_count() > 1:
+        # multi-host: every process holds the identical global coef, so
+        # each addressable shard materializes from its global index slice
+        host = np.asarray(coef)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda i: host[i])
+    return jax.device_put(coef, sharding)
 
 
 def shard_sparse_features_model_parallel(
@@ -409,24 +427,50 @@ def shard_sparse_features_model_parallel(
     re-partitioned ON THE HOST into per-range ELL blocks with local ids
     (ops/features.partition_by_feature_range), placed ``P(model, data)``.
     Margins then psum partial gather-dots over the model axis; gradients
-    psum local scatters over the data axis — the billion-feature fixed
-    effect trains without theta ever being replicated."""
+    run as contiguous segment reductions over a column-sorted view of the
+    same nonzeros (ops/features.build_csc_plan), psum-ed over the data
+    axis — the billion-feature fixed effect trains without theta ever
+    being replicated.
+
+    On a two-level mesh carrying a ``dcn`` axis (create_two_level_mesh)
+    the sample dim shards over ``(dcn, data)`` and gradient reductions
+    stage ICI-then-DCN (staged_psum as layout). Multi-process meshes are
+    supported when every process holds the identical global batch: shards
+    are then materialized per process from the globally-computed plan."""
     assert isinstance(batch.features, F.SparseFeatures), \
         "model-parallel sparse sharding needs ELL features"
+    dcn_axis = DCN_AXIS if DCN_AXIS in mesh.axis_names else None
     n_shards = axis_size(mesh, model_axis)
-    batch = pad_batch(batch, axis_size(mesh, data_axis))
+    n_chunks = axis_size(mesh, data_axis) * (
+        axis_size(mesh, dcn_axis) if dcn_axis else 1)
+    batch = pad_batch(batch, n_chunks)
     idx, val, shard_size = F.partition_by_feature_range(
         batch.features, dim, n_shards)
-    ell = NamedSharding(mesh, P(model_axis, data_axis, None))
+    rows, vals, ptr = F.build_csc_plan(
+        batch.features, dim, n_shards, n_chunks)
+    sample = (dcn_axis, data_axis) if dcn_axis else data_axis
+    block = NamedSharding(mesh, P(model_axis, sample, None))
+
+    def put(a, sharding):
+        # multi-host: every process computed the identical global arrays,
+        # so each shard is materialized from its global index slice
+        if jax.process_count() > 1:
+            a = np.asarray(a)
+            return jax.make_array_from_callback(
+                a.shape, sharding, lambda i: a[i])
+        return jax.device_put(jnp.asarray(a), sharding)
+
     feats = F.ModelShardedSparse(
-        indices=jax.device_put(jnp.asarray(idx), ell),
-        values=jax.device_put(jnp.asarray(val), ell),
+        indices=put(idx, block), values=put(val, block),
         shard_size=shard_size, mesh=mesh,
-        data_axis=data_axis, model_axis=model_axis)
+        data_axis=data_axis, model_axis=model_axis,
+        csc_rows=put(rows, block), csc_vals=put(vals, block),
+        csc_ptr=put(ptr, block), dcn_axis=dcn_axis)
+
+    vec = NamedSharding(mesh, P(sample))
 
     def put_vec(a):
-        return None if a is None else jax.device_put(
-            a, NamedSharding(mesh, P(data_axis)))
+        return None if a is None else put(a, vec)
 
     return DataBatch(features=feats, labels=put_vec(batch.labels),
                      offsets=put_vec(batch.offsets),

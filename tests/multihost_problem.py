@@ -13,3 +13,19 @@ def make_global_problem():
     y = (rng.random(n_global) < 1 / (1 + np.exp(-(X @ w)))).astype(np.float32)
     cfg_args = dict(max_iterations=100, tolerance=1e-9)
     return X, y, cfg_args
+
+
+def make_sparse_tp_problem():
+    """Sparse (ELL) logistic problem for the sparse-TP composition test:
+    small and well-conditioned (L2 weight 1.0 at the call sites) so the
+    model-sharded directional solve and the single-host classic solve
+    land on the same optimum to test tolerance."""
+    n, d, k = 2048, 40, 5
+    rng = np.random.default_rng(7)
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = (rng.normal(size=(n, k)) / np.sqrt(k)).astype(np.float32)
+    w = rng.normal(size=d).astype(np.float32)
+    margins = np.einsum("nk,nk->n", val, w[idx])
+    y = (rng.random(n) < 1 / (1 + np.exp(-margins))).astype(np.float32)
+    cfg_args = dict(max_iterations=100, tolerance=1e-9)
+    return idx, val, y, d, cfg_args

@@ -8,16 +8,74 @@ all-reduces cross the process boundary (Gloo on CPU — the DCN stand-in;
 SURVEY §5.8). Process 0 writes the solved coefficients for the parent
 test to compare against an in-process single-host solve.
 
-Usage: multihost_worker.py <pid> <nproc> <port> <out_npy>
+Usage: multihost_worker.py <pid> <nproc> <port> <out_npy> [mode]
+
+``mode`` defaults to ``dense`` (data-sharded halves). ``sparse_tp``
+instead runs the model-sharded sparse path (ops/features
+.ModelShardedSparse + the margin-resident directional L-BFGS) on a
+``(data=4, model=2)`` mesh whose MODEL axis spans the two OS processes:
+every theta-range psum of the hot path then crosses the process
+boundary, composing tensor parallelism with the multi-host runtime.
 """
 
 import os
 import sys
 
 
+def _sparse_tp(pid, nproc, out):
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from photon_tpu.data.dataset import DataBatch
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.coordinate import FixedEffectCoordinate
+    from photon_tpu.ops import features as F
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.parallel import mesh as M
+    from photon_tpu.types import TaskType
+    from tests.multihost_problem import make_sparse_tp_problem
+
+    idx, val, y, d, cfg_args = make_sparse_tp_problem()
+    # jax.devices() orders by process (process p owns devices
+    # [p*4, p*4+4)); reshape(nproc, -1).T puts one device of EACH process
+    # in every model group, so the theta-range collectives cross the
+    # process boundary
+    devs = np.array(jax.devices()).reshape(nproc, -1).T
+    mesh = Mesh(devs, (M.DATA_AXIS, M.MODEL_AXIS))
+    span = len({dv.process_index for dv in devs[0]})
+
+    batch = DataBatch(F.SparseFeatures(jnp.asarray(idx), jnp.asarray(val)),
+                      jnp.asarray(y))
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(**cfg_args),
+        regularization=L2Regularization, regularization_weight=1.0)
+    coord = FixedEffectCoordinate(batch, d, "g",
+                                  TaskType.LOGISTIC_REGRESSION,
+                                  cfg, mesh=mesh)
+    assert coord._model_sharded
+    assert coord.batch.features.csc_ptr is not None  # segment-sum rmatvec
+    model = coord.update_model(None, None)
+    coefs = np.asarray(
+        jax.jit(lambda c: c, out_shardings=M.replicated(mesh))(
+            model.model.coefficients.means).addressable_data(0))
+    r = coord.last_result
+    print(f"proc {pid}: devices {len(jax.devices())} "
+          f"model-axis-procs {span} "
+          f"iters {int(np.asarray(r.iterations))} "
+          f"coefnorm {np.linalg.norm(coefs):.6f}", flush=True)
+    if pid == 0:
+        np.save(out, coefs)
+
+
 def main():
     pid, nproc, port, out = (int(sys.argv[1]), int(sys.argv[2]),
                              sys.argv[3], sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "dense"
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("XLA_FLAGS",
                           "--xla_force_host_platform_device_count=4")
@@ -29,6 +87,9 @@ def main():
     assert M.initialize_distributed(
         coordinator_address=f"127.0.0.1:{port}",
         num_processes=nproc, process_id=pid) == nproc
+
+    if mode == "sparse_tp":
+        return _sparse_tp(pid, nproc, out)
 
     import numpy as np
 

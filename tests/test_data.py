@@ -234,3 +234,42 @@ def test_native_parse_unterminated_buffers():
     vals = np.frombuffer(ref[3], np.float64)
     np.testing.assert_allclose(labels, [1.0, -1.0])
     np.testing.assert_allclose(vals, [0.5, 1.25, 2.5])
+
+
+def test_native_parse_tail_segment_paths():
+    """The bounded trailing-partial-line path: libsvmdec.c no longer
+    duplicates the whole blob to append a '\\n' — it parses the original
+    buffer up to its last newline and copies ONLY the final partial line
+    into a small owned buffer. Every tail shape must parse identically
+    to its newline-terminated equivalent."""
+    import numpy as np
+
+    from photon_tpu import native
+
+    parse = native.libsvm_parser()
+    if parse is None:
+        import pytest
+        pytest.skip("no C compiler in this environment")
+
+    rng = np.random.default_rng(3)
+    lines = [
+        f"{1 if rng.random() < 0.5 else -1} "
+        + " ".join(f"{j + 1}:{rng.normal():.6g}"
+                   for j in sorted(rng.choice(50, size=3, replace=False)))
+        for _ in range(200)
+    ]
+    body = "\n".join(lines)
+    cases = [
+        body,                        # multi-line blob, no trailing newline
+        lines[0],                    # single line, no newline anywhere
+        body + "\n# tail comment",   # partial line is a comment
+        body + "\n   ",              # partial line is whitespace only
+    ]
+    for text in cases:
+        got = parse(text.encode(), 0)
+        want = parse((text + "\n").encode(), 0)
+        assert got == want, text[-40:]
+    # malformed content confined to the tail segment still raises
+    import pytest
+    with pytest.raises(ValueError):
+        parse((body + "\n1 9:bad").encode(), 0)

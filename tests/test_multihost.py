@@ -31,10 +31,11 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_solve_matches_single_host(tmp_path):
-    # bounded by the workers' communicate(timeout=420) below — no
-    # pytest-timeout plugin in this image
-    out = str(tmp_path / "coefs.npy")
+def _run_workers(out, mode=None):
+    """Spawn the 2-process worker pair, bounded by communicate(timeout=420)
+    (no pytest-timeout plugin in this image). Returns the workers' logs;
+    only genuine distributed-runtime bring-up failures may skip — an
+    ordinary worker traceback is a real regression and must FAIL."""
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k != "PALLAS_AXON_POOL_IPS"}   # workers must not touch the
@@ -44,16 +45,20 @@ def test_two_process_solve_matches_single_host(tmp_path):
     workers = [
         subprocess.Popen(
             [sys.executable, os.path.join(HERE, "multihost_worker.py"),
-             str(pid), "2", str(port), out],
+             str(pid), "2", str(port), out]
+            + ([mode] if mode else []),
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=os.path.dirname(HERE))
         for pid in (0, 1)
     ]
-    # only genuine distributed-runtime bring-up failures may skip; an
-    # ordinary worker traceback is a real regression and must FAIL
     _INIT_FAILURES = ("DEADLINE_EXCEEDED", "UNAVAILABLE",
                       "Failed to connect", "preemption",
-                      "coordination service")
+                      "coordination service",
+                      # jaxlib built without CPU cross-process collectives
+                      # (no Gloo): the cluster forms but no multiprocess
+                      # program can run — an environment limitation, not a
+                      # code regression
+                      "Multiprocess computations aren't implemented")
     logs = []
     try:
         for w in workers:
@@ -68,6 +73,12 @@ def test_two_process_solve_matches_single_host(tmp_path):
         for w in workers:
             if w.poll() is None:
                 w.kill()
+    return logs
+
+
+def test_two_process_solve_matches_single_host(tmp_path):
+    out = str(tmp_path / "coefs.npy")
+    logs = _run_workers(out)
 
     assert any("devices 8" in l for l in logs), logs  # 2 procs x 4 devices
     multi = np.load(out)
@@ -94,3 +105,43 @@ def test_two_process_solve_matches_single_host(tmp_path):
     single = np.asarray(model.coefficients.means)
 
     np.testing.assert_allclose(multi, single, rtol=5e-4, atol=5e-5)
+
+
+def test_two_process_sparse_tp_model_axis_spans_processes(tmp_path):
+    """Sparse tensor parallelism composed with the multi-host runtime:
+    a (data=4, model=2) mesh whose MODEL axis pairs one device from each
+    OS process, so the hot path's theta-range collectives (margin psum
+    over model, segment-sum gradient psum over data) cross the process
+    boundary. Oracle is a single-host solve of the identical ELL problem
+    on the plain (unsharded) path."""
+    out = str(tmp_path / "coefs_tp.npy")
+    logs = _run_workers(out, mode="sparse_tp")
+
+    assert any("devices 8" in l for l in logs), logs
+    # the mesh really did span: each model group held both processes
+    assert any("model-axis-procs 2" in l for l in logs), logs
+    multi = np.load(out)
+
+    from photon_tpu.data.dataset import DataBatch
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.ops import features as F
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        GlmOptimizationProblem,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+    from tests.multihost_problem import make_sparse_tp_problem
+
+    idx, val, y, d, cfg_args = make_sparse_tp_problem()
+    cfg = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(**cfg_args),
+        regularization=L2Regularization, regularization_weight=1.0)
+    prob = GlmOptimizationProblem(TaskType.LOGISTIC_REGRESSION, cfg)
+    model, _ = prob.run(
+        DataBatch(F.SparseFeatures(jnp.asarray(idx), jnp.asarray(val)),
+                  jnp.asarray(y)),
+        dim=d, dtype=jnp.float32)
+    single = np.asarray(model.coefficients.means)
+
+    np.testing.assert_allclose(multi, single, rtol=5e-4, atol=5e-4)

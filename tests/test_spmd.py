@@ -517,10 +517,10 @@ def test_dcn_staged_psum_two_collectives(rng, devices8):
     Xs = jax.device_put(jnp.asarray(X), NamedSharding(mesh, spec_x))
     rs = jax.device_put(jnp.asarray(r), NamedSharding(mesh, spec_r))
 
-    staged = jax.jit(jax.shard_map(
+    staged = jax.jit(M.shard_map(
         lambda xb, rb: M.staged_psum(xb.T @ rb),
         mesh=mesh, in_specs=(spec_x, spec_r), out_specs=P()))
-    flat = jax.jit(jax.shard_map(
+    flat = jax.jit(M.shard_map(
         lambda xb, rb: jax.lax.psum(xb.T @ rb, (M.DCN_AXIS, M.DATA_AXIS)),
         mesh=mesh, in_specs=(spec_x, spec_r), out_specs=P()))
 
@@ -570,3 +570,75 @@ def test_newton_solve_data_parallel_parity(rng, devices8):
     hlo = prob_mesh._solve_fn.lower(
         th0, sharded, one, jnp.asarray(0.0, jnp.float64)).compile().as_text()
     assert "all-reduce" in hlo
+
+
+def test_segment_reduce_rmatvec_matches_scatter_path(rng, devices8):
+    """Parity pin for the sharded-sparse gradient kernels: the
+    column-sorted contiguous-segment reduction (csc_* plan present — the
+    fast path shard_sparse_features_model_parallel now builds at ingest)
+    must match the serialized per-slot at[].add scatter fallback (plan
+    stripped) on the SAME partitioned nonzeros, in f64 to 1e-12."""
+    import dataclasses
+
+    n, d, k = 96, 53, 7
+    sf = _ell(rng, n, d, k)
+    w = rng.normal(size=n)
+
+    mesh = M.create_mesh(8, (M.DATA_AXIS, M.MODEL_AXIS), (4, 2))
+    batch = M.shard_sparse_features_model_parallel(
+        DataBatch(sf, jnp.zeros(n)), mesh, dim=d)
+    ms = batch.features
+    assert ms.csc_ptr is not None, "ingest must build the CSC plan"
+    scatter = dataclasses.replace(
+        ms, csc_rows=None, csc_vals=None, csc_ptr=None)
+    d_pad = ms.padded_dim
+    wj = jax.device_put(jnp.asarray(w), NamedSharding(mesh, P(M.DATA_AXIS)))
+
+    for kern in (F.rmatvec, F.sq_rmatvec):
+        g_seg = jax.jit(lambda x, v, f=kern: f(x, v, d_pad))(ms, wj)
+        g_sc = jax.jit(lambda x, v, f=kern: f(x, v, d_pad))(scatter, wj)
+        np.testing.assert_allclose(np.asarray(g_seg), np.asarray(g_sc),
+                                   rtol=1e-12, atol=1e-12,
+                                   err_msg=kern.__name__)
+    # and against the unsharded oracle, which neither path shares code with
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(lambda x, v: F.rmatvec(x, v, d_pad))(ms, wj))[:d],
+        np.asarray(F.rmatvec(sf, jnp.asarray(w), d)),
+        rtol=1e-12, atol=1e-12)
+
+
+def test_sparse_tp_two_level_mesh_staged_reduction(rng):
+    """Sparse TP composed with the two-level (dcn, data, model) mesh: the
+    CSC plan chunks samples over dcn*data, the gradient psum stages
+    ICI-then-DCN (>= 2 all-reduce ops in HLO), and the kernels still match
+    the unsharded oracle."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    n, d, k = 64, 41, 5
+    sf = _ell(rng, n, d, k)
+    theta = rng.normal(size=d)
+    w = rng.normal(size=n)
+
+    mesh = M.create_two_level_mesh(8, dcn_factor=2, model_axis_size=2)
+    batch = M.shard_sparse_features_model_parallel(
+        DataBatch(sf, jnp.zeros(n)), mesh, dim=d)
+    ms = batch.features
+    assert ms.dcn_axis == M.DCN_AXIS
+    d_pad = ms.padded_dim
+    th = M.shard_coef_model_parallel(jnp.asarray(theta), mesh,
+                                     padded_dim=d_pad)
+    mv = jax.jit(lambda x, t: F.matvec(x, t))
+    np.testing.assert_allclose(np.asarray(mv(ms, th)),
+                               np.asarray(F.matvec(sf, jnp.asarray(theta))),
+                               rtol=1e-12)
+
+    wj = jax.device_put(
+        jnp.asarray(w), NamedSharding(mesh, P((M.DCN_AXIS, M.DATA_AXIS))))
+    rv = jax.jit(lambda x, v: F.rmatvec(x, v, d_pad))
+    np.testing.assert_allclose(np.asarray(rv(ms, wj))[:d],
+                               np.asarray(F.rmatvec(sf, jnp.asarray(w), d)),
+                               rtol=1e-12, atol=1e-12)
+    hlo = rv.lower(ms, wj).compile().as_text()
+    n_ar = sum(1 for line in hlo.splitlines() if "all-reduce(" in line)
+    assert n_ar >= 2, \
+        f"expected staged ICI-then-DCN all-reduces in rmatvec, found {n_ar}"
