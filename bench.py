@@ -165,6 +165,17 @@ def finish(rc_reason=None):
                 f.write("\n")
         except OSError as e:  # pragma: no cover - disk full etc.
             log(f"BENCH_SUMMARY.json write failed: {e!r}")
+        # RunReport in the driver schema (photon_tpu.runreport.v1): jitcache
+        # and compile-cache metrics are always live; per-config spans and
+        # memory watermarks appear when BENCH_TELEMETRY=1. The bench must
+        # never die over telemetry, hence the broad guard.
+        try:
+            from photon_tpu.obs.report import write_run_report
+            here = os.path.dirname(os.path.abspath(__file__))
+            write_run_report(os.path.join(here, "BENCH_RUNREPORT.json"),
+                             driver="bench", extra={"summary": rec})
+        except Exception as e:  # noqa: BLE001
+            log(f"BENCH_RUNREPORT.json write failed: {e!r}")
         emit(rec)
 
 
@@ -1818,6 +1829,13 @@ def main():
                          "which adds up to ~5 min of baseline reruns)")
     args = ap.parse_args()
 
+    if os.environ.get("BENCH_TELEMETRY"):
+        # opt-in: per-config spans + memory watermarks land in
+        # BENCH_RUNREPORT.json; default-off keeps the measured hot paths
+        # byte-identical to the untelemetered bench
+        from photon_tpu.obs import _config as _obs_config
+        _obs_config.configure(True)
+
     start_watchdog(args.deadline)
     try:
         force = bootstrap_platform(args)
@@ -1857,7 +1875,9 @@ def main():
             continue
         log(f"=== config {name} (scale {args.scale}) ===")
         try:
-            emit(fn(args.scale))
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span(f"bench/{name}"):
+                emit(fn(args.scale))
         except Exception as e:
             import traceback
 

@@ -44,6 +44,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--event-listeners", nargs="*", default=[],
                    help="fully-qualified EventListener class names "
                         "(reference: Driver.scala:62-73)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable the unified telemetry subsystem (same as "
+                        "PHOTON_TPU_TELEMETRY=1); writes runreport.json + "
+                        "trace.json under --root-output-directory")
     p.add_argument("--log-level", default="INFO")
     return p
 
@@ -60,7 +64,13 @@ def run(args: argparse.Namespace) -> np.ndarray:
 
 
 def _run(args: argparse.Namespace) -> np.ndarray:
+    from photon_tpu import obs
     from photon_tpu.utils import events
+
+    if getattr(args, "telemetry", False):
+        obs.configure(True)
+    _root_span = obs.span("score", driver="game-score")
+    _root_span.__enter__()
 
     out_dir = args.root_output_directory
     os.makedirs(out_dir, exist_ok=True)
@@ -126,6 +136,15 @@ def _run(args: argparse.Namespace) -> np.ndarray:
         "ScoringFinishEvent",
         payload={"num_scored": int(len(scores)),
                  "evaluation": evaluations}))
+    _root_span.__exit__(None, None, None)
+    if obs.enabled():
+        try:
+            obs.write_run_report(
+                os.path.join(out_dir, "runreport.json"), driver="game-score",
+                extra={"num_scored": int(len(scores))}, aggregate=True)
+            obs.write_trace(os.path.join(out_dir, "trace.json"))
+        except Exception as e:  # noqa: BLE001 — telemetry must never fail a run
+            logger.warning("failed to write telemetry artifacts: %r", e)
     return scores
 
 

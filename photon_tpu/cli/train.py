@@ -138,6 +138,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the fit into this "
                         "directory (SURVEY §5.1: the TPU-native analog of "
                         "the reference's Timed blocks + Spark UI)")
+    p.add_argument("--telemetry", action="store_true",
+                   help="enable the unified telemetry subsystem (same as "
+                        "PHOTON_TPU_TELEMETRY=1): phase spans, solver "
+                        "trajectories, compile/memory metrics; writes "
+                        "runreport.json + trace.json (Perfetto-loadable) "
+                        "under --root-output-directory")
     p.add_argument("--log-level", default="INFO")
     return p
 
@@ -259,7 +265,13 @@ def run(args: argparse.Namespace) -> List:
 
 
 def _run(args: argparse.Namespace) -> List:
+    from photon_tpu import obs
     from photon_tpu.utils import events
+
+    if getattr(args, "telemetry", False):
+        obs.configure(True)
+    _root_span = obs.span("train", driver="game-train")
+    _root_span.__enter__()
 
     task = TaskType(args.training_task)
     out_dir = args.root_output_directory
@@ -269,6 +281,18 @@ def _run(args: argparse.Namespace) -> List:
                          for s in args.feature_shards)
     parsed = [parse_coordinate_config(c) for c in args.coordinates]
     coordinate_configs = {p.name: p.configuration for p in parsed}
+    if obs.enabled():
+        # device-resident solver telemetry needs the per-iteration ring
+        # buffer in the while-loop carry; honor an explicit size if the
+        # config set one, otherwise use the reference's 100-state window
+        import dataclasses as _dc
+        for name, cfg in list(coordinate_configs.items()):
+            opt = cfg.optimization.optimizer
+            if opt.track_states == 0:
+                coordinate_configs[name] = _dc.replace(
+                    cfg, optimization=_dc.replace(
+                        cfg.optimization,
+                        optimizer=_dc.replace(opt, track_states=100)))
     update_sequence = [s.strip() for s in
                        args.coordinate_update_sequence.split(",")]
     unknown = set(update_sequence) - set(coordinate_configs)
@@ -404,6 +428,22 @@ def _run(args: argparse.Namespace) -> List:
         best_evaluation=None if best.evaluation is None
         else dict(best.evaluation)))
     save_models(args, estimator, results, tuned, index_maps, out_dir)
+    _root_span.__exit__(None, None, None)
+    if obs.enabled():
+        try:
+            report_path = os.path.join(out_dir, "runreport.json")
+            obs.write_run_report(
+                report_path, driver="game-train",
+                mesh=mesh,
+                extra={"configurations": len(sweeps),
+                       "coordinates": list(update_sequence)},
+                aggregate=True)
+            trace_path = os.path.join(out_dir, "trace.json")
+            obs.write_trace(trace_path)
+            logger.info("telemetry: run report at %s, trace at %s",
+                        report_path, trace_path)
+        except Exception as e:  # noqa: BLE001 — telemetry must never fail a run
+            logger.warning("failed to write telemetry artifacts: %r", e)
     return results + tuned
 
 

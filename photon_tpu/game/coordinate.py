@@ -39,6 +39,7 @@ from photon_tpu.optim.problem import (
     solver_cache_key,
 )
 from photon_tpu.types import OptimizerType, TaskType
+from photon_tpu.obs.spans import annotate as _obs_annotate
 from photon_tpu.utils import jitcache
 
 Array = jax.Array
@@ -165,14 +166,16 @@ class FixedEffectCoordinate:
                 if init is None else jnp.asarray(init)
             init = M.shard_coef_model_parallel(init, self.mesh,
                                                padded_dim=self._dim_padded)
-        model, result = self.problem.run(
-            batch, initial=init, dim=self.dim, dtype=batch.labels.dtype,
-            # read the weight from the coordinate's (possibly sweep-updated)
-            # config, not the problem's construction-time copy
-            regularization_weight=self.config.regularization_weight,
-            # this coordinate's batch was sharded at construction; the
-            # pallas kernel must not trace over mesh-placed arrays
-            pallas_ok=self.mesh is None)
+        with _obs_annotate("fe/solve"):
+            model, result = self.problem.run(
+                batch, initial=init, dim=self.dim, dtype=batch.labels.dtype,
+                # read the weight from the coordinate's (possibly
+                # sweep-updated) config, not the problem's
+                # construction-time copy
+                regularization_weight=self.config.regularization_weight,
+                # this coordinate's batch was sharded at construction; the
+                # pallas kernel must not trace over mesh-placed arrays
+                pallas_ok=self.mesh is None)
         from photon_tpu.optim.tracking import OptimizationStatesTracker
         self.last_result = result
         self.last_tracker = OptimizationStatesTracker.from_result(result)
@@ -204,7 +207,8 @@ class FixedEffectCoordinate:
             from photon_tpu.parallel import mesh as M
             coef = M.shard_coef_model_parallel(jnp.asarray(coef), self.mesh,
                                                padded_dim=self._dim_padded)
-        s = _fixed_score(self.batch.features, coef)
+        with _obs_annotate("fe/score"):
+            s = _fixed_score(self.batch.features, coef)
         if s.shape[0] != self._n_orig:
             s = s[: self._n_orig]
         return s
@@ -498,8 +502,9 @@ class RandomEffectCoordinate:
         if self._norm_local is not None:
             f, s, islot = self._norm_local
             norm_args = (f,) if s is None else (f, s, islot)
-        coefs, iters, reasons = self._solve_fn(self.dataset, residual_scores,
-                                               coef0, l2, l1, *norm_args)
+        with _obs_annotate("re/solve"):
+            coefs, iters, reasons = self._solve_fn(
+                self.dataset, residual_scores, coef0, l2, l1, *norm_args)
         # per-entity outcome aggregation (RandomEffectOptimizationTracker).
         # Keep the DEVICE arrays: a blocking host transfer here would
         # serialize every CD sweep on the solver's completion; the tracker
@@ -596,8 +601,9 @@ class RandomEffectCoordinate:
         return jitcache.get_or_build(("re_score", n, dense_flags), build)
 
     def score(self, model: RandomEffectModel) -> Array:
-        return self._score_fn(self.dataset,
-                              self._pad_entity_rows(model.coefficients))
+        with _obs_annotate("re/score"):
+            return self._score_fn(self.dataset,
+                                  self._pad_entity_rows(model.coefficients))
 
 
 def _re_score_builder(n: int, dense_flags=()):

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from photon_tpu.game.model import GameModel
+from photon_tpu.obs import solver as _obs_solver
+from photon_tpu.obs import spans as _obs_spans
 
 Array = jax.Array
 
@@ -120,6 +122,7 @@ def run_coordinate_descent(
             full_score = full_score + s
 
     for it in range(start_iter, config.num_iterations):
+      with _obs_spans.span("cd/sweep", iteration=it):
         for cid in config.update_sequence:
             if cid in config.locked_coordinates:
                 continue
@@ -134,10 +137,14 @@ def run_coordinate_descent(
                 new_model = coord.update_model(models.get(cid), residual)
             models[cid] = new_model
             tracker = getattr(coord, "last_tracker", None)
-            if tracker is not None and logger.isEnabledFor(logging.DEBUG):
-                # summary() forces a device->host sync; never pay it unless
-                # debug logging actually consumes it
-                logger.debug("coord %s solver: %s", cid, tracker.summary())
+            if tracker is not None:
+                # telemetry keeps a REFERENCE (device arrays and all);
+                # the host transfer happens at drain time, not here
+                _obs_solver.record(cid, tracker, sweep=it)
+                if logger.isEnabledFor(logging.DEBUG):
+                    # summary() forces a device->host sync; never pay it
+                    # unless debug logging actually consumes it
+                    logger.debug("coord %s solver: %s", cid, tracker.summary())
             new_score = coord.score(new_model)
             full_score = (full_score - own + new_score) if own is not None \
                 else (full_score + new_score)

@@ -1,0 +1,47 @@
+"""Telemetry on/off switch — the zero-overhead-when-disabled gate.
+
+Every obs recording path (spans, device-trace annotations, memory
+sampling, RunReport emission) checks :func:`enabled` first and turns
+into a no-op when telemetry is off. The metrics registry itself stays
+live regardless (host-side counter bumps at cache-lookup/driver-phase
+granularity, nowhere near a hot loop), but nothing is ever staged into
+jitted code: device-side telemetry is carried as ordinary solver outputs
+(``track_states`` ring buffers), never as ``io_callback``/``debug``
+callbacks — ``scripts/check_no_host_sync.py`` enforces that statically.
+
+Enable with ``PHOTON_TPU_TELEMETRY=1`` (any non-empty value other than
+``0``/``false``/``off``), the drivers' ``--telemetry`` flag, or
+``obs.configure(enabled=True)``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_FLAG = "PHOTON_TPU_TELEMETRY"
+
+# tri-state: None = read the env var lazily; True/False = explicit override
+_enabled: Optional[bool] = None
+
+
+def _env_enabled() -> bool:
+    raw = os.environ.get(ENV_FLAG, "").strip().lower()
+    return bool(raw) and raw not in ("0", "false", "off", "no")
+
+
+def enabled() -> bool:
+    if _enabled is not None:
+        return _enabled
+    return _env_enabled()
+
+
+def configure(enabled: Optional[bool]) -> None:
+    """Explicitly enable/disable telemetry; ``None`` reverts to the env."""
+    global _enabled
+    _enabled = enabled
+
+
+def reset() -> None:
+    """Forget the explicit override (tests)."""
+    configure(None)

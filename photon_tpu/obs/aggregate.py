@@ -1,0 +1,58 @@
+"""Multi-process telemetry aggregation to process 0.
+
+A multi-host run produces one telemetry state per process; the RunReport
+wants one manifest. This module gathers each process's JSON-safe payload
+to process 0 with exactly TWO collectives (length allgather + padded
+byte allgather), both issued at report-build time — hot paths stay
+collective-free by construction, because nothing here is ever called
+from inside a sweep or a jitted program.
+
+Single-process runs short-circuit without touching the distributed
+runtime at all.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def process_info() -> Dict[str, int]:
+    """{"index", "count"} — (0, 1) when jax isn't initialized."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {"index": 0, "count": 1}
+    try:
+        return {"index": jax.process_index(), "count": jax.process_count()}
+    except Exception:  # backend not initialized
+        return {"index": 0, "count": 1}
+
+
+def gather_payloads(payload: Dict[str, Any]) -> Optional[List[Dict[str, Any]]]:
+    """Collective gather of one JSON-safe dict per process.
+
+    Every process must call this (it is a collective). Returns the list of
+    per-process payloads (index order) on process 0, ``None`` elsewhere.
+    On a single process it returns ``[payload]`` without any collective.
+    """
+    info = process_info()
+    if info["count"] == 1:
+        return [payload]
+
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    data = np.frombuffer(json.dumps(payload).encode("utf-8"), np.uint8)
+    lengths = multihost_utils.process_allgather(
+        np.asarray([data.size], np.int64))
+    lengths = np.asarray(lengths).ravel()
+    width = int(lengths.max())
+    padded = np.zeros((width,), np.uint8)
+    padded[: data.size] = data
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    if jax.process_index() != 0:
+        return None
+    return [json.loads(bytes(gathered[p, : int(lengths[p])]).decode("utf-8"))
+            for p in range(info["count"])]

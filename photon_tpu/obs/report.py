@@ -1,0 +1,186 @@
+"""RunReport: one machine-readable JSON manifest per driver run.
+
+Written at the end of ``cli/train.py`` / ``cli/score.py`` and emitted by
+``bench.py`` in the same schema: phase spans, the metrics-registry
+snapshot, drained solver trajectories (per-iteration loss/||g||/step
+series and per-entity RE outcomes), mesh/device topology, and host/
+device memory watermarks sampled per phase. The schema is versioned so
+later perf/robustness PRs can extend it without breaking parsers.
+
+Multi-process: :func:`write_run_report` with ``aggregate=True`` gathers
+every process's metrics/memory/solver sections to process 0 (two
+collectives at report time — obs/aggregate.py) and only process 0
+writes; other processes return ``None``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA = "photon_tpu.runreport.v1"
+
+
+def _topology(mesh=None) -> Dict[str, Any]:
+    """Device/mesh topology; degrades to {} when jax isn't loaded."""
+    if sys.modules.get("jax") is None:
+        return {}
+    try:
+        from photon_tpu.parallel.mesh import mesh_topology
+        return mesh_topology(mesh)
+    except Exception:  # backend not initialized — report stays valid
+        return {}
+
+
+def _phases() -> List[Dict[str, Any]]:
+    from photon_tpu.obs import spans
+    out = []
+    for r in spans.records():
+        p = {
+            "name": r["name"],
+            "start_unix": r["start_unix"],
+            "end_unix": r["end_unix"],
+            "duration_s": r["dur_us"] / 1e6,
+            "parent": r.get("parent"),
+            "depth": r.get("depth", 0),
+            "tid": r.get("tid"),
+        }
+        if "args" in r:
+            p["args"] = r["args"]
+        if r.get("error"):
+            p["error"] = True
+        out.append(p)
+    return out
+
+
+def build_run_report(driver: str,
+                     mesh=None,
+                     extra: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Assemble this process's report dict. Draining solver telemetry and
+    sampling memory happen here — this IS the phase boundary."""
+    from photon_tpu.obs import aggregate, memory, solver
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.utils import timing
+
+    memory.record_phase("run_report")  # final watermark sample
+    report: Dict[str, Any] = {
+        "schema": SCHEMA,
+        "driver": driver,
+        "created_unix": time.time(),
+        "argv": list(sys.argv),
+        "process": aggregate.process_info(),
+        "topology": _topology(mesh),
+        "phases": _phases(),
+        "timings": [[label, secs] for label, secs in timing.timing_records()],
+        "metrics": registry.snapshot(),
+        "solver": solver.drain(),
+        "memory": memory.watermarks(),
+    }
+    if extra:
+        report["extra"] = extra
+    return report
+
+
+def write_run_report(path: str,
+                     driver: str,
+                     mesh=None,
+                     extra: Optional[Dict[str, Any]] = None,
+                     aggregate: bool = False) -> Optional[Dict[str, Any]]:
+    """Build + write the report; returns the written dict.
+
+    With ``aggregate=True`` on a multi-process run, every process must
+    call this (the gather is collective); only process 0 writes and
+    returns the report — it gains a ``processes`` section with each
+    process's metrics/memory/solver and cluster-merged ``metrics``
+    under ``metrics_aggregated``.
+    """
+    from photon_tpu.obs import aggregate as agg
+    from photon_tpu.obs.metrics import merge_snapshots
+
+    report = build_run_report(driver, mesh=mesh, extra=extra)
+    if aggregate and report["process"]["count"] > 1:
+        local = {
+            "process": report["process"],
+            "metrics": report["metrics"],
+            "memory": report["memory"],
+            "solver": report["solver"],
+            "num_phases": len(report["phases"]),
+        }
+        gathered = agg.gather_payloads(local)
+        if gathered is None:  # non-zero process: report written by proc 0
+            return None
+        report["processes"] = gathered
+        report["metrics_aggregated"] = merge_snapshots(
+            p["metrics"] for p in gathered)
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1, default=_json_fallback)
+        f.write("\n")
+    return report
+
+
+def _json_fallback(obj):
+    """Numpy scalars/arrays sneak into extras; make them JSON-safe rather
+    than killing the report at the end of a long run."""
+    try:
+        import numpy as np
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+        if isinstance(obj, np.generic):
+            return obj.item()
+    except ImportError:  # pragma: no cover
+        pass
+    return str(obj)
+
+
+def validate_run_report(report: Dict[str, Any]) -> List[str]:
+    """Structural schema check; returns a list of problems ([] = valid).
+    Used by tests and by bench.py's self-check before emitting."""
+    errors: List[str] = []
+    if report.get("schema") != SCHEMA:
+        errors.append(f"schema is {report.get('schema')!r}, want {SCHEMA!r}")
+    if not isinstance(report.get("driver"), str) or not report.get("driver"):
+        errors.append("driver must be a non-empty string")
+    if not isinstance(report.get("created_unix"), (int, float)):
+        errors.append("created_unix must be a number")
+    phases = report.get("phases")
+    if not isinstance(phases, list):
+        errors.append("phases must be a list")
+    else:
+        for i, p in enumerate(phases):
+            for k in ("name", "start_unix", "end_unix", "duration_s"):
+                if k not in p:
+                    errors.append(f"phases[{i}] missing {k!r}")
+            if ("start_unix" in p and "end_unix" in p
+                    and p["start_unix"] > p["end_unix"] + 1e-9):
+                errors.append(f"phases[{i}] ({p.get('name')}): start > end")
+            if p.get("duration_s", 0) < 0:
+                errors.append(f"phases[{i}]: negative duration")
+    metrics = report.get("metrics")
+    if not isinstance(metrics, dict):
+        errors.append("metrics must be a dict")
+    else:
+        for section in ("counters", "gauges", "histograms"):
+            if not isinstance(metrics.get(section), dict):
+                errors.append(f"metrics.{section} must be a dict")
+    solver = report.get("solver")
+    if not isinstance(solver, dict):
+        errors.append("solver must be a dict")
+    else:
+        for section in ("trajectories", "random_effects"):
+            if not isinstance(solver.get(section), list):
+                errors.append(f"solver.{section} must be a list")
+    if not isinstance(report.get("memory"), dict):
+        errors.append("memory must be a dict")
+    proc = report.get("process")
+    if (not isinstance(proc, dict) or "index" not in proc
+            or "count" not in proc):
+        errors.append("process must be {'index', 'count'}")
+    return errors

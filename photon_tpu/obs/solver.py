@@ -1,0 +1,74 @@
+"""Device-resident solver telemetry, drained only at phase boundaries.
+
+Extends the lazy-transfer pattern of ``optim/tracking.py``: coordinate
+descent pushes each update's tracker here as a bare reference — the
+per-iteration loss/||g||/step ring buffers and per-entity RE outcome
+arrays stay DEVICE arrays, so recording costs one list append and zero
+syncs. :func:`drain` (called at RunReport build time, i.e. a phase
+boundary) pays the host transfers in one batch, converts every tracker
+to a JSON-safe dict, and empties the buffer.
+
+Multi-process runs keep this per-process; the RunReport aggregation
+(obs/aggregate.py) ships the drained host dicts to process 0 — no
+collectives ride in the recording path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List
+
+from photon_tpu.obs import _config
+
+_LOCK = threading.Lock()
+# entries: {"kind", "coordinate", "tracker", "unix", **meta} — tracker is a
+# live OptimizationStatesTracker / RandomEffectOptimizationTracker whose
+# arrays may still be device-resident
+_BUFFER: List[Dict[str, Any]] = []
+
+
+def record(coordinate: str, tracker, **meta: Any) -> None:
+    """Push one update's tracker (no-op when telemetry is off, no host
+    sync ever — the tracker's arrays are adopted as-is)."""
+    if tracker is None or not _config.enabled():
+        return
+    kind = ("random_effect" if hasattr(tracker, "reason_counts")
+            else "states")
+    with _LOCK:
+        _BUFFER.append({"kind": kind, "coordinate": coordinate,
+                        "tracker": tracker, "unix": time.time(), **meta})
+
+
+def pending() -> int:
+    with _LOCK:
+        return len(_BUFFER)
+
+
+def clear() -> None:
+    with _LOCK:
+        _BUFFER.clear()
+
+
+def drain() -> Dict[str, List[Dict[str, Any]]]:
+    """Convert + clear: {"trajectories": [...], "random_effects": [...]}.
+
+    This is where device->host transfers happen — call it at phase
+    boundaries only (RunReport build, end of fit), never inside a sweep.
+    """
+    with _LOCK:
+        entries = list(_BUFFER)
+        _BUFFER.clear()
+    out: Dict[str, List[Dict[str, Any]]] = {
+        "trajectories": [], "random_effects": []}
+    for e in entries:
+        base = {k: v for k, v in e.items() if k not in ("tracker", "kind")}
+        try:
+            base.update(e["tracker"].to_dict())
+        except Exception as exc:  # a broken tracker must not kill a report
+            base["error"] = repr(exc)
+        if e["kind"] == "random_effect":
+            out["random_effects"].append(base)
+        else:
+            out["trajectories"].append(base)
+    return out

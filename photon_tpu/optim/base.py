@@ -72,26 +72,38 @@ class SolverResult(NamedTuple):
     # ring buffers of the last `track_states` iterations (None when off)
     loss_history: Optional[Array] = None    # [T]
     gnorm_history: Optional[Array] = None   # [T]
+    step_history: Optional[Array] = None    # [T] accepted step sizes (NaN
+    #                                         where the solver has no step)
 
 
 class StateTracking(NamedTuple):
-    """While-loop carry fragment for the per-iteration ring buffer."""
+    """While-loop carry fragment for the per-iteration ring buffer.
+
+    Device-resident by design: the series accumulate inside the jitted
+    while-loop carry and only cross to the host when a tracker/report
+    actually reads them — never via callbacks staged into the loop.
+    """
 
     loss: Array    # [T]
     gnorm: Array   # [T]
+    step: Array    # [T] accepted step size (NaN for steps the solver
+    #                doesn't parameterize, e.g. TRON's trust region)
 
     @staticmethod
     def init(size: int, dtype) -> Optional["StateTracking"]:
         if size <= 0:
             return None
         nan = jnp.full((size,), jnp.nan, dtype)
-        return StateTracking(loss=nan, gnorm=nan)
+        return StateTracking(loss=nan, gnorm=nan, step=nan)
 
-    def record(self, it: Array, f: Array, g: Array) -> "StateTracking":
+    def record(self, it: Array, f: Array, g: Array,
+               step: Optional[Array] = None) -> "StateTracking":
         slot = it % self.loss.shape[0]
         return StateTracking(
             loss=self.loss.at[slot].set(f),
             gnorm=self.gnorm.at[slot].set(jnp.linalg.norm(g)),
+            step=self.step.at[slot].set(
+                jnp.nan if step is None else step),
         )
 
 

@@ -174,7 +174,9 @@ def minimize(
             it=it, reason=reason,
             n_evals=c.n_evals + ls.num_evals + (1 if has_box else 0),
             ls_failed=~decreased,
-            trk=None if c.trk is None else c.trk.record(c.it, f_kept, g_kept),
+            trk=None if c.trk is None else c.trk.record(
+                c.it, f_kept, g_kept,
+                step=jnp.where(decreased, ls.step, 0.0)),
         )
 
     init = _Carry(
@@ -200,6 +202,7 @@ def minimize(
         iterations=out.it, reason=out.reason, num_fun_evals=out.n_evals,
         loss_history=None if out.trk is None else out.trk.loss,
         gnorm_history=None if out.trk is None else out.trk.gnorm,
+        step_history=None if out.trk is None else out.trk.step,
     )
 
 
@@ -444,7 +447,8 @@ def minimize_directional(
             it=it, reason=reason,
             n_evals=c.n_evals + 1,
             ls_failed=~decreased,
-            trk=None if c.trk is None else c.trk.record(c.it, f_kept, g_kept),
+            trk=None if c.trk is None else c.trk.record(
+                c.it, f_kept, g_kept, step=t),
         )
 
     gg0 = jnp.dot(g0, g0)
@@ -474,4 +478,5 @@ def minimize_directional(
         iterations=out.it, reason=out.reason, num_fun_evals=out.n_evals,
         loss_history=None if out.trk is None else out.trk.loss,
         gnorm_history=None if out.trk is None else out.trk.gnorm,
+        step_history=None if out.trk is None else out.trk.step,
     )

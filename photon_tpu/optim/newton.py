@@ -154,4 +154,5 @@ def minimize(
         iterations=out.it, reason=out.reason, num_fun_evals=out.n_evals,
         loss_history=None if out.tracking is None else out.tracking.loss,
         gnorm_history=None if out.tracking is None else out.tracking.gnorm,
+        step_history=None if out.tracking is None else out.tracking.step,
     )

@@ -187,4 +187,5 @@ def minimize(
         iterations=out.it, reason=out.reason, num_fun_evals=out.n_evals,
         loss_history=None if out.trk is None else out.trk.loss,
         gnorm_history=None if out.trk is None else out.trk.gnorm,
+        step_history=None if out.trk is None else out.trk.step,
     )

@@ -26,6 +26,8 @@ class OptimizationStatesTracker:
     gnorms: np.ndarray      # [k]
     iterations: int
     reason: ConvergenceReason
+    steps: Optional[np.ndarray] = None   # [k] accepted step sizes (NaN
+    #                                      where the solver has no step)
 
     @staticmethod
     def from_result(result: SolverResult) -> Optional["OptimizationStatesTracker"]:
@@ -41,10 +43,14 @@ class OptimizationStatesTracker:
             order = np.arange(it - size, it) % size
         losses, gnorms = loss[order], gn[order]
         valid = np.isfinite(losses)
+        steps = None
+        if result.step_history is not None:
+            steps = np.asarray(result.step_history)[order][valid]
         return OptimizationStatesTracker(
             losses=losses[valid], gnorms=gnorms[valid],
             iterations=it,
-            reason=ConvergenceReason(int(result.reason)))
+            reason=ConvergenceReason(int(result.reason)),
+            steps=steps)
 
     def summary(self) -> str:
         if not len(self.losses):
@@ -52,6 +58,20 @@ class OptimizationStatesTracker:
         return (f"{self.iterations} iters, loss {self.losses[0]:.6g} -> "
                 f"{self.losses[-1]:.6g}, ||g|| {self.gnorms[-1]:.3g}, "
                 f"{self.reason.name}")
+
+    def to_dict(self) -> Dict[str, object]:
+        """JSON-ready trajectory for the RunReport (pays the host
+        transfer if the arrays are still on device)."""
+        out: Dict[str, object] = {
+            "kind": "states",
+            "iterations": int(self.iterations),
+            "reason": self.reason.name,
+            "loss": [float(v) for v in np.asarray(self.losses)],
+            "gnorm": [float(v) for v in np.asarray(self.gnorms)],
+        }
+        if self.steps is not None:
+            out["step"] = [float(v) for v in np.asarray(self.steps)]
+        return out
 
 
 @dataclasses.dataclass
@@ -99,3 +119,15 @@ class RandomEffectOptimizationTracker:
         return (f"{self.num_entities} entities, iterations "
                 f"mean {mean_it:.1f} [{lo}, {hi}], reasons "
                 f"{self.reason_counts()}")
+
+    def to_dict(self) -> Dict[str, object]:
+        """JSON-ready per-entity outcome aggregate for the RunReport
+        (this is the drain point: the lazy device->host transfer in
+        ``_host`` happens here, at a phase boundary, not in the sweep)."""
+        mean_it, lo, hi = self.iteration_stats()
+        return {
+            "kind": "random_effect",
+            "num_entities": int(self.num_entities),
+            "iterations": {"mean": mean_it, "min": lo, "max": hi},
+            "reason_counts": self.reason_counts(),
+        }

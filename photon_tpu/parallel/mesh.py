@@ -475,3 +475,37 @@ def shard_sparse_features_model_parallel(
     return DataBatch(features=feats, labels=put_vec(batch.labels),
                      offsets=put_vec(batch.offsets),
                      weights=put_vec(batch.weights))
+
+
+def mesh_topology(mesh: Optional[Mesh] = None) -> dict:
+    """JSON-ready description of the run's process/device topology (and a
+    mesh's axis layout, when one is active) for the telemetry RunReport.
+
+    Safe to call before/without distributed init and with no accelerator:
+    everything is guarded, and nothing here forces backend initialization
+    beyond what the caller already did (a driver calls this after data is
+    placed, so devices are long since live).
+    """
+    out: dict = {}
+    try:
+        out["process_index"] = jax.process_index()
+        out["process_count"] = jax.process_count()
+        out["local_device_count"] = jax.local_device_count()
+        out["global_device_count"] = jax.device_count()
+        devs = jax.local_devices()
+        if devs:
+            out["platform"] = devs[0].platform
+            out["device_kind"] = getattr(devs[0], "device_kind", None)
+    except Exception:  # noqa: BLE001 — topology is best-effort telemetry
+        pass
+    if mesh is not None:
+        try:
+            out["mesh"] = {
+                "axis_names": list(mesh.axis_names),
+                "axis_sizes": {name: int(size) for name, size in
+                               zip(mesh.axis_names, mesh.devices.shape)},
+                "num_devices": int(mesh.devices.size),
+            }
+        except Exception:  # noqa: BLE001
+            pass
+    return out

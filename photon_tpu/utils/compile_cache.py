@@ -13,7 +13,12 @@ the standard deployment answer: ``jax.config.jax_compilation_cache_dir``.
 
 from __future__ import annotations
 
+import logging
 import os
+
+from photon_tpu.obs.metrics import registry as _metrics
+
+_logger = logging.getLogger("photon_tpu.compile_cache")
 
 _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "photon_tpu", "xla_cache")
@@ -61,6 +66,12 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     _enabled = True
+    # activation is observable: the gauge says whether the persistent cache
+    # is on, and the log line says where it lives (debuggability contract —
+    # "was the cache even active for this run?")
+    _metrics.gauge("compile_cache.enabled").set(1)
+    _metrics.counter("compile_cache.activations").inc()
+    _logger.info("persistent XLA compilation cache enabled at %s", path)
     return path
 
 
@@ -70,10 +81,15 @@ def maybe_enable() -> str | None:
     The cache is a pure optimization — any failure (unwritable HOME,
     missing jax config flags) is logged, never fatal."""
     if os.environ.get("PHOTON_TPU_NO_XLA_CACHE"):
+        _metrics.counter("compile_cache.disabled", reason="env_opt_out").inc()
+        _metrics.gauge("compile_cache.enabled").set(0)
+        _logger.info("persistent XLA cache disabled via PHOTON_TPU_NO_XLA_CACHE")
         return None
     try:
         return enable_persistent_cache()
     except Exception as e:  # noqa: BLE001 — optional feature must not kill a driver
+        _metrics.counter("compile_cache.disabled", reason="error").inc()
+        _metrics.gauge("compile_cache.enabled").set(0)
         import logging
         logging.getLogger("photon_tpu").warning(
             "persistent XLA cache unavailable: %r", e)

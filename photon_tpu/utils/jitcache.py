@@ -12,13 +12,36 @@ closure), and share it process-wide.
 
 Array-valued key parts are keyed by ``id``; the cached closure keeps the
 array alive, so an id cannot be re-used while its cache entry exists.
+
+Compile observability: every lookup lands in the telemetry metrics
+registry (``jitcache.hits`` / ``jitcache.misses`` — a miss is a fresh
+trace — ``jitcache.build_seconds``, ``jitcache.size``), and when the
+SAME logical program (the key with array identities erased) is built
+more than once, a recompile warning is logged and
+``jitcache.recompiles`` counts it: that is compile time a stable array
+identity would have saved. With telemetry enabled, the first call of
+each built program is additionally timed into the
+``jitcache.compile_seconds`` histogram — for a jitted builder product,
+first call = trace + XLA compile wall time.
 """
 
 from __future__ import annotations
 
+import functools
+import logging
+import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from photon_tpu.obs import _config as _obs_config
+from photon_tpu.obs.metrics import registry as _metrics
+
+_logger = logging.getLogger("photon_tpu.jitcache")
+
+_LOCK = threading.Lock()
 _CACHE: Dict[tuple, Callable] = {}
+# logical key (array ids erased) -> build count, for recompile detection
+_LOGICAL_BUILDS: Dict[tuple, int] = {}
 
 
 def array_token(a) -> Optional[Tuple[str, int]]:
@@ -26,16 +49,76 @@ def array_token(a) -> Optional[Tuple[str, int]]:
     return None if a is None else ("arr", id(a))
 
 
+def _logical_key(part: Any) -> Any:
+    """Erase array identities from a cache key, recursively: two keys that
+    differ only in ``("arr", id)`` tokens describe the same logical
+    program, so a second build of the same logical key is a recompile."""
+    if isinstance(part, tuple):
+        if len(part) == 2 and part[0] == "arr":
+            return "arr"
+        return tuple(_logical_key(p) for p in part)
+    return part
+
+
+def _timed_first_call(fn: Callable, key: tuple) -> Callable:
+    """Wrap a freshly-built program so its FIRST invocation (trace + XLA
+    compile for jitted builders) lands in ``jitcache.compile_seconds``.
+    Steady-state overhead after the first call is one flag check."""
+    done = [False]
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if done[0]:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        done[0] = True
+        _metrics.histogram("jitcache.compile_seconds").observe(dt)
+        _logger.debug("first call of %r: %.3fs (trace + compile)",
+                      key[0] if key else key, dt)
+        return out
+
+    return wrapped
+
+
 def get_or_build(key: tuple, builder: Callable[[], Callable]) -> Callable:
-    fn = _CACHE.get(key)
-    if fn is None:
-        fn = _CACHE[key] = builder()
+    with _LOCK:
+        fn = _CACHE.get(key)
+    if fn is not None:
+        _metrics.counter("jitcache.hits").inc()
+        return fn
+    _metrics.counter("jitcache.misses").inc()
+    t0 = time.perf_counter()
+    built = builder()
+    dt = time.perf_counter() - t0
+    _metrics.counter("jitcache.build_seconds").inc(dt)
+    if _obs_config.enabled():
+        built = _timed_first_call(built, key)
+    lk = _logical_key(key)
+    with _LOCK:
+        fn = _CACHE.get(key)
+        if fn is None:  # first build wins under concurrency
+            fn = _CACHE[key] = built
+            n = _LOGICAL_BUILDS[lk] = _LOGICAL_BUILDS.get(lk, 0) + 1
+            _metrics.gauge("jitcache.size").set(len(_CACHE))
+        else:
+            n = 1
+    if n > 1:
+        _metrics.counter("jitcache.recompiles").inc()
+        _logger.warning(
+            "recompile: logical program %r built %d times (array identities "
+            "changed); reuse the captured arrays to share the compilation",
+            lk[0] if isinstance(lk, tuple) and lk else lk, n)
     return fn
 
 
 def cache_size() -> int:
-    return len(_CACHE)
+    with _LOCK:
+        return len(_CACHE)
 
 
 def clear() -> None:
-    _CACHE.clear()
+    with _LOCK:
+        _CACHE.clear()
+        _LOGICAL_BUILDS.clear()

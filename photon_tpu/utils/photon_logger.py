@@ -45,7 +45,20 @@ class PhotonLogger:
         self.path = os.path.join(output_dir, filename)
         self.logger = logging.getLogger(name)
         self.logger.setLevel(parse_level(level))
+        # de-duplicate: ``logging.getLogger(name)`` is shared process-wide,
+        # so a second PhotonLogger with the same name would stack another
+        # FileHandler onto it and every line would be written twice. Evict
+        # any handler WE previously attached for the same target file
+        # (foreign handlers and different-path sinks are left alone).
+        target = os.path.abspath(self.path)
+        for h in list(self.logger.handlers):
+            if (getattr(h, "_photon_tpu_owned", False)
+                    and os.path.abspath(getattr(h, "baseFilename", ""))
+                    == target):
+                self.logger.removeHandler(h)
+                h.close()
         self._handler = logging.FileHandler(self.path)
+        self._handler._photon_tpu_owned = True
         self._handler.setFormatter(logging.Formatter(_FORMAT))
         self.logger.addHandler(self._handler)
 

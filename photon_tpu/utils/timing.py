@@ -8,6 +8,14 @@ CoordinateDescent.scala:178-185).
 Used as either a context manager or a decorator; durations are also
 recorded in a process-wide registry so drivers can dump a timing summary
 (the Spark-UI stage-view stand-in).
+
+``Timed`` is now a shim over the telemetry span system (photon_tpu/obs/
+spans.py): when telemetry is enabled, every Timed block additionally
+records a nested trace span (Perfetto-exportable, aligned with device
+traces via jax.profiler.TraceAnnotation) and lands in the RunReport's
+phase list. The legacy ``_TIMINGS`` registry keeps its exact behavior —
+and is now thread-safe, so concurrent RE solves and the bench harness
+can't corrupt or interleave the summary.
 """
 
 from __future__ import annotations
@@ -15,25 +23,32 @@ from __future__ import annotations
 import contextlib
 import functools
 import logging
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from photon_tpu.obs.spans import span as _obs_span
+
 _default_logger = logging.getLogger("photon_tpu.timing")
 
-# (label, seconds) in completion order
+# (label, seconds) in completion order; guarded by _TIMINGS_LOCK
 _TIMINGS: List[Tuple[str, float]] = []
+_TIMINGS_LOCK = threading.Lock()
 
 
 def timing_records() -> List[Tuple[str, float]]:
-    return list(_TIMINGS)
+    with _TIMINGS_LOCK:
+        return list(_TIMINGS)
 
 
 def clear_timings() -> None:
-    _TIMINGS.clear()
+    with _TIMINGS_LOCK:
+        _TIMINGS.clear()
 
 
 def timing_summary() -> str:
-    lines = [f"  {label}: {secs:.3f}s" for label, secs in _TIMINGS]
+    records = timing_records()
+    lines = [f"  {label}: {secs:.3f}s" for label, secs in records]
     return "timing summary:\n" + "\n".join(lines) if lines else "no timings"
 
 
@@ -48,12 +63,17 @@ class Timed(contextlib.AbstractContextManager):
         self.seconds: Optional[float] = None
 
     def __enter__(self) -> "Timed":
+        # span shim: no-op (two attribute writes) when telemetry is off
+        self._span = _obs_span(self.label)
+        self._span.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.seconds = time.perf_counter() - self._t0
-        _TIMINGS.append((self.label, self.seconds))
+        self._span.__exit__(exc_type, exc, tb)
+        with _TIMINGS_LOCK:
+            _TIMINGS.append((self.label, self.seconds))
         status = "" if exc_type is None else " [FAILED]"
         self.logger.log(self.level, "%s (%.3f s)%s", self.label,
                         self.seconds, status)

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Static guard: no host-sync primitives inside solver code.
+
+The telemetry contract (photon_tpu/obs) is zero-overhead-when-disabled
+AND zero-staged-into-jit-when-enabled: device-resident solver series ride
+the ``lax.while_loop`` carry as ordinary outputs (optim/base.py
+StateTracking), never via callbacks. A ``jax.debug.callback`` /
+``io_callback`` staged into a jitted loop body would force a host
+round-trip per iteration and silently serialize every solve; a
+``.block_until_ready`` in solver code would stall the dispatch pipeline.
+
+This script walks ``photon_tpu/optim/`` (plus ``photon_tpu/game/``,
+which drives the jitted solves) with an AST visitor and fails — with
+file:line — on any of:
+
+  * ``jax.debug.callback`` / ``jax.debug.print``
+  * ``io_callback`` / ``jax.experimental.io_callback`` / ``pure_callback``
+  * ``<expr>.block_until_ready(...)``
+
+Escape hatch for genuinely host-side helpers (NOT loop bodies): put the
+marker comment ``host-sync-ok`` on the offending line.
+
+Wired into tier-1 via tests/test_observability.py; also runnable
+standalone::
+
+    python scripts/check_no_host_sync.py
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = (
+    os.path.join(REPO, "photon_tpu", "optim"),
+    os.path.join(REPO, "photon_tpu", "game"),
+)
+MARKER = "host-sync-ok"
+
+# attribute-call names that force a host round-trip
+BANNED_ATTRS = {"block_until_ready"}
+# bare or dotted function names that stage host callbacks into jit
+BANNED_CALLS = {"io_callback", "pure_callback"}
+# dotted paths (matched as suffix chains on Attribute nodes)
+BANNED_PATHS = (
+    ("debug", "callback"),
+    ("debug", "print"),
+    ("experimental", "io_callback"),
+)
+
+
+def _dotted(node: ast.AST) -> Tuple[str, ...]:
+    """Attribute chain as a name tuple: jax.debug.callback ->
+    ('jax', 'debug', 'callback')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return tuple(reversed(parts))
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, source_lines: List[str]):
+        self.path = path
+        self.lines = source_lines
+        self.violations: List[str] = []
+
+    def _flag(self, node: ast.Call, what: str) -> None:
+        line = self.lines[node.lineno - 1] if node.lineno <= len(self.lines) \
+            else ""
+        if MARKER in line:
+            return
+        rel = os.path.relpath(self.path, REPO)
+        self.violations.append(f"{rel}:{node.lineno}: {what}")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in BANNED_ATTRS:
+                self._flag(node, f".{fn.attr}() forces a host sync")
+            chain = _dotted(fn)
+            if fn.attr in BANNED_CALLS:
+                self._flag(node, f"{'.'.join(chain) or fn.attr}() stages a "
+                                 "host callback into jit")
+            else:
+                for path in BANNED_PATHS:
+                    if chain[-len(path):] == path:
+                        self._flag(node, f"{'.'.join(chain)}() stages a "
+                                         "host callback into jit")
+                        break
+        elif isinstance(fn, ast.Name) and fn.id in BANNED_CALLS:
+            self._flag(node, f"{fn.id}() stages a host callback into jit")
+        self.generic_visit(node)
+
+
+def check(paths=SCAN_DIRS) -> List[str]:
+    violations: List[str] = []
+    for root in paths:
+        for dirpath, _dirs, files in os.walk(root):
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path) as f:
+                    src = f.read()
+                try:
+                    tree = ast.parse(src, filename=path)
+                except SyntaxError as e:
+                    violations.append(f"{path}: unparseable: {e}")
+                    continue
+                v = _Visitor(path, src.splitlines())
+                v.visit(tree)
+                violations.extend(v.violations)
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if violations:
+        print("host-sync primitives found in solver code "
+              f"(mark intentional host-side lines with '{MARKER}'):")
+        for v in violations:
+            print(f"  {v}")
+        return 1
+    print("ok: no host-sync primitives in photon_tpu/optim or "
+          "photon_tpu/game")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,24 @@ def _sparse_tp(pid, nproc, out):
         np.save(out, coefs)
 
 
+def _obs(pid, nproc, out):
+    """Telemetry aggregation across the 2-process cluster: each process
+    bumps distinct counter values and runs a span; ``write_run_report``
+    with ``aggregate=True`` gathers everything to process 0 (the only
+    collectives telemetry ever issues — at report time, never in a hot
+    path)."""
+    import jax
+    from photon_tpu import obs
+
+    obs.configure(True)
+    with obs.span("obs/worker", pid=pid):
+        obs.metrics.counter("obs_test.work").inc(pid + 1)
+        obs.metrics.gauge("obs_test.pid").set(pid)
+    rep = obs.write_run_report(out, driver="obs-test", aggregate=True)
+    print(f"proc {pid}: devices {len(jax.devices())} "
+          f"wrote-report {rep is not None}", flush=True)
+
+
 def main():
     pid, nproc, port, out = (int(sys.argv[1]), int(sys.argv[2]),
                              sys.argv[3], sys.argv[4])
@@ -90,6 +108,8 @@ def main():
 
     if mode == "sparse_tp":
         return _sparse_tp(pid, nproc, out)
+    if mode == "obs":
+        return _obs(pid, nproc, out)
 
     import numpy as np
 

@@ -248,3 +248,343 @@ def test_score_driver_emits_events(tmp_path):
     assert names == ["PhotonSetupEvent", "ScoringFinishEvent", "closed"]
     assert RecordingListener.captured[1].payload["num_scored"] == 300
     assert RecordingListener.captured[1].payload["evaluation"]["AUC"] > 0.5
+
+
+# -- unified telemetry subsystem (photon_tpu/obs) ---------------------------
+
+import json
+import subprocess
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def obs():
+    """Fresh, ENABLED telemetry state per test; fully reset afterwards so
+    the disabled-by-default contract holds for every other test."""
+    from photon_tpu import obs as obs_mod
+
+    obs_mod.reset()
+    obs_mod.configure(True)
+    yield obs_mod
+    obs_mod.reset()
+
+
+def test_metrics_registry_counters_gauges_histograms(obs):
+    from photon_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("requests").inc()
+    reg.counter("requests").inc(2.5)
+    reg.counter("requests", shard="a").inc(7)
+    reg.gauge("depth").set(3)
+    reg.gauge("depth").max(1)          # watermark: stays 3
+    reg.histogram("latency", buckets=(0.1, 1.0)).observe(0.05)
+    reg.histogram("latency", buckets=(0.1, 1.0)).observe(0.5)
+    reg.histogram("latency", buckets=(0.1, 1.0)).observe(50.0)
+
+    snap = reg.snapshot()
+    assert snap["counters"]["requests"] == 3.5
+    assert snap["counters"]['requests{shard="a"}'] == 7
+    assert snap["gauges"]["depth"] == 3
+    h = snap["histograms"]["latency"]
+    assert h["count"] == 3 and h["counts"] == [1, 1, 1]  # 0.1, 1.0, +Inf
+    assert h["sum"] == pytest.approx(50.55)
+    # snapshot round-trips through JSON
+    assert json.loads(reg.to_json()) == snap
+
+    with pytest.raises(ValueError):
+        reg.counter("requests").inc(-1)
+    with pytest.raises(ValueError):
+        reg.gauge("requests")  # kind conflict on the same name
+
+
+def test_metrics_prometheus_text_format(obs):
+    from photon_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("jitcache.hits").inc(4)
+    reg.histogram("compile.seconds", buckets=(1.0, 10.0)).observe(0.5)
+    reg.histogram("compile.seconds", buckets=(1.0, 10.0)).observe(5.0)
+    text = reg.to_prometheus_text()
+    assert "# TYPE jitcache_hits counter" in text
+    assert "jitcache_hits 4" in text
+    assert "# TYPE compile_seconds histogram" in text
+    # cumulative le buckets + +Inf + sum/count
+    assert 'compile_seconds_bucket{le="1.0"} 1' in text
+    assert 'compile_seconds_bucket{le="10.0"} 2' in text
+    assert 'compile_seconds_bucket{le="+Inf"} 2' in text
+    assert "compile_seconds_count 2" in text
+
+
+def test_merge_snapshots_cluster_semantics(obs):
+    from photon_tpu.obs.metrics import MetricsRegistry, merge_snapshots
+
+    snaps = []
+    for pid in (0, 1):
+        reg = MetricsRegistry()
+        reg.counter("work").inc(pid + 1)
+        reg.gauge("watermark").set(10 * (pid + 1))
+        reg.histogram("lat", buckets=(1.0,)).observe(0.5)
+        snaps.append(reg.snapshot())
+    merged = merge_snapshots(snaps)
+    assert merged["counters"]["work"] == 3          # sum
+    assert merged["gauges"]["watermark"] == 20      # max
+    assert merged["histograms"]["lat"]["count"] == 2
+
+
+def test_span_nesting_and_trace_roundtrip(obs, tmp_path):
+    from photon_tpu.obs import spans
+
+    with obs.span("outer", config=1):
+        with obs.span("inner"):
+            pass
+        with obs.span("inner2"):
+            pass
+    recs = spans.records()
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["inner"]["parent"] == "outer"
+    assert by_name["inner"]["depth"] == 1
+    assert by_name["outer"]["parent"] is None
+    assert by_name["outer"]["args"] == {"config": 1}
+    # containment: child interval inside parent interval
+    o, i = by_name["outer"], by_name["inner"]
+    assert o["ts_us"] <= i["ts_us"]
+    assert i["ts_us"] + i["dur_us"] <= o["ts_us"] + o["dur_us"] + 1
+
+    path = str(tmp_path / "trace.json")
+    obs.write_trace(path)
+    trace = json.load(open(path))
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    for ev in trace["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+        assert "pid" in ev and "tid" in ev
+    names = {ev["name"] for ev in trace["traceEvents"]}
+    assert {"outer", "inner", "inner2"} <= names
+
+
+def test_span_disabled_is_noop():
+    from photon_tpu import obs as obs_mod
+    from photon_tpu.obs import spans
+
+    obs_mod.reset()   # disabled unless PHOTON_TPU_TELEMETRY is set
+    os.environ.pop("PHOTON_TPU_TELEMETRY", None)
+    before = len(spans.records())
+    with obs_mod.span("ghost"):
+        pass
+    with obs_mod.annotate("ghost2"):
+        pass
+    assert len(spans.records()) == before
+    obs_mod.reset()
+
+
+def test_timed_is_a_span_shim(obs):
+    from photon_tpu.obs import spans
+    from photon_tpu.utils.timing import Timed, clear_timings, timing_records
+
+    clear_timings()
+    with Timed("shim-phase"):
+        pass
+    # legacy registry still fed...
+    assert [r[0] for r in timing_records()] == ["shim-phase"]
+    # ...and the span buffer got the same phase
+    assert any(r["name"] == "shim-phase" for r in spans.records())
+
+
+def test_timings_registry_thread_safety():
+    from photon_tpu.utils.timing import Timed, clear_timings, timing_records
+
+    clear_timings()
+    n_threads, per_thread = 8, 50
+
+    def work(tid):
+        for i in range(per_thread):
+            with Timed(f"t{tid}-{i}", level=logging.DEBUG):
+                pass
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recs = timing_records()
+    assert len(recs) == n_threads * per_thread
+    # no torn/interleaved records: every entry is a well-formed pair
+    assert all(isinstance(label, str) and secs >= 0 for label, secs in recs)
+    clear_timings()
+
+
+def test_jitcache_hit_miss_counters(obs):
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.utils import jitcache
+
+    jitcache.clear()
+    registry.clear()
+    built = []
+
+    def builder():
+        built.append(1)
+        return lambda x: x + 1
+
+    fn = jitcache.get_or_build(("obs_test", 1), builder)
+    assert fn(1) == 2
+    jitcache.get_or_build(("obs_test", 1), builder)
+    jitcache.get_or_build(("obs_test", 1), builder)
+    snap = registry.snapshot()
+    assert snap["counters"]["jitcache.misses"] == 1
+    assert snap["counters"]["jitcache.hits"] == 2
+    assert len(built) == 1
+    assert snap["gauges"]["jitcache.size"] >= 1
+    # telemetry enabled: first call of the built program was timed
+    assert snap["histograms"]["jitcache.compile_seconds"]["count"] == 1
+    jitcache.clear()
+    registry.clear()
+
+
+def test_jitcache_recompile_warning(obs, caplog):
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.utils import jitcache
+
+    jitcache.clear()
+    registry.clear()
+    a1 = np.zeros(3)
+    a2 = np.zeros(3)  # same logical program, different array identity
+    with caplog.at_level(logging.WARNING, logger="photon_tpu.jitcache"):
+        jitcache.get_or_build(("solve", jitcache.array_token(a1)),
+                              lambda: (lambda: 0))
+        jitcache.get_or_build(("solve", jitcache.array_token(a2)),
+                              lambda: (lambda: 0))
+    assert registry.snapshot()["counters"]["jitcache.recompiles"] == 1
+    assert any("recompile" in r.message for r in caplog.records)
+    jitcache.clear()
+    registry.clear()
+
+
+def test_photon_logger_no_duplicate_handlers(tmp_path):
+    """Regression: two PhotonLoggers on the same name+file used to stack
+    FileHandlers and double every line."""
+    from photon_tpu.utils.photon_logger import PhotonLogger
+
+    out = str(tmp_path / "job")
+    pl1 = PhotonLogger(out, name="photon_tpu.dup_test")
+    pl2 = PhotonLogger(out, name="photon_tpu.dup_test")  # same target file
+    pl2.info("exactly once")
+    pl2.flush()
+    text = open(os.path.join(out, "driver.log")).read()
+    assert text.count("exactly once") == 1
+    # photon-owned handlers for the same file were deduplicated
+    owned = [h for h in pl2.logger.handlers
+             if getattr(h, "_photon_tpu_owned", False)]
+    assert len(owned) == 1
+    # a foreign handler must survive the dedup
+    foreign = logging.NullHandler()
+    pl2.logger.addHandler(foreign)
+    pl3 = PhotonLogger(out, name="photon_tpu.dup_test")
+    assert foreign in pl3.logger.handlers
+    pl3.logger.removeHandler(foreign)
+    pl3.close()
+
+
+def test_solver_step_history_recorded():
+    from photon_tpu.optim import lbfgs
+    from photon_tpu.optim.base import SolverConfig
+    from photon_tpu.optim.tracking import OptimizationStatesTracker
+
+    center = jnp.asarray(np.arange(1.0, 6.0))
+    res = lbfgs.minimize(_quadratic(center), jnp.zeros(5),
+                         config=SolverConfig(max_iterations=50,
+                                             tolerance=1e-10,
+                                             track_states=100))
+    assert res.step_history is not None
+    trk = OptimizationStatesTracker.from_result(res)
+    assert trk.steps is not None and len(trk.steps) == len(trk.losses)
+    # at least one accepted step with a positive step size
+    assert np.nanmax(trk.steps) > 0
+    d = trk.to_dict()
+    assert d["kind"] == "states"
+    assert len(d["loss"]) == len(d["step"])
+    json.dumps(d)  # JSON-clean
+
+
+def test_run_report_schema_from_train_driver(obs, tmp_path):
+    """Acceptance: fast CPU train-driver run with telemetry on writes a
+    RunReport that round-trips json.loads, has start<=end on every phase
+    span, and a monotone per-iteration loss for the convex problem."""
+    from photon_tpu.cli import train
+    from tests.test_drivers import FIXED_COORD, _write_game_records
+
+    data = str(tmp_path / "data" / "train.avro")
+    _write_game_records(data, n=300, seed=11)
+    out = str(tmp_path / "out")
+    train.run(train.build_arg_parser().parse_args([
+        "--input-data-directories", os.path.dirname(data),
+        "--root-output-directory", out,
+        "--training-task", "LOGISTIC_REGRESSION",
+        "--feature-shard-configuration", "name=global,feature.bags=features",
+        "--coordinate-configuration", FIXED_COORD,
+        "--coordinate-update-sequence", "fixed",
+        "--telemetry",
+    ]))
+
+    report = json.loads(open(os.path.join(out, "runreport.json")).read())
+    assert obs.validate_run_report(report) == []
+    assert report["schema"] == "photon_tpu.runreport.v1"
+    assert report["driver"] == "game-train"
+    names = [p["name"] for p in report["phases"]]
+    assert "train" in names and "read training data" in names
+    for p in report["phases"]:
+        assert p["start_unix"] <= p["end_unix"] + 1e-9
+
+    # convex logistic + L2: the tracked per-iteration loss is monotone
+    trajs = report["solver"]["trajectories"]
+    assert trajs, "telemetry run must drain at least one solver trajectory"
+    losses = trajs[0]["loss"]
+    assert len(losses) >= 2
+    assert all(a >= b - 1e-9 for a, b in zip(losses, losses[1:]))
+
+    # memory watermarks per top-level phase
+    assert "train" in report["memory"]
+    assert report["memory"]["train"]["host"]["peak_rss_bytes"] > 0
+
+    # the Perfetto trace is alongside and loads as chrome trace JSON
+    trace = json.load(open(os.path.join(out, "trace.json")))
+    assert trace["traceEvents"]
+    assert any(ev["name"] == "train" for ev in trace["traceEvents"])
+
+
+def test_multiprocess_telemetry_aggregation(tmp_path):
+    """Two OS processes bump distinct counters; write_run_report with
+    aggregate=True gathers everything to process 0 (skip-guarded like the
+    other multihost tests when the distributed runtime is unavailable)."""
+    from tests.test_multihost import _run_workers
+
+    out = str(tmp_path / "runreport.json")
+    logs = _run_workers(out, mode="obs")
+    assert any("wrote-report True" in l for l in logs), logs
+    assert any("wrote-report False" in l for l in logs), logs  # proc 1
+
+    report = json.loads(open(out).read())
+    from photon_tpu import obs as obs_mod
+    assert obs_mod.validate_run_report(report) == []
+    assert report["process"]["count"] == 2
+    assert len(report["processes"]) == 2
+    # counters sum across processes: proc0 inc(1) + proc1 inc(2)
+    assert report["metrics_aggregated"]["counters"]["obs_test.work"] == 3
+    # gauges take the cluster max
+    assert report["metrics_aggregated"]["gauges"]["obs_test.pid"] == 1
+
+
+def test_no_host_sync_static_check():
+    """Tier-1 wiring for scripts/check_no_host_sync.py: solver code must
+    stay free of host-sync primitives (callbacks staged into jit,
+    block_until_ready)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_no_host_sync.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    assert r.returncode == 0, r.stdout
+    assert "ok:" in r.stdout
