@@ -108,6 +108,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    choices=[v.value for v in VarianceComputationType])
     p.add_argument("--data-validation", default="VALIDATE_FULL",
                    choices=[v.value for v in DataValidationType])
+    p.add_argument("--data-validation-drop-invalid", action="store_true",
+                   help="drop rows with non-finite/invalid fields instead "
+                        "of failing the run (counts are logged and reported "
+                        "via telemetry)")
     p.add_argument("--hyper-parameter-tuning", default="NONE",
                    choices=[m.value for m in HyperparameterTuningMode])
     p.add_argument("--hyper-parameter-tuning-iter", type=int, default=0)
@@ -340,7 +344,10 @@ def _run(args: argparse.Namespace) -> List:
             validation_df, _ = read_frame(val_dirs, index_maps)
 
     with Timed("data validation", logger):
-        validate_dataframe(df, task, DataValidationType(args.data_validation))
+        df = validate_dataframe(
+            df, task, DataValidationType(args.data_validation),
+            drop_invalid_rows=getattr(args, "data_validation_drop_invalid",
+                                      False))
 
     shard_ids = sorted({p.configuration.data.feature_shard_id for p in parsed})
     with Timed("feature stats + normalization", logger):
@@ -388,12 +395,27 @@ def _run(args: argparse.Namespace) -> List:
     if args.profile_dir:
         import jax
         profile_cm = jax.profiler.trace(args.profile_dir)
-    with profile_cm, Timed(f"train {len(sweeps)} configuration(s)", logger):
-        results = estimator.fit(df, validation_df=validation_df,
-                                configurations=sweeps,
-                                initial_model=initial_model,
-                                checkpoint_dir=ckpt_dir,
-                                resume=bool(args.resume_from))
+    from photon_tpu.resilience.failures import (
+        CoordinateFailureError,
+        PreemptionRequested,
+    )
+    try:
+        with profile_cm, Timed(f"train {len(sweeps)} configuration(s)",
+                               logger):
+            results = estimator.fit(df, validation_df=validation_df,
+                                    configurations=sweeps,
+                                    initial_model=initial_model,
+                                    checkpoint_dir=ckpt_dir,
+                                    resume=bool(args.resume_from))
+    except (PreemptionRequested, CoordinateFailureError) as e:
+        # the exception carries the emergency checkpoint path published at
+        # the abort boundary; flush telemetry so the RunReport records the
+        # failure trail, then let main() map it to a distinct exit code
+        logger.warning("training interrupted: %s", e)
+        _root_span.__exit__(None, None, None)
+        _write_telemetry_artifacts(out_dir, mesh, len(sweeps),
+                                   update_sequence)
+        raise
     _emit_optimization_logs(estimator, results)
 
     tuned = []
@@ -429,22 +451,32 @@ def _run(args: argparse.Namespace) -> List:
         else dict(best.evaluation)))
     save_models(args, estimator, results, tuned, index_maps, out_dir)
     _root_span.__exit__(None, None, None)
-    if obs.enabled():
-        try:
-            report_path = os.path.join(out_dir, "runreport.json")
-            obs.write_run_report(
-                report_path, driver="game-train",
-                mesh=mesh,
-                extra={"configurations": len(sweeps),
-                       "coordinates": list(update_sequence)},
-                aggregate=True)
-            trace_path = os.path.join(out_dir, "trace.json")
-            obs.write_trace(trace_path)
-            logger.info("telemetry: run report at %s, trace at %s",
-                        report_path, trace_path)
-        except Exception as e:  # noqa: BLE001 — telemetry must never fail a run
-            logger.warning("failed to write telemetry artifacts: %r", e)
+    _write_telemetry_artifacts(out_dir, mesh, len(sweeps), update_sequence)
     return results + tuned
+
+
+def _write_telemetry_artifacts(out_dir, mesh, n_configurations,
+                               update_sequence) -> None:
+    """RunReport + trace flush — shared by the normal exit path and the
+    preemption/failure emergency path."""
+    from photon_tpu import obs
+
+    if not obs.enabled():
+        return
+    try:
+        report_path = os.path.join(out_dir, "runreport.json")
+        obs.write_run_report(
+            report_path, driver="game-train",
+            mesh=mesh,
+            extra={"configurations": n_configurations,
+                   "coordinates": list(update_sequence)},
+            aggregate=True)
+        trace_path = os.path.join(out_dir, "trace.json")
+        obs.write_trace(trace_path)
+        logger.info("telemetry: run report at %s, trace at %s",
+                    report_path, trace_path)
+    except Exception as e:  # noqa: BLE001 — telemetry must never fail a run
+        logger.warning("failed to write telemetry artifacts: %r", e)
 
 
 def _best_result(estimator: GameEstimator, results: List):
@@ -495,9 +527,31 @@ def save_models(args, estimator, results, tuned, index_maps, out_dir) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> None:
+    from photon_tpu.resilience import shutdown as _shutdown
+    from photon_tpu.resilience.failures import (
+        EXIT_COORDINATE_FAILURE,
+        EXIT_PREEMPTED,
+        CoordinateFailureError,
+        PreemptionRequested,
+    )
     from photon_tpu.utils.compile_cache import maybe_enable
     maybe_enable()
-    run(build_arg_parser().parse_args(argv))
+    # SIGTERM/SIGINT -> graceful stop at the next coordinate boundary with
+    # an emergency checkpoint (resilience/shutdown.py); a second SIGINT
+    # still kills immediately
+    _shutdown.install()
+    try:
+        run(build_arg_parser().parse_args(argv))
+    except PreemptionRequested as e:
+        logger.warning("preempted (%s); emergency checkpoint: %s",
+                       _shutdown.reason(), e.checkpoint_path)
+        sys.exit(EXIT_PREEMPTED)
+    except CoordinateFailureError as e:
+        logger.error("training aborted: %s (resume from checkpoint: %s)",
+                     e, e.checkpoint_path)
+        sys.exit(EXIT_COORDINATE_FAILURE)
+    finally:
+        _shutdown.uninstall()
 
 
 if __name__ == "__main__":

@@ -55,18 +55,29 @@ def validate_dataframe(
     df: GameDataFrame,
     task: TaskType,
     validation: DataValidationType = DataValidationType.VALIDATE_FULL,
-) -> None:
-    """Raise DataValidationError on any violated rule (reference:
-    DataValidators.sanityCheckDataFrameForTraining)."""
+    drop_invalid_rows: bool = False,
+) -> GameDataFrame:
+    """Validate and return the frame (reference:
+    DataValidators.sanityCheckDataFrameForTraining).
+
+    Default: raise DataValidationError listing per-rule violation counts.
+    With ``drop_invalid_rows``, rows failing ANY rule are filtered out
+    instead (a new frame is returned; the drop count is logged and
+    reported through obs metrics + the resilience failure trail). Only
+    rows the mode actually checked are dropped — VALIDATE_SAMPLE cannot
+    vouch for the rows it skipped."""
     if validation == DataValidationType.VALIDATE_DISABLED:
-        return
+        return df
     mask = _row_mask(df, validation)
     violations: Dict[str, int] = {}
+    bad_rows = np.zeros(df.num_samples, bool)
 
     def check(name: str, ok: np.ndarray):
-        bad = int(np.sum(~ok & mask))
-        if bad:
-            violations[name] = bad
+        bad = ~ok & mask
+        n_bad = int(np.sum(bad))
+        if n_bad:
+            violations[name] = n_bad
+            np.logical_or(bad_rows, bad, out=bad_rows)
 
     y = np.asarray(df.response, float)
     check("finite labels", np.isfinite(y))
@@ -95,6 +106,58 @@ def validate_dataframe(
         check(f"finite features [{sid}]", ok)
 
     if violations:
-        raise DataValidationError(violations)
+        if not drop_invalid_rows:
+            raise DataValidationError(violations)
+        df = _drop_rows(df, bad_rows)
+        n_dropped = int(bad_rows.sum())
+        logger.warning("data validation dropped %d invalid row(s): %s",
+                       n_dropped, violations)
+        try:
+            from photon_tpu.obs.metrics import registry
+            registry.counter("data.invalid_rows_dropped").inc(n_dropped)
+        except Exception:  # pragma: no cover - telemetry must not fail
+            logger.debug("metrics emission failed", exc_info=True)
+        from photon_tpu.resilience import failures
+        failures.record_failure("invalid_rows_dropped", rows=n_dropped,
+                                violations=dict(violations))
+        return df
     logger.info("data validation passed (%s rows, mode %s)",
                 int(mask.sum()), validation.value)
+    return df
+
+
+def _drop_rows(df: GameDataFrame, bad_rows: np.ndarray) -> GameDataFrame:
+    """New GameDataFrame with the flagged rows filtered from every
+    columnar container (response/offsets/weights/id_tags/feature shards,
+    in all three shard storage forms)."""
+    from photon_tpu.game.dataset import CsrRows, FeatureShard
+
+    keep = ~bad_rows
+    keep_idx = np.flatnonzero(keep)
+
+    shards: Dict[str, FeatureShard] = {}
+    for sid, shard in df.feature_shards.items():
+        if shard.is_dense:
+            rows = np.asarray(shard.rows)[keep]
+        elif isinstance(shard.rows, CsrRows):
+            nnz = shard.rows.row_nnz()
+            el = np.repeat(keep, nnz)
+            indptr = np.zeros(len(keep_idx) + 1, np.int64)
+            np.cumsum(nnz[keep], out=indptr[1:])
+            rows = CsrRows(indptr, shard.rows.cols[el], shard.rows.vals[el])
+        else:
+            rows = [shard.rows[i] for i in keep_idx]
+        shards[sid] = FeatureShard(rows=rows, dim=shard.dim)
+
+    def take(col):
+        return None if col is None else np.asarray(col)[keep]
+
+    return GameDataFrame(
+        num_samples=int(keep.sum()),
+        response=np.asarray(df.response)[keep],
+        feature_shards=shards,
+        offsets=take(df.offsets),
+        weights=take(df.weights),
+        id_tags={tag: list(np.asarray(vals, dtype=object)[keep])
+                 for tag, vals in df.id_tags.items()},
+    )

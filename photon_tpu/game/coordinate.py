@@ -23,6 +23,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_tpu.data.dataset import DataBatch
 from photon_tpu.data.sampling import maybe_downsample
@@ -33,6 +34,7 @@ from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.ops import features as F
 from photon_tpu.ops.losses import loss_for_task
 from photon_tpu.optim import lbfgs, owlqn, tron
+from photon_tpu.optim.base import FailureMode
 from photon_tpu.optim.problem import (
     GLMOptimizationConfiguration,
     GlmOptimizationProblem,
@@ -150,6 +152,13 @@ class FixedEffectCoordinate:
             if extra:  # mesh padding: zero residual on zero-weight pad rows
                 residual_scores = jnp.pad(residual_scores, (0, extra))
             batch = batch.add_scores_to_offsets(residual_scores)
+        if getattr(self, "_chaos_poison_once", False):
+            # fault injection (resilience/chaos.py): a NaN offset poisons
+            # the first objective evaluation exactly like a corrupt
+            # upstream residual would
+            self._chaos_poison_once = False
+            batch = batch.add_scores_to_offsets(
+                jnp.full((batch.num_samples,), jnp.nan, batch.labels.dtype))
         if self._sampling_key is not None and self.config.down_sampling_rate < 1.0:
             # fresh subsample per coordinate-descent sweep (the reference
             # draws a new down-sample on every update)
@@ -179,6 +188,14 @@ class FixedEffectCoordinate:
         from photon_tpu.optim.tracking import OptimizationStatesTracker
         self.last_result = result
         self.last_tracker = OptimizationStatesTracker.from_result(result)
+        # one scalar host read at the coordinate boundary (never inside the
+        # solve): the descent driver must branch on failure in Python to
+        # roll the coordinate back
+        self.last_failure = None
+        if result.failure is not None:
+            code = int(np.asarray(result.failure))
+            if code != FailureMode.NONE:
+                self.last_failure = FailureMode(code)
         from photon_tpu.types import VarianceComputationType
         if self.variance_type != VarianceComputationType.NONE:
             # reference: DistributedOptimizationProblem.run computes
@@ -421,7 +438,9 @@ class RandomEffectCoordinate:
                 if f_row is not None:
                     coef = ctx.transformed_space_to_model(
                         coef, islot if s_row is not None else None)
-                return coef, r.iterations, r.reason
+                fail = (jnp.asarray(0, jnp.int32) if r.failure is None
+                        else r.failure)
+                return coef, r.iterations, r.reason, fail
 
             def solve_sparse(feat_idx, feat_val, *rest):
                 return solve_core(F.SparseFeatures(feat_idx, feat_val), *rest)
@@ -445,6 +464,7 @@ class RandomEffectCoordinate:
                 # per-entity solver stats (-1 = entity never trained)
                 iters = jnp.full((E,), -1, jnp.int32)
                 reasons = jnp.full((E,), -1, jnp.int32)
+                fails = jnp.zeros((E,), jnp.int32)
                 for blk, dense in zip(ds.blocks, dense_flags):
                     offsets = blk.offsets
                     if residual_flat is not None:
@@ -473,12 +493,17 @@ class RandomEffectCoordinate:
                             args.append(norm_islot.at[blk.entity_rows].get(
                                 mode="fill", fill_value=-1))
                             axes.extend([0, 0])
-                    solved, it_b, reason_b = jax.vmap(
+                    solved, it_b, reason_b, fail_b = jax.vmap(
                         fn, in_axes=tuple(axes))(*args)
+                    # per-entity isolation: a failed entity keeps its warm
+                    # start; healthy lanes in the same block keep their
+                    # fresh solves (no host branch — pure select)
+                    solved = jnp.where((fail_b != 0)[:, None], x0, solved)
                     out = out.at[blk.entity_rows].set(solved, mode="drop")
                     iters = iters.at[blk.entity_rows].set(it_b, mode="drop")
                     reasons = reasons.at[blk.entity_rows].set(reason_b, mode="drop")
-                return out, iters, reasons
+                    fails = fails.at[blk.entity_rows].set(fail_b, mode="drop")
+                return out, iters, reasons, fails
 
             return solve_all
 
@@ -502,8 +527,14 @@ class RandomEffectCoordinate:
         if self._norm_local is not None:
             f, s, islot = self._norm_local
             norm_args = (f,) if s is None else (f, s, islot)
+        if getattr(self, "_chaos_poison_once", False):
+            # fault injection (resilience/chaos.py): NaN residuals poison
+            # every entity's objective, like a corrupt upstream score pass
+            self._chaos_poison_once = False
+            residual_scores = jnp.full((self.n,), jnp.nan,
+                                       coef0.dtype)
         with _obs_annotate("re/solve"):
-            coefs, iters, reasons = self._solve_fn(
+            coefs, iters, reasons, fails = self._solve_fn(
                 self.dataset, residual_scores, coef0, l2, l1, *norm_args)
         # per-entity outcome aggregation (RandomEffectOptimizationTracker).
         # Keep the DEVICE arrays: a blocking host transfer here would
@@ -513,6 +544,18 @@ class RandomEffectCoordinate:
         e_orig = self._num_entities_orig
         self.last_tracker = RandomEffectOptimizationTracker(
             iterations=iters[:e_orig], reasons=reasons[:e_orig])
+        # failure isolation already happened device-side (failed entities
+        # kept their warm start inside solve_all); here only the counts
+        # cross to the host — one scalar at the coordinate boundary
+        fails_orig = fails[:e_orig]
+        n_failed = int(np.asarray(jnp.sum(fails_orig != 0)))
+        self.last_failed_entities = n_failed
+        self.last_failure = None
+        if n_failed and e_orig and n_failed == e_orig:
+            # EVERY entity failed: the coordinate as a whole is poisoned
+            # (a bad residual pass, not a few degenerate entities)
+            self.last_failure = FailureMode(int(np.asarray(
+                jnp.max(fails_orig))))
         variances = None
         from photon_tpu.types import VarianceComputationType
         if (self.variance_type != VarianceComputationType.NONE

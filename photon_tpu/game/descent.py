@@ -13,6 +13,19 @@ TPU re-design: DataScores RDDs with +/- joins become flat [n] arrays with
 elementwise arithmetic; the persist/unpersist choreography disappears
 (arrays are device-resident); everything else keeps the reference's
 semantics exactly.
+
+Resilience (no reference analog — Spark lineage recovery doesn't exist
+here): every coordinate update is a fault boundary. A solve that trips a
+device-side non-finite guard (optim.base.FailureMode) rolls the
+coordinate back to its previous model and the sweep continues; the same
+coordinate failing ``max_consecutive_failures`` times aborts with a
+resumable mid-sweep checkpoint. SIGTERM/SIGINT (resilience/shutdown.py)
+is honored at the next coordinate boundary with an emergency partial
+checkpoint whose resume is bitwise-equal to the uninterrupted run — which
+is why partial checkpoints persist the score container verbatim instead
+of recomputing it (incremental score arithmetic is order-sensitive in the
+last ulp). Sweep boundaries run the multi-host consistency guard
+(resilience/multihost.py).
 """
 
 from __future__ import annotations
@@ -23,10 +36,19 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_tpu.game.model import GameModel
 from photon_tpu.obs import solver as _obs_solver
 from photon_tpu.obs import spans as _obs_spans
+from photon_tpu.resilience import chaos as _chaos
+from photon_tpu.resilience import failures as _failures
+from photon_tpu.resilience import multihost as _multihost
+from photon_tpu.resilience import shutdown as _shutdown
+from photon_tpu.resilience.failures import (
+    CoordinateFailureError,
+    PreemptionRequested,
+)
 
 Array = jax.Array
 
@@ -41,6 +63,10 @@ class CoordinateDescentConfig:
     update_sequence: List[str]
     num_iterations: int = 1
     locked_coordinates: frozenset = frozenset()  # partial retraining
+    # abort (with a resumable checkpoint) after this many CONSECUTIVE
+    # failed solves of the same coordinate; isolated failures roll back
+    # and the sweep continues
+    max_consecutive_failures: int = 3
 
 
 @dataclasses.dataclass
@@ -72,7 +98,8 @@ def run_coordinate_descent(
     there; ``resume=True`` restarts from the latest one — the continuation
     is bitwise-equal to an uninterrupted run (SURVEY §5.3: checkpoint +
     restart replaces Spark lineage recovery; scores are recomputed from
-    the models, down-sampling PRNG counters are restored).
+    the models at sweep boundaries, restored verbatim from mid-sweep
+    partial checkpoints, down-sampling PRNG counters are restored).
     """
     to_train = [c for c in config.update_sequence
                 if c not in config.locked_coordinates]
@@ -91,13 +118,27 @@ def run_coordinate_descent(
     best_iter: Optional[int] = None
     history: List[Dict[str, float]] = []
     start_iter = 0
+    resume_coord_idx = 0
+    restored_scores: Optional[Dict[str, Array]] = None
+    restored_full: Optional[Array] = None
 
     if checkpoint_dir and resume:
         from photon_tpu.game import checkpoint as ckpt
         state = ckpt.load_latest(checkpoint_dir)
         if state is not None:
             models = dict(state.models)
-            start_iter = state.sweep + 1
+            if state.sweep_in_progress is not None:
+                # mid-sweep partial checkpoint (preemption / coordinate
+                # abort): re-enter the interrupted sweep at the exact
+                # coordinate boundary, score container verbatim
+                start_iter = state.sweep_in_progress
+                resume_coord_idx = state.next_coordinate
+                restored_scores = {cid: jnp.asarray(v) for cid, v
+                                   in (state.scores or {}).items()}
+                restored_full = (None if state.full_score is None
+                                 else jnp.asarray(state.full_score))
+            else:
+                start_iter = state.sweep + 1
             best_model = (GameModel(dict(state.best_models))
                           if state.best_models else None)
             best_metric = state.best_metric
@@ -107,26 +148,69 @@ def run_coordinate_descent(
                 if cid in coordinates and hasattr(coordinates[cid],
                                                   "_update_count"):
                     coordinates[cid]._update_count = count
-            logger.info("resumed from %s (sweep %d complete)",
-                        checkpoint_dir, state.sweep)
+            logger.info(
+                "resumed from %s (sweep %d complete%s)", checkpoint_dir,
+                state.sweep,
+                "" if state.sweep_in_progress is None
+                else f", re-entering sweep {start_iter}"
+                     f" at coordinate index {resume_coord_idx}")
 
     scores: Dict[str, Array] = {}
     full_score = jnp.zeros((num_samples,), dtype)
 
-    # initial scores for any pre-existing models (warm start / locked /
-    # checkpoint-resumed — scores are pure functions of the models)
-    for cid in config.update_sequence:
-        if cid in models:
-            s = coordinates[cid].score(models[cid])
-            scores[cid] = s
-            full_score = full_score + s
+    if restored_scores is not None:
+        scores = restored_scores
+        if restored_full is not None:
+            full_score = restored_full
+    else:
+        # initial scores for any pre-existing models (warm start / locked /
+        # checkpoint-resumed — at sweep boundaries scores are pure
+        # functions of the models)
+        for cid in config.update_sequence:
+            if cid in models:
+                s = coordinates[cid].score(models[cid])
+                scores[cid] = s
+                full_score = full_score + s
+
+    def _counters() -> Dict[str, int]:
+        return {cid: coordinates[cid]._update_count
+                for cid in config.update_sequence
+                if hasattr(coordinates[cid], "_update_count")}
+
+    def save_partial(sweep_in_progress: int, next_k: int) -> Optional[str]:
+        """Emergency mid-sweep checkpoint at a coordinate boundary."""
+        if not checkpoint_dir:
+            return None
+        from photon_tpu.game import checkpoint as ckpt
+        return ckpt.save_checkpoint(
+            checkpoint_dir, sweep_in_progress - 1, models, _counters(),
+            best_models=None if best_model is None else best_model.models,
+            best_metric=best_metric, best_iteration=best_iter,
+            history=history,
+            sweep_in_progress=sweep_in_progress, next_coordinate=next_k,
+            scores={cid: np.asarray(s) for cid, s in scores.items()},
+            full_score=np.asarray(full_score))
+
+    consecutive: Dict[str, int] = {}
 
     for it in range(start_iter, config.num_iterations):
       with _obs_spans.span("cd/sweep", iteration=it):
-        for cid in config.update_sequence:
+        for k, cid in enumerate(config.update_sequence):
+            if it == start_iter and k < resume_coord_idx:
+                continue  # re-entered sweep: these already ran pre-restart
+            _chaos.maybe_preempt(it, cid)
+            if _shutdown.requested():
+                path = save_partial(it, k)
+                _failures.record_failure(
+                    "preemption", sweep=it, coordinate=cid,
+                    reason=_shutdown.reason(), checkpoint=path)
+                raise PreemptionRequested(checkpoint_path=path, sweep=it,
+                                          coordinate=cid)
             if cid in config.locked_coordinates:
                 continue
             coord = coordinates[cid]
+            if _chaos.is_active() and _chaos.should_poison_nan(cid, it):
+                coord._chaos_poison_once = True
             own = scores.get(cid)
             partial = full_score - own if own is not None else full_score
             residual = partial if len(config.update_sequence) > 1 else None
@@ -135,7 +219,6 @@ def run_coordinate_descent(
             with Timed(f"CD iter {it} update {cid}", logger,
                        level=logging.DEBUG):
                 new_model = coord.update_model(models.get(cid), residual)
-            models[cid] = new_model
             tracker = getattr(coord, "last_tracker", None)
             if tracker is not None:
                 # telemetry keeps a REFERENCE (device arrays and all);
@@ -145,6 +228,37 @@ def run_coordinate_descent(
                     # summary() forces a device->host sync; never pay it
                     # unless debug logging actually consumes it
                     logger.debug("coord %s solver: %s", cid, tracker.summary())
+
+            n_failed_entities = getattr(coord, "last_failed_entities", 0)
+            if n_failed_entities:
+                # isolated per-entity failures: those entities kept their
+                # warm start inside the solve; the coordinate is still good
+                _failures.record_failure(
+                    "entity_solve_failures", coordinate=cid, sweep=it,
+                    entities=int(n_failed_entities))
+            failure = getattr(coord, "last_failure", None)
+            if failure is not None:
+                # coordinate-level failure: discard the new model, keep the
+                # previous one and its score — the sweep continues on the
+                # other coordinates
+                consecutive[cid] = consecutive.get(cid, 0) + 1
+                _failures.record_failure(
+                    "coordinate_rollback", coordinate=cid, sweep=it,
+                    failure=failure.name, consecutive=consecutive[cid])
+                logger.warning(
+                    "coordinate %s failed (%s) at sweep %d; rolled back "
+                    "(%d consecutive)", cid, failure.name, it,
+                    consecutive[cid])
+                if consecutive[cid] >= config.max_consecutive_failures:
+                    path = save_partial(it, k + 1)
+                    _failures.record_failure(
+                        "coordinate_abort", coordinate=cid, sweep=it,
+                        consecutive=consecutive[cid], checkpoint=path)
+                    raise CoordinateFailureError(
+                        cid, it, consecutive[cid], checkpoint_path=path)
+                continue
+            consecutive[cid] = 0
+            models[cid] = new_model
             new_score = coord.score(new_model)
             full_score = (full_score - own + new_score) if own is not None \
                 else (full_score + new_score)
@@ -154,6 +268,8 @@ def run_coordinate_descent(
                 metrics = validation_fn(GameModel(dict(models)))
                 history.append({"iteration": it, "coordinate": cid, **metrics})
                 logger.info("CD iter %d coord %s: %s", it, cid, metrics)
+
+        resume_coord_idx = 0  # only the re-entered sweep skips coordinates
 
         # best-model bookkeeping over FULL sweeps (reference :162-171)
         if validation_fn is not None:
@@ -177,16 +293,25 @@ def run_coordinate_descent(
             if cid in scores:
                 full_score = full_score + scores[cid]
 
+        # sweep boundary = the one place replicated state is compared
+        # across hosts (collective; every process reaches it together)
+        _multihost.check_consistency(models, it)
+
+        ckpt_path = None
         if checkpoint_dir:
             from photon_tpu.game import checkpoint as ckpt
-            counters = {cid: coordinates[cid]._update_count
-                        for cid in config.update_sequence
-                        if hasattr(coordinates[cid], "_update_count")}
-            ckpt.save_checkpoint(
-                checkpoint_dir, it, models, counters,
+            ckpt_path = ckpt.save_checkpoint(
+                checkpoint_dir, it, models, _counters(),
                 best_models=None if best_model is None else best_model.models,
                 best_metric=best_metric, best_iteration=best_iter,
                 history=history)
+        if _shutdown.requested():
+            # the sweep-boundary checkpoint just published IS the
+            # emergency checkpoint — stop before starting another sweep
+            _failures.record_failure("preemption", sweep=it,
+                                     reason=_shutdown.reason(),
+                                     checkpoint=ckpt_path)
+            raise PreemptionRequested(checkpoint_path=ckpt_path, sweep=it)
 
     final = GameModel(dict(models))
     return CoordinateDescentResult(

@@ -399,10 +399,15 @@ class AvroFileReader:
 
 
 def read_avro(path: str) -> Tuple[Any, List[Any]]:
-    """Read one container file -> (writer schema, list of records)."""
-    with open(path, "rb") as f:
-        r = AvroFileReader(f)
-        return r.schema, list(r)
+    """Read one container file -> (writer schema, list of records).
+
+    The bytes are fetched through the retrying reader (resilience/retry.py)
+    in one shot — a transient storage error costs a backoff, never the
+    run — and decoded from memory."""
+    from photon_tpu.resilience import io as rio
+
+    r = AvroFileReader(_io.BytesIO(rio.read_bytes(path, op="avro_read")))
+    return r.schema, list(r)
 
 
 def list_avro_files(path: str) -> List[str]:
@@ -418,9 +423,11 @@ def list_avro_files(path: str) -> List[str]:
 def iter_avro_dir(path: str) -> Iterator[Any]:
     """Iterate records across all ``*.avro`` files in a directory (or a
     single file) in name order."""
+    from photon_tpu.resilience import io as rio
+
     for fp in list_avro_files(path):
-        with open(fp, "rb") as f:
-            yield from AvroFileReader(f)
+        yield from AvroFileReader(
+            _io.BytesIO(rio.read_bytes(fp, op="avro_read")))
 
 
 # -- cross-file reader-schema resolution -------------------------------------
@@ -523,11 +530,17 @@ def read_merged(paths: List[str]) -> Tuple[Any, List[Any]]:
 
 def write_avro(path: str, schema: Any, records: Iterable[Any],
                codec: str = "deflate", sync_interval: int = 4000) -> None:
-    """Write records to one Avro object-container file."""
+    """Write records to one Avro object-container file.
+
+    The container is encoded into memory once, then published with the
+    retrying atomic writer (fsync + tmp-rename). Encoding first matters
+    beyond atomicity: callers pass ``records`` as generators, which can
+    only be consumed once — a retry loop around a streaming write would
+    silently produce an empty file on the second attempt."""
     names = _Names()
     names.register_all(schema)
     sync = os.urandom(SYNC_SIZE)
-    with open(path, "wb") as f:
+    with _io.BytesIO() as f:
         f.write(MAGIC)
         meta_enc = BinaryEncoder()
         meta = {"avro.schema": json.dumps(schema).encode(),
@@ -562,3 +575,8 @@ def write_avro(path: str, schema: Any, records: Iterable[Any],
             if count >= sync_interval:
                 flush()
         flush()
+        payload = f.getvalue()
+
+    from photon_tpu.resilience import io as rio
+
+    rio.atomic_write_bytes(path, payload, op="avro_write")

@@ -17,6 +17,7 @@ already carries one.
 
 from __future__ import annotations
 
+import io
 import logging
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -170,8 +171,10 @@ def read_game_frame(
     paths = [p for d in input_dirs for p in list_avro_files(d)]
     if not paths:
         raise FileNotFoundError(f"no avro files under {list(input_dirs)}")
+    from photon_tpu.resilience import io as rio
+
     for path in paths:
-        with open(path, "rb") as f:
+        with io.BytesIO(rio.read_bytes(path, op="ingest_read")) as f:
             reader = AvroFileReader(f)
             specs = tuple(_bag_spec(None, reader.schema, b)
                           for b in bag_names)

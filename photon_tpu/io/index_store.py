@@ -56,10 +56,12 @@ def write_index_store(path: str, index_map: IndexMap) -> None:
         rows[i] = (h, off, len(kb), idx)
         off += len(kb)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "wb") as f:
-        f.write(_HEADER.pack(MAGIC, len(hashed)))
-        f.write(rows.tobytes())
-        f.write(b"".join(key_bytes))
+    from photon_tpu.resilience import io as rio
+    rio.atomic_write_bytes(
+        path,
+        _HEADER.pack(MAGIC, len(hashed)) + rows.tobytes()
+        + b"".join(key_bytes),
+        op="index_write")
 
 
 class IndexStore:

@@ -29,6 +29,7 @@ from photon_tpu.io import avro as avro_io
 from photon_tpu.io.index_map import IndexMap, split_feature_key
 from photon_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_AVRO
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+from photon_tpu.resilience import io as rio
 from photon_tpu.types import TaskType
 
 import jax.numpy as jnp
@@ -135,8 +136,9 @@ def save_model_metadata(output_dir: str, task: TaskType,
         "randomEffectOptimizationConfigurations": {
             "configurations": RANDOM_EFFECT, "values": random_vals},
     }
-    with open(os.path.join(output_dir, METADATA_FILE), "w") as f:
-        json.dump(meta, f, indent=2)
+    rio.atomic_write_bytes(os.path.join(output_dir, METADATA_FILE),
+                           json.dumps(meta, indent=2).encode("utf-8"),
+                           op="model_write")
 
 
 def load_model_metadata(model_dir: str) -> dict:
@@ -175,8 +177,9 @@ def save_game_model(
         if isinstance(m, FixedEffectModel):
             cdir = os.path.join(output_dir, FIXED_EFFECT, cid)
             os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
-            with open(os.path.join(cdir, ID_INFO), "w") as f:
-                f.write(m.feature_shard_id + "\n")
+            rio.atomic_write_bytes(os.path.join(cdir, ID_INFO),
+                                   (m.feature_shard_id + "\n").encode("utf-8"),
+                                   op="model_write")
             imap = index_maps[m.feature_shard_id]
             coefs = m.model.coefficients
             rec = {
@@ -199,8 +202,11 @@ def save_game_model(
                     f"random-effect coordinate {cid} needs vocab + projection")
             cdir = os.path.join(output_dir, RANDOM_EFFECT, cid)
             os.makedirs(os.path.join(cdir, COEFFICIENTS), exist_ok=True)
-            with open(os.path.join(cdir, ID_INFO), "w") as f:
-                f.write(m.random_effect_type + "\n" + m.feature_shard_id + "\n")
+            rio.atomic_write_bytes(
+                os.path.join(cdir, ID_INFO),
+                (m.random_effect_type + "\n"
+                 + m.feature_shard_id + "\n").encode("utf-8"),
+                op="model_write")
             imap = index_maps[m.feature_shard_id]
             names = vocab.names(m.random_effect_type)
             proj = np.asarray(projections[cid])

@@ -64,6 +64,7 @@ def build_run_report(driver: str,
     sampling memory happen here — this IS the phase boundary."""
     from photon_tpu.obs import aggregate, memory, solver
     from photon_tpu.obs.metrics import registry
+    from photon_tpu.resilience import failures
     from photon_tpu.utils import timing
 
     memory.record_phase("run_report")  # final watermark sample
@@ -79,6 +80,7 @@ def build_run_report(driver: str,
         "metrics": registry.snapshot(),
         "solver": solver.drain(),
         "memory": memory.watermarks(),
+        "failures": failures.snapshot(),
     }
     if extra:
         report["extra"] = extra
@@ -179,6 +181,8 @@ def validate_run_report(report: Dict[str, Any]) -> List[str]:
                 errors.append(f"solver.{section} must be a list")
     if not isinstance(report.get("memory"), dict):
         errors.append("memory must be a dict")
+    if not isinstance(report.get("failures"), list):
+        errors.append("failures must be a list")
     proc = report.get("process")
     if (not isinstance(proc, dict) or "index" not in proc
             or "count" not in proc):

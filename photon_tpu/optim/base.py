@@ -35,6 +35,23 @@ class ConvergenceReason(enum.IntEnum):
     OBJECTIVE_NOT_IMPROVING = 4
 
 
+class FailureMode(enum.IntEnum):
+    """Typed device-side failure detected inside a solver while_loop.
+
+    The reference has no analog — a NaN objective poisons the Breeze
+    history silently and the model that comes out is garbage. Here every
+    solver guards its carry: a non-finite loss/gradient/step rejects the
+    step and terminates the solve with one of these codes on
+    ``SolverResult.failure``, leaving the last finite iterate as the
+    result. Coordinate descent (game/descent.py) reads the code at the
+    coordinate boundary and rolls back."""
+
+    NONE = 0
+    NON_FINITE_LOSS = 1
+    NON_FINITE_GRADIENT = 2
+    NON_FINITE_STEP = 3
+
+
 @dataclasses.dataclass(frozen=True)
 class SolverConfig:
     """Reference: OptimizerConfig.scala:28 + per-solver defaults
@@ -74,6 +91,9 @@ class SolverResult(NamedTuple):
     gnorm_history: Optional[Array] = None   # [T]
     step_history: Optional[Array] = None    # [T] accepted step sizes (NaN
     #                                         where the solver has no step)
+    # int32 FailureMode; None only for legacy constructions that predate
+    # the non-finite guards (treated as NONE by consumers)
+    failure: Optional[Array] = None
 
 
 class StateTracking(NamedTuple):
@@ -168,6 +188,18 @@ def convergence_reason(
         ),
     )
     return reason.astype(jnp.int32)
+
+
+def nonfinite_code(f: Array, g_finite: Array) -> Array:
+    """int32 FailureMode from a scalar loss and a scalar gradient-finite
+    flag (callers pick the cheapest finite witness they have — e.g. the
+    directional L-BFGS uses its already-computed g.g instead of paying a
+    full pass over a sharded gradient)."""
+    return jnp.where(
+        jnp.isfinite(f),
+        jnp.where(g_finite, FailureMode.NONE, FailureMode.NON_FINITE_GRADIENT),
+        FailureMode.NON_FINITE_LOSS,
+    ).astype(jnp.int32)
 
 
 # Objective closures the solvers consume: fg(x, data, hyper) -> (f, g) and

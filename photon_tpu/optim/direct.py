@@ -24,7 +24,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from photon_tpu.optim.base import ConvergenceReason, SolverResult
+from photon_tpu.optim.base import (
+    ConvergenceReason,
+    FailureMode,
+    SolverResult,
+    nonfinite_code,
+)
 
 Array = jax.Array
 
@@ -37,12 +42,21 @@ def _newton_step(x0: Array, f0: Array, g: Array, h: Array) -> SolverResult:
 
     Singular/degenerate curvature (rank-deficient features at lambda=0,
     or an empty vmap lane) keeps the start point and SAYS SO — a failed
-    entity must not read as converged in the per-entity trackers."""
+    entity must not read as converged in the per-entity trackers. The
+    ``failure`` code distinguishes a bad input (non-finite f0/g, e.g. a
+    poisoned residual) from a non-finite Cholesky step."""
     chol = jax.scipy.linalg.cho_factor(h)
     step = -jax.scipy.linalg.cho_solve(chol, g)
     ok = jnp.all(jnp.isfinite(step))
     step = jnp.where(ok, step, 0.0)
     hs = h @ step
+    init_fail = nonfinite_code(f0, jnp.all(jnp.isfinite(g)))
+    failure = jnp.where(
+        init_fail != FailureMode.NONE,
+        init_fail,
+        jnp.where(ok,
+                  jnp.asarray(FailureMode.NONE, jnp.int32),
+                  jnp.asarray(FailureMode.NON_FINITE_STEP, jnp.int32)))
     return SolverResult(
         coef=x0 + step,
         value=f0 + jnp.dot(g, step) + 0.5 * jnp.dot(step, hs),
@@ -54,6 +68,7 @@ def _newton_step(x0: Array, f0: Array, g: Array, h: Array) -> SolverResult:
             jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32)),
         num_fun_evals=jnp.asarray(1, jnp.int32),
         loss_history=None, gnorm_history=None,
+        failure=failure,
     )
 
 

@@ -25,11 +25,13 @@ from jax import lax
 
 from photon_tpu.optim.base import (
     ConvergenceReason,
+    FailureMode,
     SolverConfig,
     SolverResult,
     StateTracking,
     absolute_tolerances,
     convergence_reason,
+    nonfinite_code,
     project_box,
 )
 from photon_tpu.optim.linesearch import (
@@ -54,6 +56,8 @@ class _Carry(NamedTuple):
     reason: Array
     n_evals: Array
     ls_failed: Array   # bool: last line search failed to decrease
+    nf_count: Array    # int32: consecutive non-finite evaluations
+    failure: Array     # int32 FailureMode (non-zero terminates the loop)
     trk: Optional[StateTracking]  # per-iteration ring buffer (None = off)
 
 
@@ -107,7 +111,8 @@ def minimize(
     tols = absolute_tolerances(f0, g0, config.tolerance)
 
     def cond(c: _Carry):
-        return c.reason == ConvergenceReason.NOT_CONVERGED
+        return ((c.reason == ConvergenceReason.NOT_CONVERGED)
+                & (c.failure == FailureMode.NONE))
 
     def body(c: _Carry) -> _Carry:
         direction = two_loop_direction(c.g, c.s_hist, c.y_hist, c.rho,
@@ -138,7 +143,15 @@ def minimize(
             f_new = jnp.where(changed, f_proj, f_new)
             g_new = jnp.where(changed, g_proj[...], g_new)
 
-        decreased = f_new < c.f
+        # Non-finite guard: a NaN f fails `<` on its own, but a -inf loss
+        # would sail through, and a finite f with a NaN gradient would
+        # poison the curvature history — gate acceptance on full
+        # finiteness. Rejection leaves the carry at the last finite
+        # iterate; the failure code below terminates after the retry
+        # (same direction, ls shrinks) also comes back non-finite.
+        g_finite = jnp.all(jnp.isfinite(g_new))
+        finite = jnp.isfinite(f_new) & g_finite
+        decreased = finite & (f_new < c.f)
         # reject non-decreasing steps entirely
         x_new = jnp.where(decreased, x_new, c.x)
         f_kept = jnp.where(decreased, f_new, c.f)
@@ -166,6 +179,17 @@ def minimize(
             jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
             reason,
         )
+        # two consecutive non-finite evaluations: the NaN-aware line
+        # search already shrank away once and the region is still bad —
+        # terminate with a typed failure at the last finite iterate
+        nf_count = jnp.where(finite, 0, c.nf_count + 1).astype(jnp.int32)
+        failure = jnp.where(nf_count >= 2, nonfinite_code(f_new, g_finite),
+                            jnp.asarray(FailureMode.NONE, jnp.int32))
+        reason = jnp.where(
+            failure != FailureMode.NONE,
+            jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
+            reason,
+        )
 
         return _Carry(
             x=x_new, f=f_kept, g=g_kept, f_prev=c.f,
@@ -174,6 +198,7 @@ def minimize(
             it=it, reason=reason,
             n_evals=c.n_evals + ls.num_evals + (1 if has_box else 0),
             ls_failed=~decreased,
+            nf_count=nf_count, failure=failure,
             trk=None if c.trk is None else c.trk.record(
                 c.it, f_kept, g_kept,
                 step=jnp.where(decreased, ls.step, 0.0)),
@@ -193,6 +218,9 @@ def minimize(
         ),
         n_evals=jnp.asarray(1, jnp.int32),
         ls_failed=jnp.asarray(False),
+        nf_count=jnp.asarray(0, jnp.int32),
+        # a non-finite start (poisoned data) exits before the first step
+        failure=nonfinite_code(f0, jnp.all(jnp.isfinite(g0))),
         trk=StateTracking.init(config.track_states, dtype),
     )
 
@@ -203,6 +231,7 @@ def minimize(
         loss_history=None if out.trk is None else out.trk.loss,
         gnorm_history=None if out.trk is None else out.trk.gnorm,
         step_history=None if out.trk is None else out.trk.step,
+        failure=out.failure,
     )
 
 
@@ -227,6 +256,7 @@ class _DirCarry(NamedTuple):
     reason: Array
     n_evals: Array
     ls_failed: Array
+    failure: Array     # int32 FailureMode (non-zero terminates the loop)
     trk: Optional[StateTracking]
 
 
@@ -313,7 +343,8 @@ def minimize_directional(
     tols = absolute_tolerances(f0, g0, config.tolerance)
 
     def cond(c: _DirCarry):
-        return c.reason == ConvergenceReason.NOT_CONVERGED
+        return ((c.reason == ConvergenceReason.NOT_CONVERGED)
+                & (c.failure == FailureMode.NONE))
 
     def body(c: _DirCarry) -> _DirCarry:
         c_g, c_s, c_y = _compact_direction(
@@ -363,6 +394,24 @@ def minimize_directional(
 
         gng = jnp.dot(c.g, g_kept)
         gg_new = jnp.dot(g_kept, g_kept)
+
+        # Non-finite guard priced for the sharded path: isfinite on two
+        # scalars already in hand (f and g.g — any NaN/Inf component of g
+        # makes g.g non-finite), NO extra d-pass. A bad full-data eval
+        # withdraws the step — the carry reverts to the previous finite
+        # point — and the failure code terminates the loop, so the
+        # where-selects below are only ever live on the final iteration.
+        ok = jnp.isfinite(f_kept) & jnp.isfinite(gg_new)
+        failure = jnp.where(ok, jnp.asarray(FailureMode.NONE, jnp.int32),
+                            nonfinite_code(f_kept, jnp.isfinite(gg_new)))
+        x_new = jnp.where(ok, x_new, c.x)
+        margins_new = jnp.where(ok, margins_new, c.margins)
+        xx_kept = jnp.where(ok, xx_kept, c.xx)
+        f_kept = jnp.where(ok, f_kept, c.f)
+        g_kept = jnp.where(ok, g_kept, c.g)
+        gng = jnp.where(ok, gng, c.gg)
+        gg_new = jnp.where(ok, gg_new, c.gg)
+        decreased = decreased & ok
 
         # direction . y_j via coefficients against the old grams;
         # direction . g_new comes straight from the line search: the trial
@@ -437,6 +486,11 @@ def minimize_directional(
             jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
             reason,
         )
+        reason = jnp.where(
+            failure != FailureMode.NONE,
+            jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
+            reason,
+        )
 
         return _DirCarry(
             x=x_new, f=f_kept, g=g_kept, f_prev=c.f,
@@ -447,6 +501,7 @@ def minimize_directional(
             it=it, reason=reason,
             n_evals=c.n_evals + 1,
             ls_failed=~decreased,
+            failure=failure,
             trk=None if c.trk is None else c.trk.record(
                 c.it, f_kept, g_kept, step=t),
         )
@@ -469,6 +524,8 @@ def minimize_directional(
         ),
         n_evals=jnp.asarray(1, jnp.int32),
         ls_failed=jnp.asarray(False),
+        # same scalar-witness trick as the loop guard: g.g covers g
+        failure=nonfinite_code(f0, jnp.isfinite(gg0)),
         trk=StateTracking.init(config.track_states, dtype),
     )
 
@@ -479,4 +536,5 @@ def minimize_directional(
         loss_history=None if out.trk is None else out.trk.loss,
         gnorm_history=None if out.trk is None else out.trk.gnorm,
         step_history=None if out.trk is None else out.trk.step,
+        failure=out.failure,
     )

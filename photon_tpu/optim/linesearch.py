@@ -109,13 +109,16 @@ def wolfe_linesearch(
         i = c.i + 1
         a = c.a_next
 
-        # best strict-decrease tracker (failure fallback)
-        better = f_a < c.f_best
+        # best strict-decrease tracker (failure fallback); a -inf "best"
+        # would poison the caller's carry, so non-finite trials never win
+        better = (f_a < c.f_best) & jnp.isfinite(f_a)
         a_best = jnp.where(better, a, c.a_best)
         f_best = jnp.where(better, f_a, c.f_best)
         g_best = jnp.where(better, g_a, c.g_best)
 
-        armijo_fail = f_a > f0 + c1 * a * d0
+        # a non-finite trial classifies as an Armijo failure: the bracket
+        # shrinks back toward the finite region instead of growing into it
+        armijo_fail = (f_a > f0 + c1 * a * d0) | ~jnp.isfinite(f_a)
         wolfe_ok = jnp.abs(d_a) <= -c2 * d0
         # approximate-Wolfe acceptance (Hager-Zhang style): near the
         # optimum the true decrease underflows f0's ulp, strict Armijo
@@ -128,7 +131,8 @@ def wolfe_linesearch(
         slack = 8.0 * jnp.finfo(dtype).eps * jnp.abs(f0)
         approx_conv = ((f_a <= f0 + slack)
                        & (d_a >= c2 * d0)
-                       & (d_a <= (2.0 * c1 - 1.0) * d0))
+                       & (d_a <= (2.0 * c1 - 1.0) * d0)
+                       & jnp.isfinite(f_a))
         # the slack is a CLASSIFICATION device only: a candidate inside the
         # flatness window but with f_a > f0 is a rounding-level ascent —
         # report converged (success) without moving the iterate off the
@@ -319,17 +323,20 @@ def wolfe_linesearch_directional(
         i = c.i + 1
         a = c.a_next
 
-        better = f_a < c.f_best
+        # same non-finite handling as wolfe_linesearch: bad trials never
+        # become the fallback best, and they shrink the bracket
+        better = (f_a < c.f_best) & jnp.isfinite(f_a)
         a_best = jnp.where(better, a, c.a_best)
         f_best = jnp.where(better, f_a, c.f_best)
         d_best = jnp.where(better, d_a, c.d_best)
 
-        armijo_fail = f_a > f0 + c1 * a * d0
+        armijo_fail = (f_a > f0 + c1 * a * d0) | ~jnp.isfinite(f_a)
         wolfe_ok = jnp.abs(d_a) <= -c2 * d0
         slack = 8.0 * jnp.finfo(dtype).eps * jnp.abs(f0)
         approx_conv = ((f_a <= f0 + slack)
                        & (d_a >= c2 * d0)
-                       & (d_a <= (2.0 * c1 - 1.0) * d0))
+                       & (d_a <= (2.0 * c1 - 1.0) * d0)
+                       & jnp.isfinite(f_a))
         approx_take = approx_conv & (f_a <= f0)
         approx_stop = approx_conv & ~approx_take
 

@@ -41,11 +41,13 @@ import jax.numpy as jnp
 
 from photon_tpu.optim.base import (
     ConvergenceReason,
+    FailureMode,
     SolverConfig,
     SolverResult,
     StateTracking,
     absolute_tolerances,
     convergence_reason,
+    nonfinite_code,
 )
 
 Array = jax.Array
@@ -60,6 +62,7 @@ class _Carry(NamedTuple):
     it: Array
     n_evals: Array
     reason: Array
+    failure: Array    # int32 FailureMode (non-zero terminates the loop)
     tracking: Optional[StateTracking]
 
 
@@ -101,7 +104,8 @@ def minimize(
         return t, f_new, g_new, k, ok
 
     def cond(c: _Carry):
-        return c.reason == ConvergenceReason.NOT_CONVERGED
+        return ((c.reason == ConvergenceReason.NOT_CONVERGED)
+                & (c.failure == FailureMode.NONE))
 
     def body(c: _Carry):
         h = hess_matrix(c.x)
@@ -119,7 +123,16 @@ def minimize(
         # is converged to the dtype's resolution and classifies as
         # FUNCTION_VALUES_CONVERGED below) but never move the iterate
         # uphill (same contract as linesearch.LineSearchResult)
-        take = accepted & (f_new <= c.f)
+        # non-finite guard: the Armijo test already screens f_t, but a
+        # finite trial value can still carry a NaN/Inf gradient (saturated
+        # margins) — never admit one into the carry, and terminate with a
+        # typed failure (retrying the same step cannot help)
+        g_fin = jnp.all(jnp.isfinite(g_new))
+        take = accepted & (f_new <= c.f) & g_fin
+        failure = jnp.where(
+            accepted & ~g_fin,
+            jnp.asarray(FailureMode.NON_FINITE_GRADIENT, jnp.int32),
+            jnp.asarray(FailureMode.NONE, jnp.int32))
         x_new = jnp.where(take, c.x + t * direction, c.x)
         f_new = jnp.where(take, f_new, c.f)
         g_new = jnp.where(take, g_new, c.g)
@@ -132,10 +145,14 @@ def minimize(
             (reason == ConvergenceReason.NOT_CONVERGED) & ~accepted,
             jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
             reason)
+        reason = jnp.where(
+            failure != FailureMode.NONE,
+            jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
+            reason)
         tracking = (None if c.tracking is None
                     else c.tracking.record(c.it, f_new, g_new))
         return _Carry(x_new, f_new, g_new, it,
-                      c.n_evals + ls_evals, reason, tracking)
+                      c.n_evals + ls_evals, reason, failure, tracking)
 
     # sentinel f_prev far from f0 so the initial check can only fire on
     # the gradient (an already-stationary start) or max_iterations=0
@@ -147,6 +164,7 @@ def minimize(
         reason=jnp.asarray(
             convergence_reason(jnp.asarray(0, jnp.int32), f_far, f0, g0,
                                tols, config.max_iterations), jnp.int32),
+        failure=nonfinite_code(f0, jnp.all(jnp.isfinite(g0))),
         tracking=StateTracking.init(config.track_states, x0.dtype))
     out = jax.lax.while_loop(cond, body, init)
     return SolverResult(
@@ -155,4 +173,5 @@ def minimize(
         loss_history=None if out.tracking is None else out.tracking.loss,
         gnorm_history=None if out.tracking is None else out.tracking.gnorm,
         step_history=None if out.tracking is None else out.tracking.step,
+        failure=out.failure,
     )

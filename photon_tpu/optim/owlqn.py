@@ -20,11 +20,13 @@ from jax import lax
 
 from photon_tpu.optim.base import (
     ConvergenceReason,
+    FailureMode,
     StateTracking,
     SolverConfig,
     SolverResult,
     absolute_tolerances,
     convergence_reason,
+    nonfinite_code,
 )
 from photon_tpu.optim.lbfgs import two_loop_direction
 
@@ -56,6 +58,7 @@ class _Carry(NamedTuple):
     it: Array
     reason: Array
     n_evals: Array
+    failure: Array    # int32 FailureMode (non-zero terminates the loop)
     trk: Optional[StateTracking]  # per-iteration ring buffer (None = off)
 
 
@@ -86,7 +89,8 @@ def minimize(
     tols = absolute_tolerances(f0, pg0, config.tolerance)
 
     def cond(c: _Carry):
-        return c.reason == ConvergenceReason.NOT_CONVERGED
+        return ((c.reason == ConvergenceReason.NOT_CONVERGED)
+                & (c.failure == FailureMode.NONE))
 
     def body(c: _Carry) -> _Carry:
         direction = two_loop_direction(c.pg, c.s_hist, c.y_hist, c.rho,
@@ -132,7 +136,15 @@ def minimize(
         _alpha, f_new, x_new, g_new, k, ok, _ = lax.while_loop(
             ls_cond, ls_body, init_ls)
 
-        decreased = ok & (f_new < c.f)
+        # Non-finite guard: a NaN/Inf trial must never be kept, and unlike
+        # a merely flat trial it cannot be retried (the next probe would be
+        # identical), so it terminates with a typed failure code. NaN fails
+        # `<` on its own but -Inf passes it — gate on full finiteness.
+        g_fin = jnp.all(jnp.isfinite(g_new))
+        fin = jnp.isfinite(f_new) & g_fin
+        failure = jnp.where(fin, jnp.asarray(FailureMode.NONE, jnp.int32),
+                            nonfinite_code(f_new, g_fin))
+        decreased = ok & (f_new < c.f) & fin
         x_kept = jnp.where(decreased, x_new, c.x)
         f_kept = jnp.where(decreased, f_new, c.f)
         g_kept = jnp.where(decreased, g_new, c.g)
@@ -158,11 +170,16 @@ def minimize(
             jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
             reason,
         )
+        reason = jnp.where(
+            failure != FailureMode.NONE,
+            jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
+            reason,
+        )
 
         return _Carry(x=x_kept, f=f_kept, g=g_kept, pg=pg_new, f_prev=c.f,
                       s_hist=s_hist, y_hist=y_hist, rho=rho,
                       n_pairs=n_pairs, head=head, it=it, reason=reason,
-                      n_evals=c.n_evals + k,
+                      n_evals=c.n_evals + k, failure=failure,
                       trk=None if c.trk is None
                       else c.trk.record(c.it, f_kept, pg_new))
 
@@ -178,6 +195,7 @@ def minimize(
             jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32),
         ),
         n_evals=jnp.asarray(1, jnp.int32),
+        failure=nonfinite_code(f0, jnp.all(jnp.isfinite(g0))),
         trk=StateTracking.init(config.track_states, dtype),
     )
 
@@ -188,4 +206,5 @@ def minimize(
         loss_history=None if out.trk is None else out.trk.loss,
         gnorm_history=None if out.trk is None else out.trk.gnorm,
         step_history=None if out.trk is None else out.trk.step,
+        failure=out.failure,
     )

@@ -22,11 +22,13 @@ from jax import lax
 
 from photon_tpu.optim.base import (
     ConvergenceReason,
+    FailureMode,
     StateTracking,
     SolverConfig,
     SolverResult,
     absolute_tolerances,
     convergence_reason,
+    nonfinite_code,
 )
 
 Array = jax.Array
@@ -104,6 +106,8 @@ class _Carry(NamedTuple):
     failures: Array
     reason: Array
     n_evals: Array
+    nf_count: Array   # consecutive non-finite trial steps
+    failure: Array    # int32 FailureMode (non-zero terminates the loop)
     trk: "Optional[StateTracking]"  # per-iteration ring buffer (None = off)
 
 
@@ -132,7 +136,8 @@ def minimize(
     dtype = x0.dtype
 
     def cond(c: _Carry):
-        return c.reason == ConvergenceReason.NOT_CONVERGED
+        return ((c.reason == ConvergenceReason.NOT_CONVERGED)
+                & (c.failure == FailureMode.NONE))
 
     def body(c: _Carry) -> _Carry:
         if hess_setup is not None:
@@ -169,11 +174,25 @@ def minimize(
             ),
         )
 
-        accept = actred > _ETA0 * prered
+        # Non-finite guard: a NaN actred fails `>` on its own, but a -Inf
+        # f_try makes actred = +Inf and would be accepted — gate acceptance
+        # on full finiteness of the trial, and keep the trust radius finite
+        # (a NaN prered/asn poisons delta even on a rejected step) so the
+        # shrunken region can recover from transient overflow.
+        g_fin = jnp.all(jnp.isfinite(g_try))
+        fin = jnp.isfinite(f_try) & g_fin
+        accept = fin & (actred > _ETA0 * prered)
+        delta = jnp.where(jnp.isfinite(delta), delta, 0.5 * c.delta)
         x_new = jnp.where(accept, x_try, c.x)
         f_new = jnp.where(accept, f_try, c.f)
         g_new = jnp.where(accept, g_try, c.g)
         failures = jnp.where(accept, 0, c.failures + 1).astype(jnp.int32)
+        nf_count = jnp.where(fin, 0, c.nf_count + 1).astype(jnp.int32)
+        failure = jnp.where(
+            nf_count >= 2,
+            nonfinite_code(f_try, g_fin),
+            jnp.asarray(FailureMode.NONE, jnp.int32),
+        )
 
         it = c.it + 1
         reason = convergence_reason(it, c.f, f_new, g_new, tols,
@@ -184,10 +203,16 @@ def minimize(
             jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
             reason,
         )
+        reason = jnp.where(
+            failure != FailureMode.NONE,
+            jnp.asarray(ConvergenceReason.OBJECTIVE_NOT_IMPROVING, jnp.int32),
+            reason,
+        )
 
         return _Carry(x=x_new, f=f_new, g=g_new, f_prev=c.f, delta=delta,
                       it=it, failures=failures, reason=reason,
-                      n_evals=c.n_evals + 1,
+                      n_evals=c.n_evals + 1, nf_count=nf_count,
+                      failure=failure,
                       trk=None if c.trk is None
                       else c.trk.record(c.it, f_new, g_new))
 
@@ -202,6 +227,8 @@ def minimize(
             jnp.asarray(ConvergenceReason.NOT_CONVERGED, jnp.int32),
         ),
         n_evals=jnp.asarray(1, jnp.int32),
+        nf_count=jnp.asarray(0, jnp.int32),
+        failure=nonfinite_code(f0, jnp.all(jnp.isfinite(g0))),
         trk=StateTracking.init(config.track_states, dtype),
     )
 
@@ -212,4 +239,5 @@ def minimize(
         loss_history=None if out.trk is None else out.trk.loss,
         gnorm_history=None if out.trk is None else out.trk.gnorm,
         step_history=None if out.trk is None else out.trk.step,
+        failure=out.failure,
     )

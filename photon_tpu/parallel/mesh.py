@@ -496,7 +496,7 @@ def mesh_topology(mesh: Optional[Mesh] = None) -> dict:
         if devs:
             out["platform"] = devs[0].platform
             out["device_kind"] = getattr(devs[0], "device_kind", None)
-    except Exception:  # noqa: BLE001 — topology is best-effort telemetry
+    except Exception:  # hygiene-ok — topology is best-effort telemetry
         pass
     if mesh is not None:
         try:
@@ -506,6 +506,6 @@ def mesh_topology(mesh: Optional[Mesh] = None) -> dict:
                                zip(mesh.axis_names, mesh.devices.shape)},
                 "num_devices": int(mesh.devices.size),
             }
-        except Exception:  # noqa: BLE001
+        except Exception:  # hygiene-ok — mesh shape is best-effort telemetry
             pass
     return out

@@ -1,0 +1,50 @@
+"""Fault containment for TPU-native GAME training.
+
+The reference Photon ML leans on Spark lineage for every failure class:
+a lost partition is recomputed deterministically and a poisoned solve
+dies with its executor. This rebuild replaced lineage with sweep-granular
+bitwise checkpoint/resume (game/checkpoint.py); this package supplies the
+in-band defenses that lineage never had to provide:
+
+- ``FailureMode`` + device-side non-finite guards inside every solver
+  while_loop (optim/*.py) — NaN/Inf in loss/gradient/step rejects the
+  step and terminates the solve with a typed failure instead of
+  propagating NaNs, with no host synchronization in the hot loop.
+- coordinate-level isolation (game/descent.py): a failed coordinate
+  solve rolls back to that coordinate's previous model and the sweep
+  continues; repeated failures abort with a resumable checkpoint.
+- preemption-aware shutdown (``shutdown``): SIGTERM/SIGINT request a
+  graceful stop at the next coordinate boundary; an emergency partial
+  checkpoint keeps the continuation bitwise-equal.
+- retrying I/O (``retry``/``io``): exponential backoff with
+  deterministic jitter around ingest reads and atomic, fsync-audited
+  publishes of checkpoints/models/indexes.
+- a deterministic chaos harness (``chaos``) injecting NaN solves,
+  transient I/O errors, simulated preemption, and kill-mid-write, so
+  tests/test_resilience.py exercises every path above reproducibly.
+
+Every failure/retry/rollback event is recorded through ``failures`` and
+lands in the obs metrics registry plus the RunReport ``failures``
+section.
+"""
+
+from photon_tpu.optim.base import FailureMode
+from photon_tpu.resilience.failures import (
+    EXIT_COORDINATE_FAILURE,
+    EXIT_PREEMPTED,
+    CoordinateFailureError,
+    PreemptionRequested,
+    record_failure,
+)
+from photon_tpu.resilience.retry import RetryPolicy, with_retries
+
+__all__ = [
+    "FailureMode",
+    "EXIT_COORDINATE_FAILURE",
+    "EXIT_PREEMPTED",
+    "CoordinateFailureError",
+    "PreemptionRequested",
+    "record_failure",
+    "RetryPolicy",
+    "with_retries",
+]

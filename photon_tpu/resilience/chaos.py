@@ -1,0 +1,153 @@
+"""Deterministic fault injection for resilience tests.
+
+One process-wide optional ``ChaosConfig``; when installed, well-known
+hook points consult it:
+
+- ``should_poison_nan(coordinate, sweep)`` — game/descent.py asks before
+  each coordinate update; a hit makes that update train against NaN
+  offsets, driving the solver's non-finite guards end to end.
+- ``before_io(op)`` — retry.with_retries calls it at the top of every
+  attempt; configured ops raise ``ChaosIOError`` (an OSError, so the
+  retry budget applies) a fixed number of times, then succeed.
+- ``maybe_preempt(sweep, coordinate)`` — game/descent.py asks at each
+  coordinate boundary; a hit flips the same flag a real SIGTERM would
+  (resilience/shutdown.py), exercising the emergency-checkpoint path.
+- ``at_publish(op)`` — resilience/io.py + game/checkpoint.py call it
+  between tmp-write and rename; a hit raises ``SimulatedKill``, which
+  deliberately bypasses tmp cleanup so the partial state stays on disk
+  exactly as a real SIGKILL would leave it.
+
+Everything is counter-based off the installed config — two runs with the
+same config and workload inject identically. ``seed`` feeds the optional
+rate-based I/O mode (``io_error_rate``), which keys a hash on
+(seed, op, attempt index) rather than any global RNG.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import zlib
+from contextlib import contextmanager
+from typing import Dict, Optional, Tuple
+
+
+class ChaosIOError(OSError):
+    """Injected transient I/O failure (retryable by design)."""
+
+
+class SimulatedKill(RuntimeError):
+    """Injected hard kill between tmp-write and atomic rename."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    seed: int = 0
+    # (coordinate id, sweep) pairs whose update trains on NaN offsets
+    nan_solve: Tuple[Tuple[str, int], ...] = ()
+    # op prefix -> number of transient I/O errors to inject (then succeed)
+    io_failures: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # probability of a transient error per attempt, keyed by (seed, op,
+    # attempt counter) — deterministic, no global RNG
+    io_error_rate: float = 0.0
+    # (sweep, coordinate id): request graceful preemption at that boundary
+    preempt_at: Optional[Tuple[int, str]] = None
+    # ops whose atomic publish is killed between write and rename
+    kill_publish_ops: Tuple[str, ...] = ()
+    # number of successful publishes of a matching op before the kill
+    kill_publish_after: int = 0
+
+
+class _State:
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self.lock = threading.Lock()
+        self.io_injected: Dict[str, int] = {}
+        self.io_attempts: Dict[str, int] = {}
+        self.publishes_seen = 0
+        self.kill_fired = False
+        self.preempt_fired = False
+
+
+_active: Optional[_State] = None
+
+
+def install(config: ChaosConfig) -> None:
+    global _active
+    _active = _State(config)
+
+
+def uninstall() -> None:
+    global _active
+    _active = None
+
+
+def is_active() -> bool:
+    return _active is not None
+
+
+@contextmanager
+def active(config: ChaosConfig):
+    install(config)
+    try:
+        yield
+    finally:
+        uninstall()
+
+
+def should_poison_nan(coordinate: str, sweep: int) -> bool:
+    s = _active
+    return s is not None and (coordinate, sweep) in s.config.nan_solve
+
+
+def before_io(op: str) -> None:
+    s = _active
+    if s is None:
+        return
+    with s.lock:
+        for prefix, budget in s.config.io_failures.items():
+            if not op.startswith(prefix):
+                continue
+            done = s.io_injected.get(prefix, 0)
+            if done < budget:
+                s.io_injected[prefix] = done + 1
+                raise ChaosIOError(
+                    f"chaos: injected transient I/O error #{done + 1} "
+                    f"for {op!r}")
+        if s.config.io_error_rate > 0.0:
+            i = s.io_attempts.get(op, 0)
+            s.io_attempts[op] = i + 1
+            h = zlib.crc32(f"{s.config.seed}:{op}:{i}".encode()) / 2**32
+            if h < s.config.io_error_rate:
+                raise ChaosIOError(
+                    f"chaos: injected rate-based I/O error for {op!r} "
+                    f"(attempt {i})")
+
+
+def maybe_preempt(sweep: int, coordinate: str) -> None:
+    s = _active
+    if s is None or s.config.preempt_at is None:
+        return
+    with s.lock:
+        if s.preempt_fired or s.config.preempt_at != (sweep, coordinate):
+            return
+        s.preempt_fired = True
+    from photon_tpu.resilience import shutdown
+    shutdown.request(f"chaos preemption at sweep {sweep}, "
+                     f"coordinate {coordinate!r}")
+
+
+def at_publish(op: str) -> None:
+    s = _active
+    if s is None or not s.config.kill_publish_ops:
+        return
+    with s.lock:
+        if s.kill_fired or not any(op.startswith(p)
+                                   for p in s.config.kill_publish_ops):
+            return
+        if s.publishes_seen < s.config.kill_publish_after:
+            s.publishes_seen += 1
+            return
+        s.kill_fired = True
+    raise SimulatedKill(f"chaos: killed publish of {op!r} between "
+                        f"tmp-write and rename")

@@ -1,0 +1,80 @@
+"""Failure-event log, typed training aborts, and driver exit codes.
+
+One process-wide, thread-safe event list: every guard trip, rollback,
+retry give-up, and preemption records here. The obs RunReport pulls
+``snapshot()`` into its ``failures`` section so post-mortems read one
+manifest instead of grepping logs; each record also bumps the
+``resilience.failures`` counter (labelled by kind) in the metrics
+registry.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List
+
+logger = logging.getLogger(__name__)
+
+# Distinct driver exit codes (cli/train.py): 75 follows the sysexits
+# EX_TEMPFAIL convention — the run was healthy and is resumable.
+EXIT_PREEMPTED = 75
+EXIT_COORDINATE_FAILURE = 76
+
+_lock = threading.Lock()
+_events: List[Dict[str, Any]] = []
+
+
+class PreemptionRequested(RuntimeError):
+    """Graceful-stop honored at a coordinate boundary; the emergency
+    checkpoint (when a checkpoint dir is configured) is already on disk
+    when this propagates."""
+
+    def __init__(self, checkpoint_path=None, sweep=None, coordinate=None):
+        self.checkpoint_path = checkpoint_path
+        self.sweep = sweep
+        self.coordinate = coordinate
+        super().__init__(
+            f"preemption honored at sweep {sweep}, coordinate {coordinate!r}"
+            + (f"; emergency checkpoint at {checkpoint_path}"
+               if checkpoint_path else " (no checkpoint directory configured)"))
+
+
+class CoordinateFailureError(RuntimeError):
+    """Structured abort: one coordinate failed N consecutive sweeps."""
+
+    def __init__(self, coordinate, sweep, consecutive, checkpoint_path=None):
+        self.coordinate = coordinate
+        self.sweep = sweep
+        self.consecutive = consecutive
+        self.checkpoint_path = checkpoint_path
+        super().__init__(
+            f"coordinate {coordinate!r} failed {consecutive} consecutive "
+            f"sweeps (last at sweep {sweep})"
+            + (f"; resumable checkpoint at {checkpoint_path}"
+               if checkpoint_path else ""))
+
+
+def record_failure(kind: str, **info: Any) -> Dict[str, Any]:
+    """Append one failure/recovery event; returns the recorded dict."""
+    event = {"kind": kind, "unix": time.time(), **info}
+    with _lock:
+        _events.append(event)
+    try:
+        from photon_tpu.obs.metrics import registry
+        registry.counter("resilience.failures", kind=kind).inc()
+    except Exception:  # metrics must never mask the failure being recorded
+        logger.debug("failure-event metrics emission failed", exc_info=True)
+    logger.warning("resilience event: %s", event)
+    return event
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    with _lock:
+        return [dict(e) for e in _events]
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()

@@ -1,0 +1,95 @@
+"""Durable, retrying file primitives: fsync-audited atomic publish.
+
+Every byte-level write in ``io/`` and ``game/checkpoint.py`` funnels
+through here so the durability contract lives in one place:
+
+    write tmp -> fsync(tmp) -> [chaos.at_publish] -> rename -> fsync(dir)
+
+A crash before the rename leaves only a tmp file/dir that readers
+ignore; a crash after it leaves the complete new artifact. Reads and
+publishes both run under the retry budget (resilience/retry.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from photon_tpu.resilience import chaos
+from photon_tpu.resilience.retry import RetryPolicy, with_retries
+
+logger = logging.getLogger(__name__)
+
+
+def fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str) -> None:
+    """Persist a rename/creation in its directory (POSIX requires syncing
+    the directory entry separately from the file data)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms without O_RDONLY dirs — best effort
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        logger.debug("directory fsync unsupported for %s", path)
+    finally:
+        os.close(fd)
+
+
+def fsync_tree(path: str) -> None:
+    """fsync every regular file under ``path``, then the dirs bottom-up."""
+    for root, dirs, files in os.walk(path, topdown=False):
+        for name in files:
+            fsync_file(os.path.join(root, name))
+        fsync_dir(root)
+
+
+def read_bytes(path: str, op: str = "read",
+               policy: Optional[RetryPolicy] = None) -> bytes:
+    def _read() -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+    return with_retries(_read, op=op, policy=policy)
+
+
+def atomic_write_bytes(path: str, data: bytes, op: str = "write",
+                       policy: Optional[RetryPolicy] = None) -> None:
+    """Atomically publish ``data`` at ``path`` with fsync-before-rename.
+
+    Retried as a unit: each attempt rewrites its own tmp file, so a
+    transient failure mid-publish never leaves a half-written final
+    artifact. ``chaos.SimulatedKill`` (not an OSError) propagates without
+    cleanup, leaving the tmp file behind like a real kill would.
+    """
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+
+    def _publish() -> None:
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            chaos.at_publish(op)
+            os.replace(tmp, path)
+            fsync_dir(d)
+        except chaos.SimulatedKill:
+            raise  # a real kill leaves the tmp file — so does the simulated one
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    with_retries(_publish, op=op, policy=policy)

@@ -1,0 +1,98 @@
+"""Retrying I/O: exponential backoff with deterministic jitter.
+
+Transient filesystem/network hiccups must not abort a multi-hour GAME
+fit; every ingest read and atomic publish in ``io/`` runs through
+:func:`with_retries`. Jitter is a pure function of (op, attempt) — two
+runs back off identically, keeping the chaos suite and any timing-
+sensitive debugging reproducible (no global RNG involved).
+
+Env knobs (read per call so tests/ops can tune a live process):
+
+  PHOTON_TPU_IO_RETRIES       max attempts, default 4 (= 3 retries)
+  PHOTON_TPU_IO_RETRY_BASE_S  first backoff delay, default 0.05
+  PHOTON_TPU_IO_RETRY_MAX_S   backoff cap per attempt, default 2.0
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+import zlib
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+ENV_ATTEMPTS = "PHOTON_TPU_IO_RETRIES"
+ENV_BASE = "PHOTON_TPU_IO_RETRY_BASE_S"
+ENV_MAX = "PHOTON_TPU_IO_RETRY_MAX_S"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,)
+
+    @staticmethod
+    def from_env() -> "RetryPolicy":
+        return RetryPolicy(
+            max_attempts=max(1, int(os.environ.get(ENV_ATTEMPTS, 4))),
+            base_delay_s=float(os.environ.get(ENV_BASE, 0.05)),
+            max_delay_s=float(os.environ.get(ENV_MAX, 2.0)),
+        )
+
+
+def backoff_delay(op: str, attempt: int, base: float, cap: float) -> float:
+    """Delay before retry #``attempt`` (0-based): exponential, capped,
+    with deterministic jitter in [0.5, 1.0) x the raw delay."""
+    raw = min(cap, base * (2.0 ** attempt))
+    h = zlib.crc32(f"{op}:{attempt}".encode()) / 2.0**32
+    return raw * (0.5 + 0.5 * h)
+
+
+def with_retries(
+    fn: Callable[..., T],
+    *args,
+    op: str = "io",
+    policy: Optional[RetryPolicy] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    **kwargs,
+) -> T:
+    """Run ``fn(*args, **kwargs)``, retrying on ``policy.retry_on``.
+
+    Each attempt first consults the chaos harness for ``op`` (injected
+    transient errors count against the same budget as real ones). On
+    give-up the last error propagates after being recorded as a
+    ``resilience`` failure event.
+    """
+    from photon_tpu.resilience import chaos, failures
+
+    if policy is None:
+        policy = RetryPolicy.from_env()
+    for attempt in range(policy.max_attempts):
+        try:
+            chaos.before_io(op)
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            if attempt + 1 >= policy.max_attempts:
+                failures.record_failure("io_giveup", op=op,
+                                        attempts=policy.max_attempts,
+                                        error=repr(e))
+                raise
+            delay = backoff_delay(op, attempt, policy.base_delay_s,
+                                  policy.max_delay_s)
+            try:
+                from photon_tpu.obs.metrics import registry
+                registry.counter("resilience.io_retry", op=op).inc()
+            except Exception:
+                logger.debug("retry metrics emission failed", exc_info=True)
+            logger.warning("%s failed (attempt %d/%d): %r — retrying in "
+                           "%.3fs", op, attempt + 1, policy.max_attempts, e,
+                           delay)
+            sleep(delay)
+    raise AssertionError("unreachable: loop either returns or raises")

@@ -1,0 +1,78 @@
+"""Preemption-aware shutdown: signal -> flag -> coordinate boundary.
+
+TPU preemption (and any orderly kill) delivers SIGTERM with a grace
+window. The handler here only flips a flag — everything heavy (the
+emergency checkpoint, the RunReport flush) happens at the next
+coordinate boundary on the training thread, where device state is
+consistent and the continuation stays bitwise-equal. A second SIGINT
+falls through to the default KeyboardInterrupt so an interactive ^C ^C
+still kills a hung run.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_requested = False
+_reason: Optional[str] = None
+_previous: dict = {}
+
+
+def request(reason: str = "requested") -> None:
+    """Flip the stop flag (signal handler, chaos harness, or embedder)."""
+    global _requested, _reason
+    with _lock:
+        if not _requested:
+            _requested = True
+            _reason = reason
+            logger.warning("graceful shutdown requested (%s); stopping at "
+                           "the next coordinate boundary", reason)
+
+
+def requested() -> bool:
+    return _requested
+
+
+def reason() -> Optional[str]:
+    return _reason
+
+
+def reset() -> None:
+    global _requested, _reason
+    with _lock:
+        _requested = False
+        _reason = None
+
+
+def _handler(signum, frame):
+    if _requested and signum == signal.SIGINT:
+        # operator insists: restore default behavior and interrupt now
+        raise KeyboardInterrupt
+    request(signal.Signals(signum).name)
+
+
+def install(signums=(signal.SIGTERM, signal.SIGINT)) -> None:
+    """Install the graceful handler (main thread only — callers off the
+    main thread get a no-op, matching the signal module's own rule)."""
+    if threading.current_thread() is not threading.main_thread():
+        logger.debug("not on the main thread; shutdown handler not installed")
+        return
+    for s in signums:
+        if s not in _previous:
+            _previous[s] = signal.getsignal(s)
+        signal.signal(s, _handler)
+
+
+def uninstall() -> None:
+    """Restore pre-install handlers (tests)."""
+    if threading.current_thread() is not threading.main_thread():
+        return
+    for s, old in list(_previous.items()):
+        signal.signal(s, old)
+        del _previous[s]

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Static guard: no silent exception swallowing in library code.
+
+The resilience contract (photon_tpu/resilience) is that failures are
+either handled-and-recorded or propagated — never silently eaten. A bare
+``except:`` also catches ``KeyboardInterrupt``/``SystemExit`` and breaks
+the SIGINT escalation path in resilience/shutdown.py; an
+``except Exception: pass`` (or ``...``) hides exactly the I/O and solver
+faults this subsystem exists to surface.
+
+This script walks ``photon_tpu/`` and ``scripts/`` with an AST visitor
+and fails — with file:line — on:
+
+  * bare ``except:`` handlers (no exception type at all)
+  * ``except Exception`` / ``except BaseException`` handlers whose body
+    is only ``pass`` / ``...`` (swallow-with-no-record)
+
+Handlers that log, re-raise, record a failure event, or narrow the type
+are all fine. Escape hatch for the rare intentional swallow: put the
+marker comment ``hygiene-ok`` on the ``except`` line.
+
+Wired into tier-1 via tests/test_resilience.py; also runnable
+standalone::
+
+    python scripts/check_exception_hygiene.py
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = (
+    os.path.join(REPO, "photon_tpu"),
+    os.path.join(REPO, "scripts"),
+)
+MARKER = "hygiene-ok"
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(node) -> bool:
+    """True for ``except Exception`` / ``except BaseException`` including
+    dotted (builtins.Exception) and tuple forms containing one."""
+    if node is None:
+        return False
+    if isinstance(node, ast.Tuple):
+        return any(_is_broad(el) for el in node.elts)
+    if isinstance(node, ast.Attribute):
+        return node.attr in _BROAD
+    return isinstance(node, ast.Name) and node.id in _BROAD
+
+
+def _body_is_silent(body) -> bool:
+    """Handler body is only pass / ... — nothing logged, raised, or
+    recorded."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is ...):
+            continue
+        return False
+    return True
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, source_lines: List[str]):
+        self.path = path
+        self.lines = source_lines
+        self.violations: List[str] = []
+
+    def _flag(self, node: ast.ExceptHandler, what: str) -> None:
+        line = self.lines[node.lineno - 1] if node.lineno <= len(self.lines) \
+            else ""
+        if MARKER in line:
+            return
+        rel = os.path.relpath(self.path, REPO)
+        self.violations.append(f"{rel}:{node.lineno}: {what}")
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self._flag(node, "bare 'except:' (catches KeyboardInterrupt/"
+                             "SystemExit; name the exception type)")
+        elif _is_broad(node.type) and _body_is_silent(node.body):
+            self._flag(node, "broad except with silent body (log, record, "
+                             "or narrow the type)")
+        self.generic_visit(node)
+
+
+def check(paths=SCAN_DIRS) -> List[str]:
+    violations: List[str] = []
+    for root in paths:
+        for dirpath, _dirs, files in os.walk(root):
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                with open(path) as f:
+                    src = f.read()
+                try:
+                    tree = ast.parse(src, filename=path)
+                except SyntaxError as e:
+                    violations.append(f"{path}: unparseable: {e}")
+                    continue
+                v = _Visitor(path, src.splitlines())
+                v.visit(tree)
+                violations.extend(v.violations)
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if violations:
+        print("silent exception handlers found "
+              f"(mark intentional swallows with '{MARKER}'):")
+        for v in violations:
+            print(f"  {v}")
+        return 1
+    print("ok: exception hygiene clean in photon_tpu/ and scripts/")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,10 @@ test to compare against an in-process single-host solve.
 
 Usage: multihost_worker.py <pid> <nproc> <port> <out_npy> [mode]
 
-``mode`` defaults to ``dense`` (data-sharded halves). ``sparse_tp``
+``mode`` defaults to ``dense`` (data-sharded halves). ``consistency``
+runs the sweep-boundary multi-host consistency guard
+(resilience/multihost.py) against matched and deliberately-desynced
+replicated state. ``sparse_tp``
 instead runs the model-sharded sparse path (ops/features
 .ModelShardedSparse + the margin-resident directional L-BFGS) on a
 ``(data=4, model=2)`` mesh whose MODEL axis spans the two OS processes:
@@ -90,6 +93,37 @@ def _obs(pid, nproc, out):
           f"wrote-report {rep is not None}", flush=True)
 
 
+def _consistency(pid, nproc, out):
+    """Sweep-boundary consistency guard across the 2-process cluster:
+    identical replicated state passes; a per-process perturbation (the
+    desync the guard exists to catch) must raise MultiHostDesyncError on
+    EVERY process with all hosts' digests in the message."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from photon_tpu.game.model import FixedEffectModel
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.resilience import multihost
+    from photon_tpu.types import TaskType
+
+    def models(vals):
+        return {"fixed": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(jnp.asarray(vals, jnp.float32)),
+                TaskType.LOGISTIC_REGRESSION), "g")}
+
+    multihost.check_consistency(models([1.0, 2.0, 3.0]), sweep=0)
+    print(f"proc {pid}: consistency-ok", flush=True)
+    try:
+        multihost.check_consistency(models([1.0, 2.0, 3.0 + pid]), sweep=1)
+        print(f"proc {pid}: desync-missed", flush=True)
+    except multihost.MultiHostDesyncError as e:
+        assert len(e.digests) == nproc and len(set(e.digests)) > 1
+        print(f"proc {pid}: desync-detected sweep {e.sweep}", flush=True)
+    if pid == 0:
+        np.save(out, np.zeros(1))
+
+
 def main():
     pid, nproc, port, out = (int(sys.argv[1]), int(sys.argv[2]),
                              sys.argv[3], sys.argv[4])
@@ -110,6 +144,8 @@ def main():
         return _sparse_tp(pid, nproc, out)
     if mode == "obs":
         return _obs(pid, nproc, out)
+    if mode == "consistency":
+        return _consistency(pid, nproc, out)
 
     import numpy as np
 

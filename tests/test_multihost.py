@@ -107,6 +107,19 @@ def test_two_process_solve_matches_single_host(tmp_path):
     np.testing.assert_allclose(multi, single, rtol=5e-4, atol=5e-5)
 
 
+def test_two_process_consistency_guard_detects_desync(tmp_path):
+    """The sweep-boundary consistency guard (resilience/multihost.py)
+    across a real 2-process cluster: bitwise-identical fixed-effect state
+    passes; a one-host perturbation raises MultiHostDesyncError on every
+    process, carrying all hosts' digests."""
+    out = str(tmp_path / "consistency.npy")
+    logs = _run_workers(out, mode="consistency")
+
+    assert sum("consistency-ok" in l for l in logs) == 2, logs
+    assert not any("desync-missed" in l for l in logs), logs
+    assert sum("desync-detected sweep 1" in l for l in logs) == 2, logs
+
+
 def test_two_process_sparse_tp_model_axis_spans_processes(tmp_path):
     """Sparse tensor parallelism composed with the multi-host runtime:
     a (data=4, model=2) mesh whose MODEL axis pairs one device from each

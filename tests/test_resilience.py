@@ -1,0 +1,632 @@
+"""Resilience subsystem: device-side non-finite solver guards,
+coordinate-level failure isolation, preemption-safe checkpointing,
+retrying I/O, and the deterministic chaos harness driving all of it.
+
+Every end-to-end test here injects faults through
+photon_tpu.resilience.chaos — no monkeypatching of library internals —
+so the exact code paths production failures take are the ones exercised.
+"""
+
+import glob
+import os
+import signal
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from photon_tpu.estimators.game_estimator import (
+    CoordinateConfiguration,
+    FixedEffectDataConfiguration,
+    GameEstimator,
+)
+from photon_tpu.function.objective import L2Regularization
+from photon_tpu.game import checkpoint as ckpt
+from photon_tpu.game.dataset import CsrRows, FeatureShard, GameDataFrame
+from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+from photon_tpu.optim.base import FailureMode, SolverConfig
+from photon_tpu.optim.problem import (
+    GLMOptimizationConfiguration,
+    OptimizerConfig,
+)
+from photon_tpu.resilience import chaos, failures, multihost, retry, shutdown
+from photon_tpu.resilience import io as rio
+from photon_tpu.resilience.failures import (
+    CoordinateFailureError,
+    PreemptionRequested,
+)
+from photon_tpu.types import OptimizerType, TaskType
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    """Process-wide resilience state must not leak between tests."""
+    failures.clear()
+    shutdown.reset()
+    chaos.uninstall()
+    yield
+    failures.clear()
+    shutdown.reset()
+    chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# device-side non-finite guards: every solver terminates with a typed
+# FailureMode instead of looping on NaN/Inf
+# ---------------------------------------------------------------------------
+
+
+def _nan_vg(x):
+    f = jnp.asarray(float("nan"), x.dtype) * jnp.sum(x * x)
+    return f, jnp.full_like(x, float("nan"))
+
+
+def _nan_grad_vg(x):
+    # finite loss, poisoned gradient
+    return jnp.sum(x * x), jnp.full_like(x, float("nan"))
+
+
+def _quad_vg(x):
+    return 0.5 * jnp.sum(x * x), x
+
+
+class TestSolverGuards:
+    def test_lbfgs_nan_loss(self):
+        from photon_tpu.optim import lbfgs
+        res = lbfgs.minimize(_nan_vg, jnp.ones(4))
+        assert int(res.failure) == FailureMode.NON_FINITE_LOSS
+        assert int(res.iterations) <= 2
+
+    def test_lbfgs_nan_gradient(self):
+        from photon_tpu.optim import lbfgs
+        res = lbfgs.minimize(_nan_grad_vg, jnp.ones(4))
+        assert int(res.failure) == FailureMode.NON_FINITE_GRADIENT
+
+    def test_lbfgs_healthy_run_reports_no_failure(self):
+        from photon_tpu.optim import lbfgs
+        res = lbfgs.minimize(_quad_vg, jnp.ones(4))
+        assert int(res.failure) == FailureMode.NONE
+
+    def test_lbfgs_nan_mid_run(self):
+        from photon_tpu.optim import lbfgs
+
+        def vg(x):
+            # healthy at the start, NaN once the iterate moves
+            f = 0.5 * jnp.sum((x - 3.0) ** 2)
+            bad = jnp.any(jnp.abs(x) > 0.5)
+            f = jnp.where(bad, jnp.asarray(float("nan"), f.dtype), f)
+            return f, jnp.where(bad, jnp.full_like(x, float("nan")), x - 3.0)
+
+        res = lbfgs.minimize(vg, jnp.zeros(4))
+        # the line search rejects every non-finite trial, so the iterate
+        # never enters the poisoned region: result stays finite (whether
+        # the run ends in recovery or a typed failure, NaN never escapes)
+        assert np.isfinite(np.asarray(res.coef)).all()
+        assert np.abs(np.asarray(res.coef)).max() <= 0.5
+        assert np.isfinite(float(res.value))
+
+    def test_owlqn_nan_loss(self):
+        from photon_tpu.optim import owlqn
+        res = owlqn.minimize(_nan_vg, jnp.ones(4), l1_weight=0.1)
+        assert int(res.failure) == FailureMode.NON_FINITE_LOSS
+
+    def test_tron_nan_loss(self):
+        from photon_tpu.optim import tron
+
+        def hv(x, v):
+            return v
+
+        res = tron.minimize(_nan_vg, hv, jnp.ones(4))
+        assert int(res.failure) == FailureMode.NON_FINITE_LOSS
+
+    def test_newton_nan_gradient_mid_run(self):
+        from photon_tpu.optim import newton
+
+        def vg(x):
+            bad = jnp.any(jnp.abs(x - 1.0) < 0.1)  # poison near the optimum
+            g = jnp.where(bad, jnp.full_like(x, float("nan")), x - 1.0)
+            return 0.5 * jnp.sum((x - 1.0) ** 2), g
+
+        def hess(x):
+            return jnp.eye(x.shape[0], dtype=x.dtype)
+
+        res = newton.minimize(vg, hess, jnp.zeros(3))
+        assert int(res.failure) == FailureMode.NON_FINITE_GRADIENT
+
+    def test_direct_nan_loss(self):
+        from photon_tpu.optim import direct
+
+        def hess(x):
+            return jnp.eye(x.shape[0], dtype=x.dtype)
+
+        res = direct.minimize(_nan_vg, hess, jnp.ones(3))
+        assert int(res.failure) == FailureMode.NON_FINITE_LOSS
+
+    def test_direct_singular_step(self):
+        from photon_tpu.optim import direct
+
+        def vg(x):
+            return jnp.sum(x), jnp.ones_like(x)
+
+        def hess(x):  # singular: cho_solve produces non-finite step
+            return jnp.zeros((x.shape[0], x.shape[0]), x.dtype)
+
+        res = direct.minimize(vg, hess, jnp.ones(3))
+        assert int(res.failure) == FailureMode.NON_FINITE_STEP
+
+
+# ---------------------------------------------------------------------------
+# end-to-end GAME harness
+# ---------------------------------------------------------------------------
+
+
+def _frame(rng, n=240, d=8, users=6, d_u=3):
+    Xg = rng.normal(size=(n, d))
+    Xu = rng.normal(size=(n, d_u))
+    uid = rng.integers(0, users, size=n)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(Xg @ rng.normal(size=d))))
+         ).astype(np.float64)
+    iu = np.arange(d_u, dtype=np.int32)
+    return GameDataFrame(
+        num_samples=n, response=y,
+        feature_shards={"g": FeatureShard(Xg, d),
+                        "u": FeatureShard([(iu, Xu[i]) for i in range(n)], d_u)},
+        id_tags={"userId": [str(v) for v in uid]})
+
+
+def _estimator(num_iterations=4):
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=25, tolerance=1e-9),
+        regularization=L2Regularization, regularization_weight=1.0)
+    return GameEstimator(
+        TaskType.LOGISTIC_REGRESSION,
+        {"fixed": CoordinateConfiguration(
+            FixedEffectDataConfiguration("g"), opt),
+         "per_user": CoordinateConfiguration(
+             RandomEffectDataConfiguration("userId", "u"), opt)},
+        update_sequence=["fixed", "per_user"],
+        num_iterations=num_iterations, dtype=jnp.float64)
+
+
+def _means(model, cid):
+    m = model[cid]
+    return np.asarray(m.model.coefficients.means if cid == "fixed"
+                      else m.coefficients)
+
+
+def _assert_models_equal(a, b):
+    for cid in ("fixed", "per_user"):
+        assert np.array_equal(_means(a, cid), _means(b, cid)), \
+            f"{cid}: models diverged"
+
+
+class TestChaosNaNIsolation:
+    def test_poisoned_coordinate_rolls_back_and_run_completes(self, rng):
+        df = _frame(rng)
+        clean = _estimator().fit(df)[-1].model
+
+        failures.clear()
+        with chaos.active(chaos.ChaosConfig(nan_solve=(("fixed", 1),))):
+            poisoned = _estimator().fit(df)[-1].model
+
+        events = failures.snapshot()
+        rollbacks = [e for e in events if e["kind"] == "coordinate_rollback"]
+        assert len(rollbacks) == 1
+        assert rollbacks[0]["coordinate"] == "fixed"
+        assert rollbacks[0]["sweep"] == 1
+        assert rollbacks[0]["failure"] in ("NON_FINITE_LOSS",
+                                           "NON_FINITE_GRADIENT")
+        assert not any(e["kind"] == "coordinate_abort" for e in events)
+        # the run survived to a finite model, not the poisoned solve
+        assert np.isfinite(_means(poisoned, "fixed")).all()
+        # an isolated failure costs one update, so the result differs from
+        # the clean run (proving the sweep-1 update really was discarded)
+        assert not np.array_equal(_means(poisoned, "fixed"),
+                                  _means(clean, "fixed"))
+
+    def test_rollback_lands_in_run_report_failures(self, rng):
+        from photon_tpu.obs.report import build_run_report, validate_run_report
+        df = _frame(rng, n=120)
+        failures.clear()
+        with chaos.active(chaos.ChaosConfig(nan_solve=(("fixed", 1),))):
+            _estimator(num_iterations=2).fit(df)
+        report = build_run_report("test")
+        assert validate_run_report(report) == []
+        kinds = [e["kind"] for e in report["failures"]]
+        assert "coordinate_rollback" in kinds
+
+    def test_consecutive_failures_abort_with_resumable_checkpoint(
+            self, rng, tmp_path):
+        df = _frame(rng)
+        ckdir = str(tmp_path / "ck")
+        cfg = chaos.ChaosConfig(
+            nan_solve=(("fixed", 1), ("fixed", 2), ("fixed", 3)))
+        with chaos.active(cfg):
+            with pytest.raises(CoordinateFailureError) as ei:
+                _estimator().fit(df, checkpoint_dir=ckdir)
+        assert ei.value.coordinate == "fixed"
+        assert ei.value.consecutive == 3
+        assert ei.value.checkpoint_path is not None
+        assert os.path.isdir(ei.value.checkpoint_path)
+        assert any(e["kind"] == "coordinate_abort"
+                   for e in failures.snapshot())
+
+        # the abort checkpoint is a loadable mid-sweep partial
+        state = ckpt.load_latest(str(tmp_path / "ck" / "config_000"))
+        assert state is not None and state.sweep_in_progress == 3
+        assert state.next_coordinate == 1  # past the aborted coordinate
+        assert state.scores is not None and state.full_score is not None
+
+        # with the fault gone, resume finishes the run
+        res = _estimator().fit(df, checkpoint_dir=ckdir, resume=True)
+        assert np.isfinite(_means(res[-1].model, "fixed")).all()
+
+
+class TestPreemption:
+    def test_chaos_preemption_resumes_bitwise_equal(self, rng, tmp_path):
+        df = _frame(rng)
+        ckdir = str(tmp_path / "ck")
+        full = _estimator().fit(df)[-1].model
+
+        cfg = chaos.ChaosConfig(preempt_at=(1, "per_user"))
+        with chaos.active(cfg):
+            with pytest.raises(PreemptionRequested) as ei:
+                _estimator().fit(df, checkpoint_dir=ckdir)
+        assert ei.value.checkpoint_path is not None
+        assert "part" in os.path.basename(ei.value.checkpoint_path)
+        assert any(e["kind"] == "preemption" for e in failures.snapshot())
+
+        shutdown.reset()  # a fresh process would start unset
+        resumed = _estimator().fit(df, checkpoint_dir=ckdir,
+                                   resume=True)[-1].model
+        _assert_models_equal(full, resumed)
+
+    def test_sigterm_flips_flag_and_is_honored(self, rng, tmp_path):
+        # handler unit-level: one SIGTERM -> graceful flag, no exception
+        shutdown.install()
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert shutdown.requested()
+            assert shutdown.reason() == "SIGTERM"
+        finally:
+            shutdown.uninstall()
+
+        # a pre-set flag stops training at the FIRST coordinate boundary
+        df = _frame(rng, n=120)
+        shutdown.request("test")
+        with pytest.raises(PreemptionRequested):
+            _estimator(num_iterations=2).fit(
+                df, checkpoint_dir=str(tmp_path / "ck"))
+
+    def test_second_sigint_raises_keyboard_interrupt(self):
+        shutdown.install()
+        try:
+            os.kill(os.getpid(), signal.SIGINT)
+            assert shutdown.requested()
+            with pytest.raises(KeyboardInterrupt):
+                os.kill(os.getpid(), signal.SIGINT)
+        finally:
+            shutdown.uninstall()
+
+
+class TestKillMidWrite:
+    def test_kill_between_write_and_rename_resumes_bitwise(self, rng,
+                                                           tmp_path):
+        df = _frame(rng)
+        ckdir = str(tmp_path / "ck")
+        full = _estimator().fit(df)[-1].model
+
+        # second checkpoint publish dies between tmp-write and rename
+        cfg = chaos.ChaosConfig(kill_publish_ops=("checkpoint",),
+                                kill_publish_after=1)
+        with chaos.active(cfg):
+            with pytest.raises(chaos.SimulatedKill):
+                _estimator().fit(df, checkpoint_dir=ckdir)
+
+        nsdir = str(tmp_path / "ck" / "config_000")
+        # the kill left its tmp dir behind (like a real SIGKILL)...
+        assert glob.glob(os.path.join(nsdir, ".ckpt_tmp_*"))
+        # ...which resume ignores: only sweep 0 is visible
+        state = ckpt.load_latest(nsdir)
+        assert state is not None and state.sweep == 0
+        assert state.sweep_in_progress is None
+
+        resumed = _estimator().fit(df, checkpoint_dir=ckdir,
+                                   resume=True)[-1].model
+        _assert_models_equal(full, resumed)
+
+
+class TestCorruptCheckpoint:
+    def _save_two(self, rng, tmp_path):
+        from photon_tpu.game.model import FixedEffectModel
+        from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+        d = str(tmp_path / "ck")
+        for sweep in (0, 1):
+            means = jnp.asarray(rng.normal(size=5))
+            m = {"fixed": FixedEffectModel(
+                GeneralizedLinearModel(Coefficients(means),
+                                       TaskType.LOGISTIC_REGRESSION), "g")}
+            ckpt.save_checkpoint(d, sweep, m, {"fixed": sweep + 1})
+        return d
+
+    def test_checksum_mismatch_raises(self, rng, tmp_path):
+        d = self._save_two(rng, tmp_path)
+        target = os.path.join(d, "sweep_0001", "model__fixed.npz")
+        blob = bytearray(open(target, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        with open(target, "wb") as f:
+            f.write(blob)
+        with pytest.raises(ckpt.CheckpointCorruptError, match="checksum"):
+            ckpt.load_checkpoint(os.path.join(d, "sweep_0001"))
+
+    def test_load_latest_skips_corrupt_dir_with_warning(self, rng, tmp_path,
+                                                        caplog):
+        d = self._save_two(rng, tmp_path)
+        # truncate the newest checkpoint's arrays mid-file (torn write)
+        target = os.path.join(d, "sweep_0001", "model__fixed.npz")
+        blob = open(target, "rb").read()
+        with open(target, "wb") as f:
+            f.write(blob[:len(blob) // 2])
+        with caplog.at_level("WARNING"):
+            state = ckpt.load_latest(d)
+        assert state is not None and state.sweep == 0  # fell back one sweep
+        assert any("skipping unusable checkpoint" in r.message
+                   for r in caplog.records)
+        assert any(e["kind"] == "checkpoint_corrupt"
+                   for e in failures.snapshot())
+
+    def test_schema_version_written(self, rng, tmp_path):
+        import json
+        d = self._save_two(rng, tmp_path)
+        meta = json.load(open(os.path.join(d, "sweep_0000", "meta.json")))
+        assert meta["schema"] == ckpt.SCHEMA_VERSION
+        assert set(meta["checksums"]) >= {"model__fixed.npz"}
+
+
+# ---------------------------------------------------------------------------
+# retrying I/O
+# ---------------------------------------------------------------------------
+
+_FAST = retry.RetryPolicy(max_attempts=4, base_delay_s=0.0, max_delay_s=0.0)
+
+
+class TestRetry:
+    def test_transient_errors_are_retried_then_succeed(self, tmp_path):
+        path = str(tmp_path / "out.bin")
+        with chaos.active(chaos.ChaosConfig(io_failures={"model_write": 2})):
+            rio.atomic_write_bytes(path, b"payload", op="model_write",
+                                   policy=_FAST)
+        assert open(path, "rb").read() == b"payload"
+        assert not any(e["kind"] == "io_giveup" for e in failures.snapshot())
+
+    def test_giveup_records_failure_and_raises(self, tmp_path):
+        path = str(tmp_path / "out.bin")
+        tight = retry.RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                                  max_delay_s=0.0)
+        with chaos.active(chaos.ChaosConfig(io_failures={"model_write": 9})):
+            with pytest.raises(chaos.ChaosIOError):
+                rio.atomic_write_bytes(path, b"x", op="model_write",
+                                       policy=tight)
+        ev = [e for e in failures.snapshot() if e["kind"] == "io_giveup"]
+        assert len(ev) == 1 and ev[0]["op"] == "model_write"
+        assert not os.path.exists(path)  # no torn final artifact
+
+    def test_read_bytes_retries(self, tmp_path):
+        path = str(tmp_path / "in.bin")
+        with open(path, "wb") as f:
+            f.write(b"abc")
+        with chaos.active(chaos.ChaosConfig(io_failures={"ingest": 1})):
+            assert rio.read_bytes(path, op="ingest_read",
+                                  policy=_FAST) == b"abc"
+
+    def test_backoff_is_deterministic_and_bounded(self):
+        for attempt in range(6):
+            d1 = retry.backoff_delay("checkpoint", attempt, 0.05, 2.0)
+            d2 = retry.backoff_delay("checkpoint", attempt, 0.05, 2.0)
+            assert d1 == d2
+            raw = min(2.0, 0.05 * 2 ** attempt)
+            assert 0.5 * raw <= d1 <= raw
+        # jitter actually varies across (op, attempt)
+        assert (retry.backoff_delay("a", 0, 1.0, 9.0)
+                != retry.backoff_delay("b", 0, 1.0, 9.0))
+
+    def test_policy_from_env(self, monkeypatch):
+        monkeypatch.setenv(retry.ENV_ATTEMPTS, "7")
+        monkeypatch.setenv(retry.ENV_BASE, "0.01")
+        monkeypatch.setenv(retry.ENV_MAX, "0.5")
+        p = retry.RetryPolicy.from_env()
+        assert (p.max_attempts, p.base_delay_s, p.max_delay_s) \
+            == (7, 0.01, 0.5)
+
+    def test_training_survives_transient_checkpoint_errors(self, rng,
+                                                           tmp_path,
+                                                           monkeypatch):
+        monkeypatch.setenv(retry.ENV_BASE, "0.0")
+        monkeypatch.setenv(retry.ENV_MAX, "0.0")
+        df = _frame(rng, n=120)
+        ckdir = str(tmp_path / "ck")
+        with chaos.active(chaos.ChaosConfig(io_failures={"checkpoint": 2})):
+            res = _estimator(num_iterations=2).fit(df, checkpoint_dir=ckdir)
+        assert res[-1].model is not None
+        state = ckpt.load_latest(str(tmp_path / "ck" / "config_000"))
+        assert state is not None and state.sweep == 1
+
+
+# ---------------------------------------------------------------------------
+# data validation: non-finite detection + opt-in row dropping
+# ---------------------------------------------------------------------------
+
+
+class TestValidators:
+    def _bad_frame(self, rng, n=50, d=4):
+        from photon_tpu.game.dataset import GameDataFrame
+        X = rng.normal(size=(n, d))
+        X[3, 1] = np.nan          # bad feature row 3
+        y = (rng.random(n) < 0.5).astype(np.float64)
+        y[7] = np.nan             # bad label row 7
+        w = np.ones(n)
+        w[11] = np.inf            # bad weight row 11
+        return GameDataFrame(
+            num_samples=n, response=y,
+            feature_shards={"g": FeatureShard(X, d)},
+            weights=w, id_tags={})
+
+    def test_default_raises_with_counts(self, rng):
+        from photon_tpu.data.validators import (
+            DataValidationError, DataValidationType, validate_dataframe)
+        with pytest.raises(DataValidationError) as ei:
+            validate_dataframe(self._bad_frame(rng),
+                               TaskType.LINEAR_REGRESSION,
+                               DataValidationType.VALIDATE_FULL)
+        v = ei.value.violations
+        assert v["finite labels"] == 1
+        assert v["finite weights"] == 1
+        assert v["finite features [g]"] == 1
+
+    def test_drop_invalid_rows(self, rng):
+        from photon_tpu.data.validators import (
+            DataValidationType, validate_dataframe)
+        failures.clear()
+        out = validate_dataframe(self._bad_frame(rng),
+                                 TaskType.LINEAR_REGRESSION,
+                                 DataValidationType.VALIDATE_FULL,
+                                 drop_invalid_rows=True)
+        assert out.num_samples == 47  # rows 3, 7, 11 gone
+        assert np.isfinite(np.asarray(out.response)).all()
+        assert np.isfinite(np.asarray(out.feature_shards["g"].rows)).all()
+        ev = [e for e in failures.snapshot()
+              if e["kind"] == "invalid_rows_dropped"]
+        assert len(ev) == 1 and ev[0]["rows"] == 3
+        # the cleaned frame now validates under the default (raising) mode
+        validate_dataframe(out, TaskType.LINEAR_REGRESSION,
+                           DataValidationType.VALIDATE_FULL)
+
+    def test_drop_filters_csr_shards(self, rng):
+        from photon_tpu.data.validators import (
+            DataValidationType, validate_dataframe)
+        n = 6
+        dense = rng.normal(size=(n, 3))
+        dense[2, 0] = np.nan
+        csr = CsrRows.from_dense(rng.normal(size=(n, 2)))
+        df = GameDataFrame(
+            num_samples=n, response=np.zeros(n),
+            feature_shards={"d": FeatureShard(dense, 3),
+                            "s": FeatureShard(csr, 2)},
+            id_tags={"userId": [str(i) for i in range(n)]})
+        out = validate_dataframe(df, TaskType.LINEAR_REGRESSION,
+                                 DataValidationType.VALIDATE_FULL,
+                                 drop_invalid_rows=True)
+        assert out.num_samples == 5
+        s = out.feature_shards["s"].rows
+        assert isinstance(s, CsrRows) and len(s) == 5
+        # surviving CSR rows keep their values, in order
+        keep = [0, 1, 3, 4, 5]
+        for new_i, old_i in enumerate(keep):
+            np.testing.assert_array_equal(s[new_i][1], csr[old_i][1])
+        assert out.id_tags["userId"] == [str(i) for i in keep]
+
+
+# ---------------------------------------------------------------------------
+# multi-host consistency guard (single-process unit level; the 2-process
+# end-to-end lives in tests/test_multihost.py)
+# ---------------------------------------------------------------------------
+
+
+class TestMultihostGuard:
+    def _model(self, means):
+        from photon_tpu.game.model import FixedEffectModel
+        from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+        return FixedEffectModel(
+            GeneralizedLinearModel(Coefficients(jnp.asarray(means)),
+                                   TaskType.LOGISTIC_REGRESSION), "g")
+
+    def test_digest_deterministic_and_value_sensitive(self):
+        a = {"fixed": self._model([1.0, 2.0, 3.0])}
+        b = {"fixed": self._model([1.0, 2.0, 3.0])}
+        c = {"fixed": self._model([1.0, 2.0, 3.5])}
+        assert multihost.fixed_effect_digest(a) \
+            == multihost.fixed_effect_digest(b)
+        assert multihost.fixed_effect_digest(a) \
+            != multihost.fixed_effect_digest(c)
+
+    def test_check_consistency_single_process_noop(self):
+        multihost.check_consistency({"fixed": self._model([1.0])}, sweep=0)
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv(multihost.ENV_FLAG, "0")
+        assert not multihost.enabled()
+        monkeypatch.delenv(multihost.ENV_FLAG)
+        assert multihost.enabled()
+
+
+# ---------------------------------------------------------------------------
+# exception-hygiene lint (tier-1 wiring + behavior)
+# ---------------------------------------------------------------------------
+
+
+class TestExceptionHygiene:
+    def test_repo_is_clean(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_exception_hygiene",
+            os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                         "check_exception_hygiene.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.check() == []
+
+    def test_lint_flags_silent_handlers(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_exception_hygiene",
+            os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                         "check_exception_hygiene.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "try:\n    x = 1\nexcept:\n    pass\n"
+            "try:\n    y = 2\nexcept Exception:\n    pass\n"
+            "try:\n    z = 3\nexcept Exception:  # hygiene-ok\n    pass\n"
+            "try:\n    w = 4\nexcept ValueError:\n    pass\n")
+        out = mod.check(paths=(str(tmp_path),))
+        assert len(out) == 2
+        assert "bare" in out[0] and "silent" in out[1]
+
+    def test_no_host_sync_lint_still_passes(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "check_no_host_sync",
+            os.path.join(os.path.dirname(__file__), os.pardir, "scripts",
+                         "check_no_host_sync.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.check() == []
+
+
+# ---------------------------------------------------------------------------
+# failure trail -> RunReport
+# ---------------------------------------------------------------------------
+
+
+class TestFailureTrail:
+    def test_record_failure_snapshot_and_metrics(self):
+        from photon_tpu.obs.metrics import registry
+        failures.clear()
+        failures.record_failure("unit_test", detail=42)
+        snap = failures.snapshot()
+        assert len(snap) == 1
+        assert snap[0]["kind"] == "unit_test" and snap[0]["detail"] == 42
+        counters = registry.snapshot()["counters"]
+        assert any("resilience.failures" in k and "unit_test" in k
+                   for k in counters)
+
+    def test_run_report_requires_failures_section(self):
+        from photon_tpu.obs.report import build_run_report, validate_run_report
+        failures.clear()
+        failures.record_failure("unit_test")
+        report = build_run_report("test")
+        assert validate_run_report(report) == []
+        assert any(e["kind"] == "unit_test" for e in report["failures"])
+        del report["failures"]
+        assert any("failures" in e for e in validate_run_report(report))
