@@ -1787,6 +1787,135 @@ def config_sparse_tp(scale: float):
     return json.loads(lines[-1])
 
 
+# --------------------------------------------------------------------------
+# serving mode: --mode serving -> BENCH_SERVING_r01.json
+# --------------------------------------------------------------------------
+
+def run_serving_bench(scale: float):
+    """Online-serving benchmark (ISSUE 5): stage a GLMix-shaped model
+    device-resident, warm the full (mode x bucket) ladder, then drive a
+    closed-loop request stream through the micro-batcher. Reports
+    throughput, per-stage p50/p95/p99, single-request latency, and the
+    zero-steady-state-compile check — the serving counterparts of the
+    training configs' samples/s + MFU."""
+    import jax
+
+    from photon_tpu.io.index_map import IndexMapBuilder, feature_key
+    from photon_tpu.io.model_io import (
+        ServingFixedEffect,
+        ServingGameModel,
+        ServingRandomEffect,
+    )
+    from photon_tpu.serving import (
+        DeviceResidentModel,
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+    )
+    from photon_tpu.types import TaskType
+    from photon_tpu.utils import compile_cache
+
+    d_global, n_users, k_user = 256, int(10_000 * scale) or 1, 8
+    n_requests = int(5_000 * scale) or 64
+    rng = np.random.default_rng(5)
+
+    b = IndexMapBuilder()
+    names = [f"g{j}" for j in range(d_global)]
+    for nm in names:
+        b.put(feature_key(nm, ""))
+    imap = b.build()
+    proj = np.stack([np.sort(rng.choice(d_global, size=k_user, replace=False))
+                     for _ in range(n_users)]).astype(np.int32)
+    serving_model = ServingGameModel(
+        TaskType.LOGISTIC_REGRESSION,
+        [ServingFixedEffect("fixed", "global",
+                            rng.normal(size=d_global).astype(np.float32))],
+        [ServingRandomEffect(
+            "per_user", "userId", "global",
+            rng.normal(size=(n_users, k_user)).astype(np.float32), proj,
+            {f"u{e}": e for e in range(n_users)})],
+        {"global": imap}, {})
+
+    t0 = time.perf_counter()
+    model = DeviceResidentModel(serving_model)
+    stage_s = time.perf_counter() - t0
+    engine = ServingEngine(model, ServingConfig(max_batch=64,
+                                                max_wait_s=0.001))
+    winfo = engine.warmup()
+    log(f"serving: staged in {stage_s:.2f}s, warmed {winfo['programs']} "
+        f"programs in {winfo['seconds']:.2f}s")
+
+    nnz = 32                           # features per request
+    def make_request(i):
+        cols = rng.choice(d_global, size=nnz, replace=False)
+        user = f"u{int(rng.integers(0, n_users))}" if i % 10 else "cold"
+        return ScoreRequest(
+            f"q{i}", {"global": [(names[c], "", float(rng.normal()))
+                                 for c in cols]},
+            {"userId": user})
+
+    requests = [make_request(i) for i in range(n_requests)]
+
+    # single-request latency probe (bucket-1 path, host wall clock)
+    singles = []
+    for r in requests[:100]:
+        t0 = time.perf_counter()
+        engine.serve([r])
+        singles.append(time.perf_counter() - t0)
+    single_p50 = float(np.percentile(singles, 50))
+    single_p99 = float(np.percentile(singles, 99))
+
+    # closed-loop throughput: submit everything, pump to completion
+    t0 = time.perf_counter()
+    done = 0
+    for r in requests:
+        engine.submit(r)
+        done += len(engine.pump())
+    done += len(engine.drain())
+    elapsed = time.perf_counter() - t0
+    qps = done / elapsed
+
+    stats = engine.stats()
+    compiles = compile_cache.compile_counts()
+    lat = stats["latency_seconds"]
+    rec = {
+        "metric": "serving_throughput_qps",
+        "value": round(qps, 1),
+        "unit": "requests/s",
+        "requests": done,
+        "wallclock_s": round(elapsed, 3),
+        "single_request_p50_s": round(single_p50, 6),
+        "single_request_p99_s": round(single_p99, 6),
+        "latency_seconds": {stage: {k: (round(v, 6)
+                                        if isinstance(v, float) else v)
+                                    for k, v in d.items()}
+                            for stage, d in lat.items()},
+        "buckets": stats["buckets"],
+        "batches": {k: v for k, v in stats["counters"].items()
+                    if k.startswith("serving.batches")},
+        "degraded": {k: v for k, v in stats["counters"].items()
+                     if k.startswith("serving.degraded")},
+        "model": {"d_global": d_global, "n_users": n_users,
+                  "k_user": k_user, "nnz_per_request": nnz},
+        "stage_seconds": round(stage_s, 3),
+        "warmup_seconds": round(winfo["seconds"], 3),
+        "warmup_programs": winfo["programs"],
+        "compile_counts": compiles,
+        "no_steady_state_compiles": compiles["steady_state"] == 0,
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "tpu_unavailable": _STATE["tpu_unavailable"],
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_SERVING_r01.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    log(f"serving: {qps:.0f} qps, total p50 "
+        f"{lat.get('total', {}).get('p50')}, steady-state compiles "
+        f"{int(compiles['steady_state'])}")
+    return rec
+
+
 # Order = on-chip capture priority (each config emits its JSON line the
 # moment it completes, so when the flaky relay dies mid-run the most
 # decision-relevant numbers are already on disk): the NEWTON flagship,
@@ -1814,6 +1943,10 @@ def main():
                     default=float(os.environ.get("BENCH_SCALE", "1.0")))
     ap.add_argument("--configs", default=os.environ.get("BENCH_CONFIGS", ""),
                     help="comma-separated subset of config names")
+    ap.add_argument("--mode", default=os.environ.get("BENCH_MODE", "train"),
+                    choices=("train", "serving"),
+                    help="train = the solver configs (default); serving = "
+                         "the online-serving bench -> BENCH_SERVING_r01.json")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -1857,6 +1990,21 @@ def main():
     except Exception as e:  # even backend init failure must yield a line
         log(f"FATAL during platform bootstrap: {e!r}")
         finish(rc_reason=f"bootstrap: {e!r}")
+        return
+
+    if args.mode == "serving":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/serving"):
+                emit(run_serving_bench(args.scale))
+        except Exception as e:
+            import traceback
+
+            log(f"serving bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "serving_throughput_qps", "value": 0.0,
+                  "unit": "requests/s", "error": repr(e)})
+        _DONE.set()     # serving mode: the record above IS the summary
         return
 
     selected = [s.strip() for s in args.configs.split(",") if s.strip()]

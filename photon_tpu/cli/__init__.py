@@ -5,6 +5,7 @@ Entry points:
   python -m photon_tpu.cli.score          GAME batch scoring (GameScoringDriver)
   python -m photon_tpu.cli.legacy         legacy single-GLM driver (Driver)
   python -m photon_tpu.cli.feature_index  feature index build (FeatureIndexingDriver)
+  python -m photon_tpu.cli.serve          online serving (JSONL stdin -> stdout)
 """
 
 from photon_tpu.cli.config import (

@@ -1,0 +1,137 @@
+"""Online serving driver: JSONL requests on stdin -> JSONL scores on stdout.
+
+The online counterpart of ``cli/score`` (batch). One process = one
+device-resident model + one serving engine; requests stream through the
+micro-batcher and responses stream out in completion order (batch pops
+are FIFO, so completion order is submission order except for immediate
+typed rejections).
+
+Request line schema::
+
+    {"uid": "r1",
+     "features": {"shardA": [["name", "term", 1.5], ...]},
+     "ids": {"userId": "u17"},
+     "offset": 0.0}
+
+Response line schema: ``ScoreResponse.to_json()`` —
+``{"uid", "score", "degraded", "fallbacks": [{"reason", ...}]}``.
+
+Usage::
+
+    python -m photon_tpu.cli.serve --model-input-directory /path/to/model \
+        [--max-batch 64] [--max-wait-ms 2] [--stats-output stats.json] \
+        < requests.jsonl > scores.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import Optional
+
+logger = logging.getLogger("photon_tpu.serve")
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_tpu.serve",
+        description="Serve a trained GAME model over JSONL stdin/stdout")
+    p.add_argument("--model-input-directory", required=True)
+    p.add_argument("--coordinates", nargs="*", default=None,
+                   help="subset of coordinate ids to load (default: all)")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="top of the power-of-two bucket ladder")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="coalescing deadline for a partial batch")
+    p.add_argument("--feature-pad", type=int, default=None,
+                   help="per-shard padded feature width (default: auto)")
+    p.add_argument("--shed-queue-depth", type=int, default=512)
+    p.add_argument("--reject-queue-depth", type=int, default=4096)
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip ladder pre-compilation (debugging only; "
+                        "steady-state requests will compile)")
+    p.add_argument("--stats-output", default=None,
+                   help="write engine stats() JSON here at stream end")
+    p.add_argument("--runreport-output", default=None,
+                   help="write a RunReport (with serving section) here")
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def build_engine(args: argparse.Namespace):
+    from photon_tpu.serving import ServingConfig, ServingEngine, SLOConfig
+    from photon_tpu.utils import compile_cache
+
+    compile_cache.maybe_enable()
+    config = ServingConfig(
+        max_batch=args.max_batch,
+        max_wait_s=args.max_wait_ms / 1000.0,
+        feature_pad=args.feature_pad,
+        slo=SLOConfig(shed_queue_depth=args.shed_queue_depth,
+                      reject_queue_depth=args.reject_queue_depth))
+    engine = ServingEngine.from_model_dir(
+        args.model_input_directory, config=config,
+        coordinates_to_load=args.coordinates)
+    if not args.no_warmup:
+        info = engine.warmup()
+        logger.info("warmed %d programs over buckets %s in %.2fs",
+                    info["programs"], info["buckets"], info["seconds"])
+    return engine
+
+
+def run(args: argparse.Namespace,
+        stdin=None, stdout=None) -> int:
+    logging.basicConfig(
+        level=args.log_level, stream=sys.stderr,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    import photon_tpu.serving as serving_pkg
+
+    stdin = stdin if stdin is not None else sys.stdin
+    stdout = stdout if stdout is not None else sys.stdout
+    engine = build_engine(args)
+    serving_pkg.set_active_engine(engine)
+
+    def emit(resp):
+        stdout.write(json.dumps(resp.to_json()) + "\n")
+
+    bad_lines = 0
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = serving_pkg.ScoreRequest.from_json(json.loads(line))
+        except (ValueError, KeyError, TypeError) as e:
+            bad_lines += 1
+            logger.warning("bad request line skipped: %r", e)
+            continue
+        rejected = engine.submit(req)
+        if rejected is not None:
+            emit(rejected)
+        for resp in engine.pump():
+            emit(resp)
+    # stream end: flush the remainder (padded partial batches)
+    for resp in engine.drain():
+        emit(resp)
+    stdout.flush()
+
+    if args.stats_output:
+        with open(args.stats_output, "w") as f:
+            json.dump(engine.stats(), f, indent=1)
+            f.write("\n")
+    if args.runreport_output:
+        from photon_tpu.obs.report import write_run_report
+        write_run_report(args.runreport_output, driver="serve")
+    if bad_lines:
+        logger.warning("%d malformed request lines skipped", bad_lines)
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    return run(build_arg_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

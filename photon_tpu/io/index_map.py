@@ -43,6 +43,7 @@ class IndexMap:
     def __init__(self, key_to_idx: Optional[Dict[str, int]] = None):
         self._map: Dict[str, int] = dict(key_to_idx or {})
         self._names: Optional[List[str]] = None
+        self._dim: Optional[int] = None
 
     # -- Map behavior --------------------------------------------------------
 
@@ -79,8 +80,12 @@ class IndexMap:
 
     @property
     def feature_dimension(self) -> int:
-        """Number of columns = max index + 1."""
-        return (max(self._map.values()) + 1) if self._map else 0
+        """Number of columns = max index + 1. Cached: the map is frozen
+        after construction, and the model-load path reads this once per
+        coordinate (each read was a full value scan)."""
+        if self._dim is None:
+            self._dim = (max(self._map.values()) + 1) if self._map else 0
+        return self._dim
 
     @property
     def has_intercept(self) -> bool:

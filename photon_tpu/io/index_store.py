@@ -102,6 +102,12 @@ class IndexStore:
     def to_index_map(self) -> IndexMap:
         return IndexMap(dict(self.items()))
 
+    @property
+    def max_index(self) -> int:
+        """Largest stored index, -1 when empty — straight off the mmap'd
+        table, no key decoding."""
+        return int(self._rows["idx"].max()) if self.num_keys else -1
+
     def close(self):
         self._rows = None  # release the numpy view over the mmap buffer
         self._mm.close()
@@ -163,13 +169,14 @@ class PartitionedIndexMap:
     def to_index_map(self) -> IndexMap:
         merged: Dict[str, int] = {}
         for s in self.stores:
-            merged.update(dict(s.items()))
+            merged.update(s.items())
         return IndexMap(merged)
 
     @property
     def feature_dimension(self) -> int:
-        return max((max((i for _, i in s.items()), default=-1)
-                    for s in self.stores), default=-1) + 1
+        # index column only — decoding every key blob just to take a max
+        # was a second full read of each partition on the load path
+        return max((s.max_index for s in self.stores), default=-1) + 1
 
     def close(self):
         for s in self.stores:

@@ -26,7 +26,12 @@ import numpy as np
 from photon_tpu.game.dataset import EntityVocabulary
 from photon_tpu.game.model import FixedEffectModel, GameModel, RandomEffectModel
 from photon_tpu.io import avro as avro_io
-from photon_tpu.io.index_map import IndexMap, split_feature_key
+from photon_tpu.io.index_map import (
+    IndexMap,
+    IndexMapBuilder,
+    feature_key,
+    split_feature_key,
+)
 from photon_tpu.io.schemas import BAYESIAN_LINEAR_MODEL_AVRO
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.resilience import io as rio
@@ -307,6 +312,158 @@ class LoadedGameModel:
                 variances=None if var is None else jnp.asarray(var),
             )
         return GameModel(models)
+
+
+# ---------------------------------------------------------------------------
+# serving fast path
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ServingFixedEffect:
+    """One fixed-effect coordinate as a flat coefficient vector."""
+
+    coordinate_id: str
+    feature_shard_id: str
+    coefficients: np.ndarray          # [D_shard] in the serving index space
+
+
+@dataclasses.dataclass
+class ServingRandomEffect:
+    """One random-effect coordinate as a gather table + entity lookup."""
+
+    coordinate_id: str
+    random_effect_type: str
+    feature_shard_id: str
+    coefficients: np.ndarray          # [E, K] per-entity local-slot coefs
+    projection: np.ndarray            # [E, K] int32 global column (-1 pad)
+    entity_rows: Dict[str, int]       # REId string -> entity row
+
+    @property
+    def num_entities(self) -> int:
+        return self.coefficients.shape[0]
+
+
+@dataclasses.dataclass
+class ServingGameModel:
+    """Serving-shaped GAME model: flat arrays + lookup dicts only.
+
+    Unlike :class:`LoadedGameModel` this carries none of the training-time
+    containers (no GameModel/EntityVocabulary, no variances, no
+    ``aligned_to`` re-packing machinery) — it is exactly what the online
+    scorer consumes, produced in one pass over the on-disk records.
+    """
+
+    task: TaskType
+    fixed: List[ServingFixedEffect]
+    random: List[ServingRandomEffect]
+    index_maps: Dict[str, IndexMap]   # serving column space, per shard
+    metadata: dict
+
+    @property
+    def shard_dims(self) -> Dict[str, int]:
+        return {sid: m.feature_dimension for sid, m in self.index_maps.items()}
+
+
+def load_for_serving(
+    model_dir: str,
+    index_maps: Optional[Dict[str, IndexMap]] = None,
+    coordinates_to_load: Optional[Sequence[str]] = None,
+    dtype=np.float32,
+) -> ServingGameModel:
+    """Load a GAME model for online scoring: one pass over every record.
+
+    Without ``index_maps`` the serving column space is built from the
+    model's own support (a feature the model never weights scores zero
+    either way, so dropping out-of-support request features preserves
+    scores exactly). Variances are never parsed — serving only scores.
+    """
+    metadata = load_model_metadata(model_dir)
+    task = TaskType(metadata["modelType"])
+    wanted = set(coordinates_to_load) if coordinates_to_load else None
+    external = index_maps is not None
+    builders: Dict[str, IndexMapBuilder] = {}
+
+    def col_of(shard_id: str, name: str, term: str) -> int:
+        if external:
+            return index_maps[shard_id].index_of(name, term)
+        return builders.setdefault(shard_id, IndexMapBuilder()).put(
+            feature_key(name, term))
+
+    # pass 1 (and only): records -> {global column: value} slot dicts;
+    # dense packing waits until every coordinate has grown the builders
+    fixed_raw: List[Tuple[str, str, Dict[int, float]]] = []
+    random_raw: List[Tuple[str, str, str, List[str], List[Dict[int, float]]]] = []
+
+    fixed_dir = os.path.join(model_dir, FIXED_EFFECT)
+    if os.path.isdir(fixed_dir):
+        for cid in sorted(os.listdir(fixed_dir)):
+            if wanted is not None and cid not in wanted:
+                continue
+            cdir = os.path.join(fixed_dir, cid)
+            with open(os.path.join(cdir, ID_INFO)) as f:
+                shard_id = f.read().split()[0]
+            if external and shard_id not in index_maps:
+                raise KeyError(f"no index map for feature shard {shard_id!r}")
+            recs = list(avro_io.iter_avro_dir(os.path.join(cdir, COEFFICIENTS)))
+            if len(recs) != 1:
+                raise ValueError(
+                    f"expected 1 fixed-effect record, got {len(recs)}")
+            slots: Dict[int, float] = {}
+            for r in recs[0]["means"]:
+                g = col_of(shard_id, str(r["name"]), str(r["term"]))
+                if g >= 0:
+                    slots[g] = float(r["value"])
+            fixed_raw.append((cid, shard_id, slots))
+
+    random_dir = os.path.join(model_dir, RANDOM_EFFECT)
+    if os.path.isdir(random_dir):
+        for cid in sorted(os.listdir(random_dir)):
+            if wanted is not None and cid not in wanted:
+                continue
+            cdir = os.path.join(random_dir, cid)
+            with open(os.path.join(cdir, ID_INFO)) as f:
+                re_type, shard_id = f.read().split()[:2]
+            if external and shard_id not in index_maps:
+                raise KeyError(f"no index map for feature shard {shard_id!r}")
+            names: List[str] = []
+            per_entity: List[Dict[int, float]] = []
+            for rec in avro_io.iter_avro_dir(os.path.join(cdir, COEFFICIENTS)):
+                slots = {}
+                for r in rec["means"]:
+                    g = col_of(shard_id, str(r["name"]), str(r["term"]))
+                    if g >= 0:
+                        slots[g] = float(r["value"])
+                names.append(str(rec["modelId"]))
+                per_entity.append(slots)
+            random_raw.append((cid, re_type, shard_id, names, per_entity))
+
+    maps = dict(index_maps) if external else {
+        sid: b.build() for sid, b in builders.items()}
+
+    fixed = []
+    for cid, shard_id, slots in fixed_raw:
+        dim = maps[shard_id].feature_dimension if shard_id in maps else 0
+        vec = np.zeros(max(dim, 1), dtype)
+        for g, v in slots.items():
+            vec[g] = v
+        fixed.append(ServingFixedEffect(cid, shard_id, vec))
+
+    random_ = []
+    for cid, re_type, shard_id, names, per_entity in random_raw:
+        E = len(per_entity)
+        K = max((len(s) for s in per_entity), default=1) or 1
+        coef = np.zeros((E, K), dtype)
+        proj = np.full((E, K), -1, np.int32)
+        for e, slots in enumerate(per_entity):
+            for s, (g, v) in enumerate(sorted(slots.items())):
+                proj[e, s] = g
+                coef[e, s] = v
+        random_.append(ServingRandomEffect(
+            cid, re_type, shard_id, coef, proj,
+            {name: i for i, name in enumerate(names)}))
+
+    return ServingGameModel(task, fixed, random_, maps, metadata)
 
 
 def load_game_model(

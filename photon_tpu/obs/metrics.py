@@ -92,6 +92,35 @@ class Histogram:
             self.sum += value
             self.count += 1
 
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            return bucket_quantile(self.buckets, self.counts, q)
+
+
+def bucket_quantile(buckets: Sequence[float], counts: Sequence[int],
+                    q: float) -> Optional[float]:
+    """Prometheus-style estimated quantile: find the bucket holding rank
+    q*count, interpolate linearly inside it (lower bound 0 for the first
+    bucket; the +Inf bucket clamps to the last finite bound). None when
+    empty. Estimation error is bounded by bucket width — pick latency
+    buckets accordingly (serving uses ~1.3x geometric steps)."""
+    total = sum(counts)
+    if total == 0:
+        return None
+    target = q * total
+    cumulative = 0
+    for i, c in enumerate(counts):
+        prev = cumulative
+        cumulative += c
+        if cumulative >= target and c > 0:
+            if i >= len(buckets):            # +Inf bucket
+                return float(buckets[-1]) if buckets else None
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            frac = (target - prev) / c
+            return float(lo + (hi - lo) * frac)
+    return float(buckets[-1]) if buckets else None
+
 
 class MetricsRegistry:
     """Thread-safe name+labels -> metric registry.
@@ -155,12 +184,18 @@ class MetricsRegistry:
                 out["gauges"][key] = metric.value
             else:
                 assert isinstance(metric, Histogram)
-                out["histograms"][key] = {
+                h = {
                     "buckets": list(metric.buckets),
                     "counts": list(metric.counts),
                     "sum": metric.sum,
                     "count": metric.count,
                 }
+                if metric.count:
+                    for name_q, q in (("p50", 0.5), ("p95", 0.95),
+                                      ("p99", 0.99)):
+                        h[name_q] = bucket_quantile(h["buckets"],
+                                                    h["counts"], q)
+                out["histograms"][key] = h
         return out
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -231,4 +266,8 @@ def merge_snapshots(snapshots: Iterable[Dict[str, Dict[str, object]]]
                                  zip(cur["counts"], h["counts"])]
                 cur["sum"] += h["sum"]
                 cur["count"] += h["count"]
+    for h in out["histograms"].values():
+        if h["count"]:  # cluster-level quantiles over the merged buckets
+            for name_q, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+                h[name_q] = bucket_quantile(h["buckets"], h["counts"], q)
     return out

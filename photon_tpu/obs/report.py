@@ -82,9 +82,26 @@ def build_run_report(driver: str,
         "memory": memory.watermarks(),
         "failures": failures.snapshot(),
     }
+    serving = _serving_section()
+    if serving is not None:
+        report["serving"] = serving
     if extra:
         report["extra"] = extra
     return report
+
+
+def _serving_section() -> Optional[Dict[str, Any]]:
+    """The active serving engine's ``stats()``, when this process is a
+    serving process. Deliberately read via ``sys.modules`` — an offline
+    driver that never imported photon_tpu.serving pays nothing and its
+    report is unchanged."""
+    mod = sys.modules.get("photon_tpu.serving")
+    if mod is None:
+        return None
+    try:
+        return mod.serving_report_section()
+    except Exception:  # noqa: BLE001 — reporting must not kill a run
+        return None
 
 
 def write_run_report(path: str,
@@ -187,4 +204,13 @@ def validate_run_report(report: Dict[str, Any]) -> List[str]:
     if (not isinstance(proc, dict) or "index" not in proc
             or "count" not in proc):
         errors.append("process must be {'index', 'count'}")
+    if "serving" in report:  # optional: only serving processes emit it
+        serving = report["serving"]
+        if not isinstance(serving, dict):
+            errors.append("serving must be a dict")
+        else:
+            for k in ("buckets", "compile_counts", "counters",
+                      "latency_seconds"):
+                if k not in serving:
+                    errors.append(f"serving missing {k!r}")
     return errors

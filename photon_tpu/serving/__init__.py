@@ -1,0 +1,60 @@
+"""Online serving: device-resident GAME models behind a micro-batcher.
+
+The offline half of the repo trains and scores frames; this package
+serves single requests at low latency. Design contract (ISSUE 5):
+
+  * the model is staged onto the accelerator exactly once
+    (:class:`DeviceResidentModel`), requests only ship [B, k] arrays;
+  * batch shapes come from a fixed power-of-two ladder
+    (:class:`BucketLadder` / :class:`MicroBatcher`), so the compile set
+    is finite and fully warmed at model load — zero steady-state
+    compiles (checked by ``scripts/check_serving_no_recompile.py``);
+  * overload degrades through a typed ladder (full -> fixed-effect-only
+    -> rejection), never an exception on the hot path.
+"""
+
+from photon_tpu.serving.batching import BucketLadder, MicroBatcher
+from photon_tpu.serving.engine import LATENCY_BUCKETS, ServingEngine
+from photon_tpu.serving.model_state import DeviceResidentModel
+from photon_tpu.serving.scorer import MODES, get_scorer, warmup_scorers
+from photon_tpu.serving.types import (
+    Fallback,
+    FallbackReason,
+    ScoreRequest,
+    ScoreResponse,
+    ServingConfig,
+    SLOConfig,
+)
+
+__all__ = [
+    "BucketLadder",
+    "DeviceResidentModel",
+    "Fallback",
+    "FallbackReason",
+    "LATENCY_BUCKETS",
+    "MODES",
+    "MicroBatcher",
+    "ScoreRequest",
+    "ScoreResponse",
+    "ServingConfig",
+    "ServingEngine",
+    "SLOConfig",
+    "get_scorer",
+    "serving_report_section",
+    "warmup_scorers",
+]
+
+# the engine the RunReport describes; a process normally runs one engine,
+# and obs/report.py picks this up without importing serving eagerly
+_active_engine = None
+
+
+def set_active_engine(engine) -> None:
+    global _active_engine
+    _active_engine = engine
+
+
+def serving_report_section():
+    """``stats()`` of the registered engine, or None when this process
+    never served (keeps offline RunReports unchanged)."""
+    return _active_engine.stats() if _active_engine is not None else None

@@ -1,0 +1,133 @@
+"""Bucketed micro-batching: admission queue + power-of-two bucket ladder.
+
+Snap ML's observation (PAPERS.md) carried to serving: the win comes from
+keeping device state resident and feeding it *fixed-shape* work. Every
+distinct batch shape is a separate XLA executable, so the batcher never
+emits an arbitrary batch size — it coalesces queued requests into the
+smallest ladder bucket that fits (padding the remainder with zero-weight
+rows) and the ladder is finite, so the compile set is finite and fully
+warmable at model-load time.
+
+The clock is injected (``clock=``) so the coalescing policy is unit-
+testable without sleeping: tests advance a fake clock and assert exactly
+when a batch forms.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+from photon_tpu.serving.types import ScoreRequest
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class BucketLadder:
+    """Fixed ladder of power-of-two batch sizes, ``min_bucket..max_batch``."""
+
+    def __init__(self, max_batch: int = 64, min_bucket: int = 1):
+        if min_bucket < 1 or max_batch < min_bucket:
+            raise ValueError(f"bad ladder bounds [{min_bucket}, {max_batch}]")
+        lo, hi = _next_pow2(min_bucket), _next_pow2(max_batch)
+        b, buckets = lo, []
+        while b <= hi:
+            buckets.append(b)
+            b *= 2
+        self.buckets: Tuple[int, ...] = tuple(buckets)
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket that fits ``n`` requests (ladder top when
+        ``n`` exceeds it — the caller takes at most ``max_batch``)."""
+        if n <= 0:
+            raise ValueError(f"bucket_for({n})")
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.max_batch
+
+
+class Pending(NamedTuple):
+    request: ScoreRequest
+    t_submit: float
+
+
+class MicroBatcher:
+    """Thread-safe admission queue with deadline-based coalescing.
+
+    A batch is released when either (a) the queue holds a full ladder-top
+    batch, or (b) the OLDEST queued request has waited ``max_wait_s``
+    (then everything pending ships in the smallest covering bucket —
+    the padded-remainder case). ``flush=True`` overrides the deadline,
+    used at stream end and by synchronous ``serve()``.
+    """
+
+    def __init__(self, ladder: BucketLadder, max_wait_s: float = 0.002,
+                 clock: Optional[Callable[[], float]] = None):
+        import time
+
+        self.ladder = ladder
+        self.max_wait_s = float(max_wait_s)
+        self.clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[Pending] = []
+
+    def submit(self, request: ScoreRequest) -> None:
+        with self._cond:
+            self._queue.append(Pending(request, self.clock()))
+            self._cond.notify()
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def oldest_wait(self) -> Optional[float]:
+        with self._lock:
+            if not self._queue:
+                return None
+            return self.clock() - self._queue[0].t_submit
+
+    def ready(self) -> bool:
+        with self._lock:
+            return self._ready_locked()
+
+    def _ready_locked(self) -> bool:
+        q = self._queue
+        if not q:
+            return False
+        if len(q) >= self.ladder.max_batch:
+            return True
+        return (self.clock() - q[0].t_submit) >= self.max_wait_s
+
+    def next_batch(self, flush: bool = False
+                   ) -> Optional[Tuple[Sequence[Pending], int]]:
+        """Pop one batch if the release policy allows; None otherwise.
+        Returns (pending items, bucket size >= len(items))."""
+        with self._lock:
+            if not self._queue:
+                return None
+            if not (flush or self._ready_locked()):
+                return None
+            take = min(len(self._queue), self.ladder.max_batch)
+            items = self._queue[:take]
+            del self._queue[:take]
+            return items, self.ladder.bucket_for(take)
+
+    def wait_for_work(self, timeout: Optional[float] = None) -> bool:
+        """Block until something is queued (background drain loops);
+        returns queue non-emptiness. Never used by synchronous paths."""
+        with self._cond:
+            if self._queue:
+                return True
+            self._cond.wait(timeout)
+            return bool(self._queue)

@@ -1,0 +1,246 @@
+"""The serving engine: admission, SLO ladder, dispatch, telemetry.
+
+Request lifecycle::
+
+    submit() ── depth > reject? ──> typed SLO_REJECTED response
+        │
+        ▼ queue (MicroBatcher)
+    pump() ── batch ready? ──> assemble (host pack, pad to bucket)
+        │                          │ depth > shed? fixed_only mode
+        ▼                          ▼
+    responses <── unpad <── compiled scorer (one dispatch per batch)
+
+Everything observable lands in the process metrics registry under the
+``serving.*`` namespace; ``stats()`` folds the registry snapshot plus
+compile-phase accounting into the dict that becomes the RunReport's
+``serving`` section and the BENCH_SERVING payload.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.obs.metrics import registry as _metrics
+from photon_tpu.serving.batching import BucketLadder, MicroBatcher, Pending
+from photon_tpu.serving.model_state import DeviceResidentModel
+from photon_tpu.serving.scorer import MODES, get_scorer, warmup_scorers
+from photon_tpu.serving.types import (
+    Fallback,
+    FallbackReason,
+    ScoreRequest,
+    ScoreResponse,
+    ServingConfig,
+)
+from photon_tpu.utils import compile_cache
+
+# serving latencies live well under the DEFAULT_BUCKETS floor (5ms);
+# ~1.3x geometric steps from 50us to ~5s keep the interpolated
+# p50/p95/p99 honest at sub-millisecond scale
+LATENCY_BUCKETS = tuple(50e-6 * 1.3 ** i for i in range(36))
+
+
+class ServingEngine:
+    """Online scorer over a device-resident GAME model."""
+
+    def __init__(self, model: DeviceResidentModel,
+                 config: Optional[ServingConfig] = None,
+                 clock=None):
+        self.model = model
+        self.config = config or ServingConfig()
+        self.ladder = BucketLadder(self.config.max_batch,
+                                   self.config.min_bucket)
+        self.batcher = MicroBatcher(self.ladder, self.config.max_wait_s,
+                                    clock=clock)
+        self.clock = self.batcher.clock
+        self._warmed = False
+        self._warmup_seconds = 0.0
+        self._warmup_programs = 0
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str,
+                       config: Optional[ServingConfig] = None,
+                       mesh=None, clock=None,
+                       coordinates_to_load=None) -> "ServingEngine":
+        from photon_tpu.io.model_io import load_for_serving
+
+        serving_model = load_for_serving(
+            model_dir, coordinates_to_load=coordinates_to_load)
+        model = DeviceResidentModel(serving_model, mesh=mesh,
+                                    feature_pad=(config.feature_pad
+                                                 if config else None))
+        return cls(model, config=config, clock=clock)
+
+    # -- warmup --------------------------------------------------------------
+
+    def warmup(self) -> dict:
+        """Compile-and-dispatch the whole (mode x bucket) ladder. After
+        this returns, steady-state serving performs zero compiles — the
+        contract ``scripts/check_serving_no_recompile.py`` enforces."""
+        t0 = time.perf_counter()
+        self._warmup_programs = warmup_scorers(self.model,
+                                               self.ladder.buckets)
+        self._warmup_seconds = time.perf_counter() - t0
+        self._warmed = True
+        _metrics.gauge("serving.warmup_seconds").set(self._warmup_seconds)
+        _metrics.gauge("serving.warmup_programs").set(self._warmup_programs)
+        return {"programs": self._warmup_programs,
+                "buckets": list(self.ladder.buckets),
+                "modes": list(MODES),
+                "seconds": self._warmup_seconds,
+                "compile_counts": compile_cache.compile_counts()}
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, request: ScoreRequest) -> Optional[ScoreResponse]:
+        """Admit one request. Returns an immediate typed rejection when
+        the queue is past the reject threshold, else None (the response
+        arrives from a later ``pump``)."""
+        _metrics.counter("serving.requests").inc()
+        depth = self.batcher.depth()
+        if depth >= self.config.slo.reject_queue_depth:
+            _metrics.counter("serving.degraded",
+                             reason=FallbackReason.SLO_REJECTED.value).inc()
+            return ScoreResponse(
+                request.uid, score=None, degraded=True,
+                fallbacks=(Fallback(FallbackReason.SLO_REJECTED,
+                                    detail=f"queue depth {depth}"),))
+        self.batcher.submit(request)
+        _metrics.gauge("serving.queue_depth").set(self.batcher.depth())
+        return None
+
+    # -- dispatch ------------------------------------------------------------
+
+    def pump(self, flush: bool = False) -> List[ScoreResponse]:
+        """Form and score at most one batch; [] when none is ready.
+        Drain loops call this repeatedly; ``flush`` overrides the
+        coalescing deadline (stream end / synchronous serve)."""
+        depth_before = self.batcher.depth()
+        popped = self.batcher.next_batch(flush=flush)
+        if popped is None:
+            return []
+        items, bucket = popped
+        shed = depth_before > self.config.slo.shed_queue_depth
+        t_start = self.clock()
+        responses = self._score_batch(items, bucket, shed, t_start)
+        _metrics.gauge("serving.queue_depth").set(self.batcher.depth())
+        return responses
+
+    def _score_batch(self, items: Sequence[Pending], bucket: int,
+                     shed: bool, t_start: float) -> List[ScoreResponse]:
+        requests = [p.request for p in items]
+        mode = "fixed_only" if shed else "full"
+
+        t0 = time.perf_counter()
+        args, fallbacks, counters = self.model.assemble(
+            requests, bucket, shed_random=shed)
+        t_assemble = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        scores = get_scorer(self.model, mode, bucket)(*args)
+        scores = np.asarray(scores)
+        t_score = time.perf_counter() - t0
+
+        if shed:
+            for fb in fallbacks:
+                fb.append(Fallback(FallbackReason.SLO_SHED_RANDOM_EFFECTS,
+                                   detail=f"batch mode {mode}"))
+
+        responses = []
+        for i, (pending, req) in enumerate(zip(items, requests)):
+            fbs = tuple(fallbacks[i])
+            responses.append(ScoreResponse(
+                req.uid, score=float(scores[i]),
+                degraded=bool(fbs), fallbacks=fbs))
+            # queue time from the injected clock (deterministic in tests);
+            # total = queue + host assemble + device score
+            q = max(t_start - pending.t_submit, 0.0)
+            _metrics.histogram("serving.latency_seconds", LATENCY_BUCKETS,
+                               stage="queue").observe(q)
+            _metrics.histogram("serving.latency_seconds", LATENCY_BUCKETS,
+                               stage="total").observe(q + t_assemble + t_score)
+
+        _metrics.counter("serving.responses").inc(len(responses))
+        _metrics.counter("serving.batches", bucket=str(bucket),
+                         mode=mode).inc()
+        _metrics.counter("serving.padded_rows").inc(counters["padded_rows"])
+        if counters["truncated_features"]:
+            _metrics.counter("serving.degraded",
+                             reason=FallbackReason.FEATURE_OVERFLOW.value
+                             ).inc(counters["truncated_features"])
+        if counters["unknown_entities"]:
+            _metrics.counter("serving.degraded",
+                             reason=FallbackReason.UNKNOWN_ENTITY.value
+                             ).inc(counters["unknown_entities"])
+        if shed:
+            _metrics.counter(
+                "serving.degraded",
+                reason=FallbackReason.SLO_SHED_RANDOM_EFFECTS.value
+                ).inc(len(responses))
+        _metrics.histogram("serving.latency_seconds", LATENCY_BUCKETS,
+                           stage="assemble").observe(t_assemble)
+        _metrics.histogram("serving.latency_seconds", LATENCY_BUCKETS,
+                           stage="score").observe(t_score)
+        return responses
+
+    # -- synchronous convenience --------------------------------------------
+
+    def serve(self, requests: Sequence[ScoreRequest]) -> List[ScoreResponse]:
+        """Score a request sequence synchronously, preserving input order.
+        Rejected requests still get (typed) responses."""
+        # FIFO queue per uid: duplicate uids stay well-defined because
+        # batches pop in submission order
+        by_uid: Dict[str, List[ScoreResponse]] = {}
+        for r in requests:
+            rejected = self.submit(r)
+            if rejected is not None:
+                by_uid.setdefault(r.uid, []).append(rejected)
+            while True:
+                got = self.pump(flush=self.batcher.depth()
+                                >= self.ladder.max_batch)
+                if not got:
+                    break
+                for resp in got:
+                    by_uid.setdefault(resp.uid, []).append(resp)
+        while self.batcher.depth():
+            for resp in self.pump(flush=True):
+                by_uid.setdefault(resp.uid, []).append(resp)
+        return [by_uid[r.uid].pop(0) for r in requests]
+
+    def drain(self) -> List[ScoreResponse]:
+        """Flush every queued request to completion (stream end)."""
+        out: List[ScoreResponse] = []
+        while self.batcher.depth():
+            out.extend(self.pump(flush=True))
+        return out
+
+    # -- reporting -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The serving section for RunReport / BENCH_SERVING: model shape,
+        ladder, compile-phase accounting, and the latency quantiles."""
+        snap = _metrics.snapshot()
+        latencies = {}
+        for key, h in snap["histograms"].items():
+            if key.startswith("serving.latency_seconds{"):
+                stage = key.split('stage="')[1].split('"')[0]
+                latencies[stage] = {
+                    k: h.get(k) for k in ("count", "sum", "p50", "p95", "p99")}
+        counters = {k: v for k, v in snap["counters"].items()
+                    if k.startswith("serving.")}
+        return {
+            "model": self.model.describe(),
+            "buckets": list(self.ladder.buckets),
+            "modes": list(MODES),
+            "warmed": self._warmed,
+            "warmup_seconds": self._warmup_seconds,
+            "warmup_programs": self._warmup_programs,
+            "compile_counts": compile_cache.compile_counts(),
+            "queue_depth": self.batcher.depth(),
+            "counters": counters,
+            "latency_seconds": latencies,
+            "slo": {"shed_queue_depth": self.config.slo.shed_queue_depth,
+                    "reject_queue_depth": self.config.slo.reject_queue_depth},
+        }

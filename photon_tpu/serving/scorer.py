@@ -1,0 +1,89 @@
+"""Compiled batch scorers for the serving engine.
+
+One jitted program per (model, mode, bucket): the program closes over the
+device-resident coefficient arrays (so they are baked into the executable
+and never re-shipped) and takes only the batch's padded feature arrays.
+The math is the offline ``game/scoring.GameScorer`` expressions verbatim
+— fixed effects as a gathered dot over padded (index, value) pairs,
+random effects as an entity-row gather followed by a slot-aligned dot —
+which is what makes serving-vs-offline parity exact rather than
+approximate.
+
+Programs are shared through ``utils/jitcache`` so every bucket compiles
+once per process; ``warmup_scorers`` dispatches each (mode, bucket)
+program on dummy inputs inside ``compile_cache.warmup`` so the full
+ladder is compiled at model-load time and steady-state traffic never
+traces.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from photon_tpu.serving.model_state import DeviceResidentModel
+from photon_tpu.utils import compile_cache, jitcache
+
+#: scoring modes; "fixed_only" is the SLO-shed ladder (random-effect
+#: gathers skipped) and is warmed alongside "full" so entering shed mode
+#: under load never triggers a compile
+MODES = ("full", "fixed_only")
+
+
+def get_scorer(model: DeviceResidentModel, mode: str,
+               bucket: int) -> Callable:
+    """Compiled scorer for one (model, mode, bucket); cached process-wide."""
+    if mode not in MODES:
+        raise ValueError(f"unknown serving mode {mode!r}")
+    key = ("serving_scorer", model.token, mode, int(bucket))
+
+    def builder():
+        import jax
+        import jax.numpy as jnp
+
+        dtype = model.dtype
+        shard_pos = {sid: i for i, sid in enumerate(model.shard_order)}
+        thetas = tuple(f.theta for f in model.fixed)
+        fixed_pos = tuple(shard_pos[f.feature_shard_id] for f in model.fixed)
+        coefs = tuple(r.coef for r in model.random)
+        with_random = mode == "full"
+
+        @jax.jit
+        def fn(fixed_idx, fixed_val, re_sidx, re_sval, re_ent, offsets):
+            total = offsets.astype(dtype)
+            for theta, pos in zip(thetas, fixed_pos):
+                # ops/features.matvec on the padded ELL layout: pad slots
+                # are (0, 0.0) so they contribute nothing
+                total = total + jnp.sum(
+                    fixed_val[pos].astype(dtype) * theta[fixed_idx[pos]],
+                    axis=-1)
+            if with_random:
+                for coef, sidx, sval, ent in zip(coefs, re_sidx, re_sval,
+                                                 re_ent):
+                    rows = coef.at[ent].get(mode="fill", fill_value=0.0)
+                    total = total + jnp.sum(
+                        sval.astype(dtype)
+                        * jnp.take_along_axis(rows, sidx, axis=1),
+                        axis=-1)
+            return total
+
+        return fn
+
+    return jitcache.get_or_build(key, builder)
+
+
+def warmup_scorers(model: DeviceResidentModel,
+                   buckets: Sequence[int]) -> int:
+    """Compile-and-dispatch every (mode, bucket) program under the warmup
+    phase flag. Returns the number of programs warmed."""
+    warmed = 0
+
+    def one_bucket(bucket):
+        nonlocal warmed
+        args = model.dummy_args(bucket)
+        for mode in MODES:
+            out = get_scorer(model, mode, bucket)(*args)
+            out.block_until_ready()
+            warmed += 1
+
+    compile_cache.warmup(buckets, one_bucket)
+    return warmed

@@ -1,0 +1,138 @@
+"""Typed requests, responses, and configuration for online serving.
+
+The reference has no online path (GameScoringDriver is batch-only); the
+shapes here follow the GLMix serving story: a request is one sample —
+per-shard (name, term, value) features plus the entity ids that select
+per-entity random-effect models — and a response is one score plus a
+*typed* account of every way the engine degraded it. Degradation is data,
+not an exception (resilience-subsystem convention: typed reasons that
+land in telemetry, never a stack trace on the hot path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, Optional, Sequence, Tuple
+
+
+class FallbackReason(str, enum.Enum):
+    """Why a score is degraded. String-valued: serializes verbatim into
+    JSONL responses, metrics labels, and the RunReport serving section."""
+
+    #: entity id absent from the model vocabulary (cold user/item) —
+    #: the coordinate contributes zero, matching the reference's
+    #: missing-per-entity-model semantics
+    UNKNOWN_ENTITY = "unknown_entity"
+    #: admission queue above the shed threshold: random-effect gathers
+    #: skipped for the whole batch, fixed-effect-only scores returned
+    SLO_SHED_RANDOM_EFFECTS = "slo_shed_random_effects"
+    #: admission queue above the reject threshold: request not scored
+    SLO_REJECTED = "slo_rejected"
+    #: request carried more features than the padded width for a shard;
+    #: overflow features dropped (first-N kept, deterministic)
+    FEATURE_OVERFLOW = "feature_overflow"
+
+
+@dataclasses.dataclass(frozen=True)
+class Fallback:
+    """One typed degradation event on one request."""
+
+    reason: FallbackReason
+    coordinate: Optional[str] = None
+    detail: str = ""
+
+    def to_json(self) -> dict:
+        out = {"reason": self.reason.value}
+        if self.coordinate:
+            out["coordinate"] = self.coordinate
+        if self.detail:
+            out["detail"] = self.detail
+        return out
+
+
+@dataclasses.dataclass
+class ScoreRequest:
+    """One sample to score.
+
+    ``features``: shard id -> sequence of (name, term, value);
+    ``entity_ids``: random-effect type -> entity id string.
+    """
+
+    uid: str
+    features: Dict[str, Sequence[Tuple[str, str, float]]]
+    entity_ids: Dict[str, str] = dataclasses.field(default_factory=dict)
+    offset: float = 0.0
+
+    @staticmethod
+    def from_json(obj: dict) -> "ScoreRequest":
+        feats = {
+            str(sid): [(str(f[0]), str(f[1]), float(f[2])) for f in rows]
+            for sid, rows in (obj.get("features") or {}).items()}
+        return ScoreRequest(
+            uid=str(obj.get("uid", "")),
+            features=feats,
+            entity_ids={str(k): str(v)
+                        for k, v in (obj.get("ids") or {}).items()},
+            offset=float(obj.get("offset", 0.0)))
+
+
+@dataclasses.dataclass
+class ScoreResponse:
+    """One scored (or shed) request. ``score`` is None only for
+    SLO_REJECTED; every other degradation still returns a usable score."""
+
+    uid: str
+    score: Optional[float]
+    degraded: bool = False
+    fallbacks: Tuple[Fallback, ...] = ()
+
+    def to_json(self) -> dict:
+        return {
+            "uid": self.uid,
+            "score": self.score,
+            "degraded": self.degraded,
+            "fallbacks": [f.to_json() for f in self.fallbacks],
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOConfig:
+    """Load-shedding thresholds on admission-queue depth.
+
+    Depth is the one signal that is both instantaneous and causal for
+    tail latency (every queued request ahead of you is latency you will
+    inherit), so the degradation ladder keys on it:
+
+      depth <= shed_queue_depth                 full GAME scoring
+      shed_queue_depth < depth <= reject_depth  fixed-effect-only batches
+      depth > reject_queue_depth                typed rejection at admission
+    """
+
+    shed_queue_depth: int = 512
+    reject_queue_depth: int = 4096
+
+    def __post_init__(self):
+        if self.shed_queue_depth < 1:
+            raise ValueError("shed_queue_depth must be >= 1")
+        if self.reject_queue_depth < self.shed_queue_depth:
+            raise ValueError("reject_queue_depth < shed_queue_depth")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Engine knobs. Every shape-bearing value here is part of the
+    compiled-program key: changing it after warmup would recompile, so
+    the config is frozen."""
+
+    #: top of the power-of-two bucket ladder (rounded up to a power of 2)
+    max_batch: int = 64
+    #: smallest bucket (1 keeps single-request latency honest)
+    min_bucket: int = 1
+    #: coalescing window: a batch forms when the ladder top fills OR the
+    #: oldest queued request has waited this long
+    max_wait_s: float = 0.002
+    #: per-shard padded feature width; None = smallest power of two
+    #: covering the shard dimension, capped at 256
+    feature_pad: Optional[int] = None
+    slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)

@@ -98,3 +98,60 @@ def maybe_enable() -> str | None:
 
 def is_enabled() -> bool:
     return _enabled
+
+
+# ---------------------------------------------------------------------------
+# warmup accounting (serving contract: zero steady-state compiles)
+# ---------------------------------------------------------------------------
+
+# compile-phase flag: builds that happen inside warmup() are expected and
+# budgeted at model-load time; any build outside is a steady-state compile
+# — for a serving process that is an SLO violation, and
+# scripts/check_serving_no_recompile.py fails on it.
+_warmup_depth = 0
+
+
+def in_warmup() -> bool:
+    return _warmup_depth > 0
+
+
+def record_compile(what: str = "program") -> None:
+    """Count one program build under the current phase. Called by the
+    jitcache on every build; serving asserts
+    ``compiles{phase="steady_state"}`` stays zero after warmup."""
+    phase = "warmup" if in_warmup() else "steady_state"
+    _metrics.counter("compile_cache.compiles", phase=phase, what=what).inc()
+
+
+def compile_counts() -> dict:
+    """{"warmup": n, "steady_state": m} across all ``what`` labels."""
+    out = {"warmup": 0.0, "steady_state": 0.0}
+    for key, val in _metrics.snapshot()["counters"].items():
+        if key.startswith("compile_cache.compiles{"):
+            for phase in out:
+                if f'phase="{phase}"' in key:
+                    out[phase] += val
+    return out
+
+
+def warmup(buckets, compile_fn) -> int:
+    """Pre-compile one program per bucket at model-load time.
+
+    ``compile_fn(bucket)`` must actually execute the jitted program for
+    that bucket (a dispatch on dummy inputs of the bucket's padded shape),
+    not just lower it — only a real call populates jit's executable cache
+    so steady-state traffic reuses it. Builds inside this call are counted
+    as ``compile_cache.compiles{phase="warmup"}``; everything after is
+    steady-state. Returns the number of buckets warmed. Reentrant (an
+    engine warming several coordinates nests safely).
+    """
+    global _warmup_depth
+    _warmup_depth += 1
+    try:
+        n = 0
+        for b in buckets:
+            compile_fn(b)
+            n += 1
+        return n
+    finally:
+        _warmup_depth -= 1

@@ -89,6 +89,10 @@ def get_or_build(key: tuple, builder: Callable[[], Callable]) -> Callable:
         _metrics.counter("jitcache.hits").inc()
         return fn
     _metrics.counter("jitcache.misses").inc()
+    # phase attribution: builds during serving warmup are budgeted, builds
+    # after it are steady-state compiles (a serving SLO violation)
+    from photon_tpu.utils import compile_cache as _cc
+    _cc.record_compile(what=str(key[0]) if key else "program")
     t0 = time.perf_counter()
     built = builder()
     dt = time.perf_counter() - t0

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Dynamic guard: steady-state serving performs ZERO compiles.
+
+The serving design (photon_tpu/serving) only works if the bucket ladder
+really closes the shape space: after ``ServingEngine.warmup()`` every
+(mode x bucket) program must already be compiled, so no steady-state
+request — any batch size, padded remainders, unknown entities, SLO shed
+mode — can trigger a trace or an XLA compile. A compile on the hot path
+is a multi-second latency cliff, which is exactly the failure mode this
+script exists to catch before it ships.
+
+The check is dynamic, not static: it builds a synthetic GAME model,
+warms the engine, then drives traffic covering
+
+  * every bucket in the ladder, full and partially filled (pad rows),
+  * unknown entities (fallback path),
+  * feature overflow (truncation path),
+  * SLO shed mode (fixed_only programs),
+
+and fails if any of three independent compile monitors moved:
+
+  1. ``compile_cache.compiles{phase="steady_state"}`` (jitcache builds),
+  2. ``jitcache.misses`` (new program cache entries),
+  3. per-program ``jax.jit`` ``_cache_size()`` (re-traces of an existing
+     program — the silent killer the first two cannot see).
+
+Wired into tier-1 via tests/test_serving.py; also runnable standalone::
+
+    JAX_PLATFORMS=cpu python scripts/check_serving_no_recompile.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_engine():
+    import numpy as np
+
+    from photon_tpu.io.index_map import IndexMapBuilder, feature_key
+    from photon_tpu.io.model_io import (
+        ServingFixedEffect,
+        ServingGameModel,
+        ServingRandomEffect,
+    )
+    from photon_tpu.serving import (
+        DeviceResidentModel,
+        ServingConfig,
+        ServingEngine,
+        SLOConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(7)
+    b = IndexMapBuilder()
+    names = [f"f{j}" for j in range(17)]          # odd, forces padding
+    for n in names:
+        b.put(feature_key(n, ""))
+    imap = b.build()
+    D = imap.feature_dimension
+    E, K = 5, 3
+    proj = np.full((E, K), -1, np.int32)
+    coef = np.zeros((E, K), np.float32)
+    for e in range(E):
+        cols = rng.choice(D, size=K, replace=False)
+        proj[e] = np.sort(cols)
+        coef[e] = rng.normal(size=K)
+    model = ServingGameModel(
+        list(TaskType)[0],
+        [ServingFixedEffect("global", "shardA",
+                            rng.normal(size=D).astype(np.float32))],
+        [ServingRandomEffect("per-user", "userId", "shardA", coef, proj,
+                             {f"u{e}": e for e in range(E)})],
+        {"shardA": imap}, {})
+    engine = ServingEngine(
+        DeviceResidentModel(model),
+        ServingConfig(max_batch=8, max_wait_s=0.0,
+                      slo=SLOConfig(shed_queue_depth=6,
+                                    reject_queue_depth=100)))
+    return engine, names
+
+
+def drive_traffic(engine, names):
+    import numpy as np
+
+    from photon_tpu.serving import ScoreRequest
+
+    rng = np.random.default_rng(11)
+
+    def req(uid, n_feats, user):
+        feats = [(str(names[j]), "", float(rng.normal()))
+                 for j in rng.choice(len(names), size=n_feats, replace=False)]
+        return ScoreRequest(uid, {"shardA": feats},
+                            {"userId": user} if user else {})
+
+    served = 0
+    # every batch size 1..max_batch: hits every bucket, full and partial
+    for n in range(1, engine.ladder.max_batch + 1):
+        reqs = [req(f"b{n}-{i}", int(rng.integers(0, len(names))),
+                    f"u{i % 7}" if i % 3 else "cold-entity")
+                for i in range(n)]
+        served += len(engine.serve(reqs))
+    # shed mode: flood past the shed threshold, then drain
+    for i in range(engine.config.slo.shed_queue_depth + 3):
+        engine.submit(req(f"s{i}", 4, f"u{i % 5}"))
+    served += len(engine.drain())
+    return served
+
+
+def main() -> int:
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.serving.scorer import MODES, get_scorer
+    from photon_tpu.utils import compile_cache
+
+    engine, names = build_engine()
+    info = engine.warmup()
+    if info["programs"] != len(engine.ladder.buckets) * len(MODES):
+        print(f"FAIL: warmed {info['programs']} programs, expected "
+              f"{len(engine.ladder.buckets) * len(MODES)}")
+        return 1
+
+    baseline = compile_cache.compile_counts()
+    misses0 = registry.counter("jitcache.misses").value
+    programs = [get_scorer(engine.model, mode, b)
+                for mode in MODES for b in engine.ladder.buckets]
+    # unwrap telemetry first-call timers to reach the jitted fn (a jit fn
+    # itself carries __wrapped__, so test for the jit API, don't unwrap
+    # unconditionally)
+    jitted = [p if hasattr(p, "_cache_size")
+              else getattr(p, "__wrapped__", p) for p in programs]
+    jitted = [f for f in jitted if hasattr(f, "_cache_size")]
+    traces0 = [f._cache_size() for f in jitted]
+
+    served = drive_traffic(engine, names)
+
+    after = compile_cache.compile_counts()
+    misses1 = registry.counter("jitcache.misses").value
+    traces1 = [f._cache_size() for f in jitted]
+
+    failures = []
+    if after["steady_state"] != baseline["steady_state"]:
+        failures.append(
+            f"compile_cache.compiles{{phase=steady_state}} moved: "
+            f"{baseline['steady_state']} -> {after['steady_state']}")
+    if misses1 != misses0:
+        failures.append(f"jitcache.misses moved: {misses0} -> {misses1}")
+    for i, (t0, t1) in enumerate(zip(traces0, traces1)):
+        if t1 > t0:
+            failures.append(f"program {i} re-traced: _cache_size "
+                            f"{t0} -> {t1}")
+    if failures:
+        print("FAIL: steady-state serving compiled:")
+        for f in failures:
+            print("  " + f)
+        return 1
+    print(f"ok: {served} responses over buckets {list(engine.ladder.buckets)}"
+          f" x modes {list(MODES)}, warmup compiles="
+          f"{int(after['warmup'])}, steady-state compiles=0")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,7 +106,7 @@ def test_owlqn_l1_logistic_vs_sklearn(rng):
     lam = 8.0
     res = owlqn.minimize(vg, jnp.zeros(D), l1_weight=lam,
                          config=SolverConfig(tolerance=1e-12, max_iterations=400))
-    sk = LogisticRegression(l1_ratio=1.0, C=1.0 / lam, solver="liblinear",
+    sk = LogisticRegression(penalty="l1", C=1.0 / lam, solver="liblinear",
                             fit_intercept=False, tol=1e-12, max_iter=5000)
     sk.fit(X, y)
     f = lambda c: float(obj.value(jnp.asarray(c), batch, Hyper.of(0.0, dtype=jnp.float64))
@@ -128,7 +128,7 @@ def test_owlqn_sparsity_path_vs_sklearn(rng):
     for lam, expect_nnz_below in [(60.0, None), (150.0, D // 2), (500.0, 1)]:
         res = owlqn.minimize(vg, jnp.zeros(D), l1_weight=lam,
                              config=SolverConfig(tolerance=1e-10, max_iterations=400))
-        sk = LogisticRegression(l1_ratio=1.0, C=1.0 / lam, solver="liblinear",
+        sk = LogisticRegression(penalty="l1", C=1.0 / lam, solver="liblinear",
                                 fit_intercept=False, tol=1e-13, max_iter=20000)
         sk.fit(X, y)
         ours = set(np.nonzero(np.asarray(res.coef))[0])

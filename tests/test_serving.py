@@ -1,0 +1,473 @@
+"""Online serving subsystem (photon_tpu/serving): parity, batching, SLO.
+
+The load-bearing assertions:
+
+  * serving-vs-offline parity: the engine's scores equal the offline
+    ``GameScorer``'s to <= 1e-6 for EVERY ladder bucket, including
+    padded-remainder batches and unknown-entity fallback rows;
+  * the micro-batcher's coalescing policy is exact under an injected
+    deterministic clock;
+  * the SLO ladder degrades typed (shed -> fixed-effect-only scores,
+    reject -> score=None), never raises;
+  * after warmup, steady-state serving performs zero compiles (wired to
+    ``scripts/check_serving_no_recompile.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from photon_tpu.game.dataset import EntityVocabulary, FeatureShard, GameDataFrame
+from photon_tpu.game.model import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    GeneralizedLinearModel,
+    RandomEffectModel,
+)
+from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+from photon_tpu.game.scoring import GameScorer
+from photon_tpu.io.index_map import IndexMap, feature_key
+from photon_tpu.io.model_io import (
+    load_for_serving,
+    load_game_model,
+    save_game_model,
+)
+from photon_tpu.serving import (
+    BucketLadder,
+    DeviceResidentModel,
+    FallbackReason,
+    MicroBatcher,
+    ScoreRequest,
+    ServingConfig,
+    ServingEngine,
+    SLOConfig,
+)
+from photon_tpu.types import TaskType
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+D_GLOBAL, D_USER = 8, 6
+N_USERS = 4
+
+
+# -- model + traffic fixture -------------------------------------------------
+
+
+def _build_model_dir(tmp_path):
+    """Save a GAME model (fixed + per-user random effect) in the
+    reference layout; return (dir, index maps, arrays for oracles)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(42)
+    im_g = IndexMap.from_keys([feature_key("g", str(j))
+                               for j in range(D_GLOBAL)])
+    im_u = IndexMap.from_keys([feature_key("u", str(j))
+                               for j in range(D_USER)])
+    theta = rng.normal(size=D_GLOBAL)
+
+    K = 3
+    proj = np.full((N_USERS, K), -1, np.int32)
+    coef = np.zeros((N_USERS, K))
+    for e in range(N_USERS):
+        cols = np.sort(rng.choice(D_USER, size=K, replace=False))
+        proj[e] = cols
+        coef[e] = rng.normal(size=K)
+    users = [f"user{e}" for e in range(N_USERS)]
+    vocab = EntityVocabulary()
+    vocab.build("userId", users)
+
+    model = GameModel({
+        "fixed": FixedEffectModel(
+            GeneralizedLinearModel(Coefficients(jnp.asarray(theta)),
+                                   TaskType.LOGISTIC_REGRESSION), "g"),
+        "per_user": RandomEffectModel(jnp.asarray(coef), "userId", "u",
+                                      TaskType.LOGISTIC_REGRESSION),
+    })
+    d = str(tmp_path / "model")
+    save_game_model(d, model, {"g": im_g, "u": im_u}, vocab=vocab,
+                    projections={"per_user": proj}, sparsity_threshold=0.0)
+    return d, {"g": im_g, "u": im_u}, vocab, users
+
+
+def _make_traffic(n, users, seed=7, unknown_every=5):
+    """n samples over both shards; every ``unknown_every``-th sample uses
+    an entity the model has never seen."""
+    rng = np.random.default_rng(seed)
+    samples = []
+    for i in range(n):
+        gf = [("g", str(j), float(rng.normal()))
+              for j in sorted(rng.choice(D_GLOBAL,
+                                         size=int(rng.integers(1, D_GLOBAL)),
+                                         replace=False))]
+        uf = [("u", str(j), float(rng.normal()))
+              for j in sorted(rng.choice(D_USER,
+                                         size=int(rng.integers(1, D_USER)),
+                                         replace=False))]
+        user = (f"cold{i}" if unknown_every and i % unknown_every == 0
+                else users[int(rng.integers(0, len(users)))])
+        samples.append({"uid": f"r{i}", "g": gf, "u": uf, "user": user,
+                        "offset": float(rng.normal() * 0.1)})
+    return samples
+
+
+def _offline_scores(model_dir, imaps, vocab, samples):
+    """The existing batch path: GameDataFrame -> GameScorer."""
+    n = len(samples)
+
+    def shard_rows(bag, imap):
+        rows = []
+        for s in samples:
+            cols = np.asarray([imap.index_of(nm, t) for nm, t, _ in s[bag]],
+                              np.int32)
+            vals = np.asarray([v for _, _, v in s[bag]])
+            rows.append((cols, vals))
+        return rows
+
+    df = GameDataFrame(
+        num_samples=n, response=np.zeros(n),
+        feature_shards={
+            "g": FeatureShard(shard_rows("g", imaps["g"]), D_GLOBAL),
+            "u": FeatureShard(shard_rows("u", imaps["u"]), D_USER)},
+        id_tags={"userId": [s["user"] for s in samples]})
+
+    loaded = load_game_model(model_dir, imaps)
+    scorer = GameScorer(n)
+    scorer.add_fixed_effect("fixed", df, "g")
+    scorer.add_random_effect("per_user", df,
+                             RandomEffectDataConfiguration("userId", "u"),
+                             vocab, loaded.projections["per_user"])
+    offsets = np.asarray([s["offset"] for s in samples], np.float32)
+    return np.asarray(scorer.score(loaded.model, offsets))
+
+
+def _requests(samples):
+    return [ScoreRequest(s["uid"], {"g": s["g"], "u": s["u"]},
+                         {"userId": s["user"]}, s["offset"])
+            for s in samples]
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """One engine, warmed, plus offline reference scores for 23 samples
+    (covers buckets 1..8 with full and remainder batches)."""
+    tmp_path = tmp_path_factory.mktemp("serving")
+    model_dir, imaps, vocab, users = _build_model_dir(tmp_path)
+    samples = _make_traffic(23, users)
+    offline = _offline_scores(model_dir, imaps, vocab, samples)
+
+    engine = ServingEngine.from_model_dir(
+        model_dir, config=ServingConfig(max_batch=8, max_wait_s=0.0))
+    info = engine.warmup()
+    return engine, samples, offline, info, model_dir
+
+
+# -- parity ------------------------------------------------------------------
+
+
+def test_parity_all_buckets_and_remainders(served):
+    """Every bucket size, full and partially filled: serving == offline
+    to <=1e-6. Group sizes 1..8 cover each ladder bucket both exactly
+    full (1, 2, 4, 8) and with padded remainder rows (3, 5, 6, 7)."""
+    engine, samples, offline, _, _ = served
+    reqs = _requests(samples)
+    pos = 0
+    for size in (1, 2, 3, 4, 5, 6, 7, 8):
+        chunk = reqs[pos:pos + size]
+        want = offline[pos:pos + size]
+        pos += size
+        if not chunk:
+            break
+        resps = engine.serve(chunk)
+        got = np.asarray([r.score for r in resps])
+        np.testing.assert_allclose(got, want[:len(chunk)], atol=1e-6,
+                                   err_msg=f"parity broke at batch size {size}")
+
+
+def test_parity_unknown_entity_rows(served):
+    """Unknown entities degrade to fixed-effect-only scores — which is
+    exactly what the offline scorer produces for unseen entities, so
+    parity holds AND the response carries the typed fallback."""
+    engine, samples, offline, _, _ = served
+    reqs = _requests(samples)
+    resps = engine.serve(reqs)
+    for s, resp, want in zip(samples, resps, offline):
+        assert resp.score == pytest.approx(float(want), abs=1e-6)
+        is_cold = s["user"].startswith("cold")
+        reasons = {f.reason for f in resp.fallbacks}
+        assert (FallbackReason.UNKNOWN_ENTITY in reasons) == is_cold
+        assert resp.degraded == is_cold
+
+
+def test_zero_steady_state_compiles_after_warmup(served):
+    """The core serving contract: the whole ladder is compiled at model
+    load; the traffic the other tests pushed compiled nothing."""
+    from photon_tpu.utils import compile_cache
+
+    engine, samples, _, info, _ = served
+    # both modes warmed over every bucket
+    assert info["programs"] == 2 * len(engine.ladder.buckets)
+    assert info["compile_counts"]["warmup"] >= info["programs"]
+
+    # delta-based: the counter is process-global and other tests in the
+    # session compile programs of their own
+    before = compile_cache.compile_counts()["steady_state"]
+    engine.serve(_requests(samples))
+    after = compile_cache.compile_counts()["steady_state"]
+    assert after == before
+
+
+def test_load_for_serving_matches_offline_load(served):
+    """The serving fast path (one pass, no variances, self-built compact
+    index space) scores identically to an engine fed the offline maps."""
+    engine, samples, offline, _, model_dir = served
+    model = load_for_serving(model_dir)
+    assert not model.index_maps.keys() - {"g", "u"}
+    eng2 = ServingEngine(
+        DeviceResidentModel(model),
+        ServingConfig(max_batch=4, max_wait_s=0.0))
+    eng2.warmup()
+    resps = eng2.serve(_requests(samples))
+    got = np.asarray([r.score for r in resps])
+    np.testing.assert_allclose(got, offline, atol=1e-6)
+
+
+# -- batching ----------------------------------------------------------------
+
+
+def test_bucket_ladder():
+    ladder = BucketLadder(max_batch=64, min_bucket=1)
+    assert ladder.buckets == (1, 2, 4, 8, 16, 32, 64)
+    assert ladder.bucket_for(1) == 1
+    assert ladder.bucket_for(3) == 4
+    assert ladder.bucket_for(64) == 64
+    assert ladder.bucket_for(1000) == 64          # caller caps the take
+    assert BucketLadder(max_batch=6, min_bucket=3).buckets == (4, 8)
+    with pytest.raises(ValueError):
+        ladder.bucket_for(0)
+    with pytest.raises(ValueError):
+        BucketLadder(max_batch=2, min_bucket=4)
+
+
+def test_microbatcher_deterministic_clock():
+    """Coalescing policy under a fake clock: nothing releases before the
+    deadline unless the ladder top fills; the deadline is measured from
+    the OLDEST queued request."""
+    now = [0.0]
+    batcher = MicroBatcher(BucketLadder(max_batch=4), max_wait_s=0.010,
+                           clock=lambda: now[0])
+
+    def req(uid):
+        return ScoreRequest(uid, {})
+
+    # one request: not ready until its deadline passes
+    batcher.submit(req("a"))
+    assert batcher.next_batch() is None
+    now[0] = 0.009
+    assert batcher.next_batch() is None
+    now[0] = 0.010
+    items, bucket = batcher.next_batch()
+    assert [p.request.uid for p in items] == ["a"] and bucket == 1
+
+    # deadline runs from the oldest request, not the newest
+    now[0] = 1.000
+    batcher.submit(req("b"))
+    now[0] = 1.008
+    batcher.submit(req("c"))
+    assert batcher.next_batch() is None
+    now[0] = 1.010                       # b is 10ms old, c only 2ms
+    items, bucket = batcher.next_batch()
+    assert [p.request.uid for p in items] == ["b", "c"] and bucket == 2
+
+    # a full ladder-top batch releases immediately, no deadline needed
+    now[0] = 2.000
+    for uid in "defg":
+        batcher.submit(req(uid))
+    items, bucket = batcher.next_batch()
+    assert len(items) == 4 and bucket == 4
+    assert batcher.depth() == 0
+
+    # flush overrides the deadline; remainder takes the smallest bucket
+    batcher.submit(req("h"))
+    batcher.submit(req("i"))
+    batcher.submit(req("j"))
+    assert batcher.next_batch() is None
+    items, bucket = batcher.next_batch(flush=True)
+    assert len(items) == 3 and bucket == 4        # padded remainder
+
+
+def test_feature_overflow_truncates_with_typed_fallback(served):
+    engine, _, _, _, model_dir = served
+    model = load_for_serving(model_dir)
+    eng = ServingEngine(DeviceResidentModel(model, feature_pad=2),
+                        ServingConfig(max_batch=2, max_wait_s=0.0,
+                                      feature_pad=2))
+    eng.warmup()
+    feats = [("g", str(j), 1.0) for j in range(5)]
+    [resp] = eng.serve([ScoreRequest("x", {"g": feats})])
+    assert resp.degraded
+    assert FallbackReason.FEATURE_OVERFLOW in {f.reason
+                                               for f in resp.fallbacks}
+    assert resp.score is not None
+
+
+# -- SLO degradation ---------------------------------------------------------
+
+
+def test_slo_shed_and_reject(served):
+    """Past the shed depth, batches run fixed-effect-only (typed fallback
+    on every row, still scored); past the reject depth, submit() returns
+    an immediate typed rejection with score=None."""
+    _, samples, _, _, model_dir = served
+    model = load_for_serving(model_dir)
+    eng = ServingEngine(
+        DeviceResidentModel(model),
+        ServingConfig(max_batch=4, max_wait_s=0.0,
+                      slo=SLOConfig(shed_queue_depth=2,
+                                    reject_queue_depth=6)))
+    eng.warmup()
+    reqs = _requests(samples)[:10]
+
+    rejected = []
+    for r in reqs:
+        resp = eng.submit(r)            # no pumping: queue depth climbs
+        if resp is not None:
+            rejected.append(resp)
+    assert len(rejected) == 4           # admits 6, rejects the rest
+    for resp in rejected:
+        assert resp.score is None and resp.degraded
+        assert resp.fallbacks[0].reason == FallbackReason.SLO_REJECTED
+
+    served_resps = eng.drain()
+    assert len(served_resps) == 6
+    shed = [r for r in served_resps
+            if FallbackReason.SLO_SHED_RANDOM_EFFECTS in
+            {f.reason for f in r.fallbacks}]
+    # depth was 6 > shed threshold 2 when the first batch formed
+    assert shed and all(r.score is not None for r in shed)
+
+    # fixed-only scores really exclude the random effect: compare against
+    # a fixed-effect-only oracle for one shed response
+    fixed_model = load_for_serving(model_dir, coordinates_to_load=["fixed"])
+    oracle = ServingEngine(DeviceResidentModel(fixed_model),
+                           ServingConfig(max_batch=1, max_wait_s=0.0))
+    oracle.warmup()
+    by_uid = {r.uid: r for r in served_resps}
+    for req in reqs[:3]:
+        if by_uid[req.uid] in shed:
+            [want] = oracle.serve([ScoreRequest(req.uid, {"g": req.features["g"]},
+                                                offset=req.offset)])
+            assert by_uid[req.uid].score == pytest.approx(want.score, abs=1e-6)
+
+
+# -- observability -----------------------------------------------------------
+
+
+def test_serving_metrics_and_stats(served):
+    from photon_tpu.utils import compile_cache
+
+    engine, samples, _, _, _ = served
+    before = compile_cache.compile_counts()["steady_state"]
+    engine.serve(_requests(samples))
+    stats = engine.stats()
+    assert stats["warmed"] is True
+    # delta-based: the compile counter is process-global
+    assert stats["compile_counts"]["steady_state"] == before
+    assert stats["counters"]["serving.requests"] >= len(samples)
+    lat = stats["latency_seconds"]
+    for stage in ("queue", "assemble", "score", "total"):
+        assert stage in lat, lat
+        assert lat[stage]["count"] > 0
+        assert lat[stage]["p50"] is not None
+        assert lat[stage]["p50"] <= lat[stage]["p95"] <= lat[stage]["p99"]
+    json.dumps(stats)                   # report-safe
+
+
+def test_runreport_gains_serving_section(served):
+    import photon_tpu.serving as serving_pkg
+    from photon_tpu.obs.report import build_run_report, validate_run_report
+
+    engine, samples, _, _, _ = served
+    engine.serve(_requests(samples))
+    serving_pkg.set_active_engine(engine)
+    try:
+        report = build_run_report("serve-test")
+        assert validate_run_report(report) == []
+        assert isinstance(
+            report["serving"]["compile_counts"]["steady_state"], float)
+        assert report["serving"]["buckets"] == list(engine.ladder.buckets)
+        assert "total" in report["serving"]["latency_seconds"]
+    finally:
+        serving_pkg.set_active_engine(None)
+
+
+def test_histogram_bucket_quantiles():
+    from photon_tpu.obs.metrics import MetricsRegistry, bucket_quantile
+
+    reg = MetricsRegistry()
+    h = reg.histogram("t.lat", buckets=(1.0, 2.0, 4.0))
+    assert h.quantile(0.5) is None      # empty
+    for v in (0.5, 1.5, 1.6, 3.0):
+        h.observe(v)
+    # p50 lands in the (1, 2] bucket, interpolated
+    assert 1.0 <= h.quantile(0.5) <= 2.0
+    assert h.quantile(0.99) <= 4.0
+    # +Inf bucket clamps to the last finite bound
+    assert bucket_quantile((1.0,), [0, 5], 0.99) == 1.0
+    snap = reg.snapshot()["histograms"]["t.lat"]
+    assert snap["p50"] == h.quantile(0.5)
+    assert snap["p95"] == h.quantile(0.95)
+
+
+# -- cli + tier-1 wiring -----------------------------------------------------
+
+
+def test_cli_serve_jsonl_roundtrip(served, tmp_path):
+    """python -m photon_tpu.cli.serve: JSONL in -> JSONL out, every uid
+    answered, scores match the offline reference."""
+    _, samples, offline, _, model_dir = served
+    lines = []
+    for s in samples:
+        lines.append(json.dumps({
+            "uid": s["uid"],
+            "features": {"g": [[n, t, v] for n, t, v in s["g"]],
+                         "u": [[n, t, v] for n, t, v in s["u"]]},
+            "ids": {"userId": s["user"]},
+            "offset": s["offset"]}))
+    lines.append("this is not json")    # malformed lines are skipped
+    stats_path = str(tmp_path / "stats.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "photon_tpu.cli.serve",
+         "--model-input-directory", model_dir,
+         "--max-batch", "4", "--max-wait-ms", "0",
+         "--stats-output", stats_path, "--log-level", "ERROR"],
+        input="\n".join(lines) + "\n", text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    out = [json.loads(l) for l in r.stdout.splitlines() if l.strip()]
+    by_uid = {o["uid"]: o for o in out}
+    assert len(by_uid) == len(samples)
+    for s, want in zip(samples, offline):
+        assert by_uid[s["uid"]]["score"] == pytest.approx(float(want),
+                                                          abs=1e-6)
+    stats = json.load(open(stats_path))
+    assert stats["compile_counts"]["steady_state"] == 0
+
+
+def test_no_recompile_script():
+    """Tier-1 wiring for scripts/check_serving_no_recompile.py: the
+    zero-steady-state-compiles contract, checked dynamically."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_serving_no_recompile.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout
+    assert "ok:" in r.stdout
