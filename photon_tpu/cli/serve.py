@@ -11,10 +11,27 @@ Request line schema::
     {"uid": "r1",
      "features": {"shardA": [["name", "term", 1.5], ...]},
      "ids": {"userId": "u17"},
-     "offset": 0.0}
+     "offset": 0.0,
+     "timeout_ms": 25}           # optional per-request deadline
 
-Response line schema: ``ScoreResponse.to_json()`` —
-``{"uid", "score", "degraded", "fallbacks": [{"reason", ...}]}``.
+Control lines (operator plane, same stream)::
+
+    {"control": "swap", "model_dir": "/path/to/candidate", "label": "v2"}
+    {"control": "drain"}
+
+A control line emits one ``{"control": ..., ...}`` response line instead
+of a score. Response line schema otherwise: ``ScoreResponse.to_json()``
+— ``{"uid", "score", "degraded", "fallbacks": [{"reason", ...}]}``.
+
+Lifecycle: stdin is consumed by a reader thread so the main loop keeps
+pumping batches (and noticing SIGTERM) while the pipe is quiet — a
+blocking ``readline`` would otherwise pin the process through a whole
+coalescing window and, worse, never observe a shutdown request (PEP 475
+retries the read after the handler returns). SIGTERM/SIGINT flips the
+engine to draining via the resilience shutdown flag: queued work is
+flushed within ``--drain-budget-s``, later lines get typed
+SHUTTING_DOWN refusals, stats/RunReport are written, and the process
+exits 0.
 
 Usage::
 
@@ -28,10 +45,18 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
+import queue
 import sys
+import threading
 from typing import Optional
 
 logger = logging.getLogger("photon_tpu.serve")
+
+#: main-loop tick while the stdin queue is quiet: long enough to idle
+#: cheaply, short enough that coalescing deadlines and drain flags are
+#: noticed promptly
+_TICK_S = 0.05
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -49,6 +74,29 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="per-shard padded feature width (default: auto)")
     p.add_argument("--shed-queue-depth", type=int, default=512)
     p.add_argument("--reject-queue-depth", type=int, default=4096)
+    p.add_argument("--default-timeout-ms", type=float, default=None,
+                   help="deadline for requests that carry no timeout_ms "
+                        "(default: no deadline)")
+    p.add_argument("--min-service-ms", type=float, default=0.0,
+                   help="refuse budgets below this at admission")
+    p.add_argument("--score-headroom-ms", type=float, default=0.0,
+                   help="assemble+score time reserved when expiring "
+                        "queued requests")
+    p.add_argument("--breaker-latency-p99-ms", type=float, default=None,
+                   help="scorer-stage p99 trip threshold "
+                        "(default: latency trip disabled)")
+    p.add_argument("--breaker-failure-rate", type=float, default=0.5)
+    p.add_argument("--breaker-cooldown-s", type=float, default=1.0)
+    p.add_argument("--drain-budget-s", type=float,
+                   default=float(os.environ.get(
+                       "PHOTON_TPU_DRAIN_BUDGET_S", "5.0")),
+                   help="max seconds spent flushing queued work after "
+                        "SIGTERM (env PHOTON_TPU_DRAIN_BUDGET_S)")
+    p.add_argument("--swap-max-deviation", type=float, default=None,
+                   help="reject a swap candidate whose shadow scores "
+                        "deviate more than this (default: finite-only)")
+    p.add_argument("--swap-require-manifest", action="store_true",
+                   help="refuse swap candidates without swap-manifest.json")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip ladder pre-compilation (debugging only; "
                         "steady-state requests will compile)")
@@ -61,7 +109,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
 
 def build_engine(args: argparse.Namespace):
-    from photon_tpu.serving import ServingConfig, ServingEngine, SLOConfig
+    from photon_tpu.serving import (
+        BreakerConfig,
+        DeadlineConfig,
+        ServingConfig,
+        ServingEngine,
+        SLOConfig,
+        SwapConfig,
+    )
     from photon_tpu.utils import compile_cache
 
     compile_cache.maybe_enable()
@@ -70,7 +125,25 @@ def build_engine(args: argparse.Namespace):
         max_wait_s=args.max_wait_ms / 1000.0,
         feature_pad=args.feature_pad,
         slo=SLOConfig(shed_queue_depth=args.shed_queue_depth,
-                      reject_queue_depth=args.reject_queue_depth))
+                      reject_queue_depth=args.reject_queue_depth),
+        deadline=DeadlineConfig(
+            default_timeout_s=(args.default_timeout_ms / 1000.0
+                               if args.default_timeout_ms is not None
+                               else None),
+            min_service_s=args.min_service_ms / 1000.0,
+            score_headroom_s=args.score_headroom_ms / 1000.0),
+        breaker=BreakerConfig(
+            latency_p99_s=(args.breaker_latency_p99_ms / 1000.0
+                           if args.breaker_latency_p99_ms is not None
+                           else float("inf")),
+            failure_rate=args.breaker_failure_rate,
+            cooldown_s=args.breaker_cooldown_s),
+        swap=SwapConfig(
+            max_shadow_deviation=(args.swap_max_deviation
+                                  if args.swap_max_deviation is not None
+                                  else float("inf")),
+            require_manifest=args.swap_require_manifest),
+        drain_budget_s=args.drain_budget_s)
     engine = ServingEngine.from_model_dir(
         args.model_input_directory, config=config,
         coordinates_to_load=args.coordinates)
@@ -81,41 +154,143 @@ def build_engine(args: argparse.Namespace):
     return engine
 
 
+def _start_reader(stdin) -> "queue.Queue":
+    """Feed stdin lines into a queue from a daemon thread; None = EOF.
+    The main loop never blocks on the pipe, so signals and coalescing
+    deadlines are handled even when no requests arrive."""
+    lines: "queue.Queue" = queue.Queue()
+
+    def _read():
+        try:
+            for line in stdin:
+                lines.put(line)
+        except ValueError:
+            pass  # hygiene-ok: stdin closed mid-read during interpreter exit
+        lines.put(None)
+
+    threading.Thread(target=_read, name="serve-stdin-reader",
+                     daemon=True).start()
+    return lines
+
+
+def _handle_control(engine, obj: dict) -> dict:
+    """Operator control line -> one response dict."""
+    from photon_tpu.serving import swap_from_dir
+
+    cmd = obj.get("control")
+    if cmd == "swap":
+        model_dir = obj.get("model_dir")
+        if not model_dir:
+            return {"control": "swap", "ok": False,
+                    "error": "missing model_dir"}
+        result = swap_from_dir(engine, str(model_dir),
+                               label=obj.get("label"))
+        out = {"control": "swap", "ok": result.accepted}
+        out.update(result.to_json())
+        return out
+    if cmd == "drain":
+        engine.begin_drain("operator drain control line")
+        return {"control": "drain", "ok": True}
+    return {"control": cmd, "ok": False, "error": f"unknown control {cmd!r}"}
+
+
 def run(args: argparse.Namespace,
         stdin=None, stdout=None) -> int:
     logging.basicConfig(
         level=args.log_level, stream=sys.stderr,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
     import photon_tpu.serving as serving_pkg
+    from photon_tpu.resilience import shutdown
 
     stdin = stdin if stdin is not None else sys.stdin
     stdout = stdout if stdout is not None else sys.stdout
     engine = build_engine(args)
     serving_pkg.set_active_engine(engine)
+    shutdown.install()
+
+    def _on_shutdown(reason: str) -> None:
+        engine.begin_drain(reason)
+
+    shutdown.add_callback(_on_shutdown)
 
     def emit(resp):
         stdout.write(json.dumps(resp.to_json()) + "\n")
 
+    lines = _start_reader(stdin)
     bad_lines = 0
-    for line in stdin:
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            req = serving_pkg.ScoreRequest.from_json(json.loads(line))
-        except (ValueError, KeyError, TypeError) as e:
-            bad_lines += 1
-            logger.warning("bad request line skipped: %r", e)
-            continue
-        rejected = engine.submit(req)
-        if rejected is not None:
-            emit(rejected)
-        for resp in engine.pump():
-            emit(resp)
-    # stream end: flush the remainder (padded partial batches)
-    for resp in engine.drain():
-        emit(resp)
-    stdout.flush()
+    eof = False
+    try:
+        while not eof and not engine.draining:
+            try:
+                line = lines.get(timeout=_TICK_S)
+            except queue.Empty:
+                # idle tick: coalescing deadlines still fire without new
+                # input, so partially-filled buckets never starve
+                for resp in engine.pump():
+                    emit(resp)
+                stdout.flush()
+                continue
+            if line is None:
+                eof = True
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                bad_lines += 1
+                logger.warning("bad request line skipped: %r", e)
+                continue
+            if isinstance(obj, dict) and "control" in obj:
+                stdout.write(json.dumps(_handle_control(engine, obj)) + "\n")
+                stdout.flush()
+                continue
+            try:
+                req = serving_pkg.ScoreRequest.from_json(obj)
+            except (ValueError, KeyError, TypeError) as e:
+                bad_lines += 1
+                logger.warning("bad request line skipped: %r", e)
+                continue
+            rejected = engine.submit(req)
+            if rejected is not None:
+                emit(rejected)
+            for resp in engine.pump():
+                emit(resp)
+
+        if engine.draining:
+            # drain: flush in-flight work within the budget, then refuse
+            # the remainder AND any lines still buffered — every request
+            # gets a typed SHUTTING_DOWN response, never a dropped line
+            for resp in engine.shutdown(args.drain_budget_s):
+                emit(resp)
+            while True:
+                try:
+                    line = lines.get_nowait()
+                except queue.Empty:
+                    break
+                if line is None or not line.strip():
+                    continue
+                try:
+                    obj = json.loads(line)
+                    if isinstance(obj, dict) and "control" in obj:
+                        continue
+                    req = serving_pkg.ScoreRequest.from_json(obj)
+                except (ValueError, KeyError, TypeError):
+                    bad_lines += 1
+                    continue
+                refused = engine.submit(req)   # draining: typed refusal
+                if refused is not None:
+                    emit(refused)
+            logger.info("drained: %s", engine.stats().get("drain"))
+        else:
+            # stream end: flush the remainder (padded partial batches)
+            for resp in engine.drain():
+                emit(resp)
+    finally:
+        stdout.flush()
+        shutdown.remove_callback(_on_shutdown)
+        shutdown.uninstall()
 
     if args.stats_output:
         with open(args.stats_output, "w") as f:

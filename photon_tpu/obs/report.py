@@ -213,4 +213,14 @@ def validate_run_report(report: Dict[str, Any]) -> List[str]:
                       "latency_seconds"):
                 if k not in serving:
                     errors.append(f"serving missing {k!r}")
+            if "swap" in serving:  # optional: engines with swap support
+                swap = serving["swap"]
+                if not isinstance(swap, dict):
+                    errors.append("serving.swap must be a dict")
+                else:
+                    for k in ("version", "history"):
+                        if k not in swap:
+                            errors.append(f"serving.swap missing {k!r}")
+                    if not isinstance(swap.get("history", []), list):
+                        errors.append("serving.swap history must be a list")
     return errors

@@ -16,6 +16,16 @@ hook points consult it:
   between tmp-write and rename; a hit raises ``SimulatedKill``, which
   deliberately bypasses tmp cleanup so the partial state stays on disk
   exactly as a real SIGKILL would leave it.
+- ``scorer_delay()`` — serving/engine.py asks inside the scorer stage;
+  returns seconds to sleep for the first ``scorer_delay_batches``
+  batches, driving the serving circuit breaker's latency trip.
+- ``should_poison_swap_candidate()`` — serving/swap.py asks after
+  loading a candidate model; a hit NaN-poisons one coefficient table so
+  the swap's finite/shadow gates must reject it.
+- ``corrupt_model_dir(path, seed)`` — deterministic torn-directory
+  helper: truncates one file (chosen by seed) to half its bytes, the
+  on-disk shape a kill mid-copy leaves behind; the swap's crc32
+  manifest gate must refuse the directory.
 
 Everything is counter-based off the installed config — two runs with the
 same config and workload inject identically. ``seed`` feeds the optional
@@ -56,6 +66,12 @@ class ChaosConfig:
     kill_publish_ops: Tuple[str, ...] = ()
     # number of successful publishes of a matching op before the kill
     kill_publish_after: int = 0
+    # serving: seconds of artificial scorer-stage delay, applied to the
+    # first scorer_delay_batches scored batches (then off)
+    scorer_delay_s: float = 0.0
+    scorer_delay_batches: int = 0
+    # serving: NaN-poison the next loaded swap candidate's coefficients
+    swap_poison_nan: bool = False
 
 
 class _State:
@@ -67,6 +83,7 @@ class _State:
         self.publishes_seen = 0
         self.kill_fired = False
         self.preempt_fired = False
+        self.scorer_delays_done = 0
 
 
 _active: Optional[_State] = None
@@ -135,6 +152,46 @@ def maybe_preempt(sweep: int, coordinate: str) -> None:
     from photon_tpu.resilience import shutdown
     shutdown.request(f"chaos preemption at sweep {sweep}, "
                      f"coordinate {coordinate!r}")
+
+
+def scorer_delay() -> float:
+    """Seconds of injected scorer-stage latency for this batch (0 when
+    inactive or the batch budget is spent). The delay is real wall time —
+    the breaker's latency window sees genuine measured seconds."""
+    s = _active
+    if s is None or s.config.scorer_delay_s <= 0:
+        return 0.0
+    with s.lock:
+        if s.scorer_delays_done >= s.config.scorer_delay_batches:
+            return 0.0
+        s.scorer_delays_done += 1
+    return s.config.scorer_delay_s
+
+
+def should_poison_swap_candidate() -> bool:
+    s = _active
+    return s is not None and s.config.swap_poison_nan
+
+
+def corrupt_model_dir(path: str, seed: int = 0) -> str:
+    """Deterministically tear one file under ``path``: truncate it to half
+    its bytes (what a kill mid-copy leaves). The victim is chosen by
+    crc32(seed) over the sorted file list, so two runs with the same seed
+    corrupt the same file. Returns the corrupted file's path."""
+    import os
+
+    files = []
+    for root, _dirs, names in os.walk(path):
+        for name in sorted(names):
+            files.append(os.path.join(root, name))
+    files.sort()
+    if not files:
+        raise ValueError(f"no files to corrupt under {path!r}")
+    victim = files[zlib.crc32(str(seed).encode()) % len(files)]
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.truncate(size // 2)
+    return victim
 
 
 def at_publish(op: str) -> None:

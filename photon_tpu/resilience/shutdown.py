@@ -22,17 +22,49 @@ _lock = threading.Lock()
 _requested = False
 _reason: Optional[str] = None
 _previous: dict = {}
+_callbacks: list = []
+
+
+def add_callback(fn) -> None:
+    """Register ``fn(reason)`` to run when shutdown is requested (e.g. a
+    serving engine's ``begin_drain``). Callbacks may run inside a signal
+    handler: they must be lock-free flag flips, never heavy work. A
+    callback added after the request fires immediately."""
+    fire_now = False
+    with _lock:
+        if fn not in _callbacks:
+            _callbacks.append(fn)
+        fire_now = _requested
+    if fire_now:
+        _run_callback(fn, _reason or "requested")
+
+
+def remove_callback(fn) -> None:
+    with _lock:
+        if fn in _callbacks:
+            _callbacks.remove(fn)
+
+
+def _run_callback(fn, reason: str) -> None:
+    try:
+        fn(reason)
+    except Exception:  # a broken callback must not break the shutdown path
+        logger.exception("shutdown callback %r failed", fn)
 
 
 def request(reason: str = "requested") -> None:
     """Flip the stop flag (signal handler, chaos harness, or embedder)."""
     global _requested, _reason
+    to_fire = []
     with _lock:
         if not _requested:
             _requested = True
             _reason = reason
+            to_fire = list(_callbacks)
             logger.warning("graceful shutdown requested (%s); stopping at "
                            "the next coordinate boundary", reason)
+    for fn in to_fire:
+        _run_callback(fn, reason)
 
 
 def requested() -> bool:

@@ -11,37 +11,77 @@ serves single requests at low latency. Design contract (ISSUE 5):
     compiles (checked by ``scripts/check_serving_no_recompile.py``);
   * overload degrades through a typed ladder (full -> fixed-effect-only
     -> rejection), never an exception on the hot path.
+
+The resilience layer (ISSUE 6) extends the contract under fault and
+change:
+
+  * every request can carry a deadline, enforced at admission and at the
+    queue->score boundary (typed DEADLINE_EXCEEDED, never a late score);
+  * a sliding-window :class:`CircuitBreaker` sheds to fixed-effect-only
+    and then rejects when the scorer stage goes slow or faulty, with
+    half-open probing to recover;
+  * SIGTERM drains gracefully: typed SHUTTING_DOWN refusals at
+    admission, in-flight micro-batches flushed within a drain budget;
+  * live model swap (serving/swap.py) validates a candidate behind a
+    gate ladder (crc manifest, finiteness, shadow parity, zero
+    steady-state compiles) and publishes atomically between
+    micro-batches, with automatic rollback on a post-swap breaker trip.
 """
 
-from photon_tpu.serving.batching import BucketLadder, MicroBatcher
+from photon_tpu.serving.batching import (
+    BucketLadder,
+    MicroBatcher,
+    QueueClosedError,
+)
+from photon_tpu.serving.breaker import CircuitBreaker
 from photon_tpu.serving.engine import LATENCY_BUCKETS, ServingEngine
 from photon_tpu.serving.model_state import DeviceResidentModel
 from photon_tpu.serving.scorer import MODES, get_scorer, warmup_scorers
+from photon_tpu.serving.swap import (
+    SwapResult,
+    swap_from_dir,
+    swap_staged,
+    verify_swap_manifest,
+    write_swap_manifest,
+)
 from photon_tpu.serving.types import (
+    BreakerConfig,
+    DeadlineConfig,
     Fallback,
     FallbackReason,
     ScoreRequest,
     ScoreResponse,
     ServingConfig,
     SLOConfig,
+    SwapConfig,
 )
 
 __all__ = [
+    "BreakerConfig",
     "BucketLadder",
+    "CircuitBreaker",
+    "DeadlineConfig",
     "DeviceResidentModel",
     "Fallback",
     "FallbackReason",
     "LATENCY_BUCKETS",
     "MODES",
     "MicroBatcher",
+    "QueueClosedError",
     "ScoreRequest",
     "ScoreResponse",
     "ServingConfig",
     "ServingEngine",
     "SLOConfig",
+    "SwapConfig",
+    "SwapResult",
     "get_scorer",
     "serving_report_section",
+    "swap_from_dir",
+    "swap_staged",
+    "verify_swap_manifest",
     "warmup_scorers",
+    "write_swap_manifest",
 ]
 
 # the engine the RunReport describes; a process normally runs one engine,

@@ -56,36 +56,66 @@ class BucketLadder:
         return self.max_batch
 
 
+class QueueClosedError(RuntimeError):
+    """Submit after ``close()`` — the engine is draining. Library callers
+    get this typed error; the engine turns it into a SHUTTING_DOWN
+    response so the hot path never leaks an exception to clients."""
+
+
 class Pending(NamedTuple):
     request: ScoreRequest
     t_submit: float
+    #: absolute deadline on the batcher clock; None = never expires
+    deadline: Optional[float] = None
 
 
 class MicroBatcher:
     """Thread-safe admission queue with deadline-based coalescing.
 
-    A batch is released when either (a) the queue holds a full ladder-top
-    batch, or (b) the OLDEST queued request has waited ``max_wait_s``
-    (then everything pending ships in the smallest covering bucket —
-    the padded-remainder case). ``flush=True`` overrides the deadline,
-    used at stream end and by synchronous ``serve()``.
+    A batch is released when (a) the queue holds a full ladder-top batch,
+    (b) the OLDEST queued request has waited ``max_wait_s`` (then
+    everything pending ships in the smallest covering bucket — the
+    padded-remainder case), or (c) a queued request's absolute deadline
+    is close enough that waiting any longer would leave it less than
+    ``deadline_headroom_s`` to assemble+score — the oldest-waiter wait
+    never overrides a tighter per-request deadline. ``flush=True``
+    overrides all of it, used at stream end and by synchronous
+    ``serve()``.
     """
 
     def __init__(self, ladder: BucketLadder, max_wait_s: float = 0.002,
-                 clock: Optional[Callable[[], float]] = None):
+                 clock: Optional[Callable[[], float]] = None,
+                 deadline_headroom_s: float = 0.0):
         import time
 
         self.ladder = ladder
         self.max_wait_s = float(max_wait_s)
+        self.deadline_headroom_s = float(deadline_headroom_s)
         self.clock = clock if clock is not None else time.monotonic
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: List[Pending] = []
+        # set lock-free: close() may run inside a signal handler that
+        # interrupted a thread already holding _lock (a non-reentrant
+        # acquire there would deadlock the main thread)
+        self._closed = False
 
-    def submit(self, request: ScoreRequest) -> None:
+    def submit(self, request: ScoreRequest,
+               deadline: Optional[float] = None) -> None:
+        if self._closed:
+            raise QueueClosedError("admission queue closed (draining)")
         with self._cond:
-            self._queue.append(Pending(request, self.clock()))
+            self._queue.append(Pending(request, self.clock(), deadline))
             self._cond.notify()
+
+    def close(self) -> None:
+        """Stop admission (drain). Lock-free on purpose — safe to call
+        from a signal handler; queued work remains poppable."""
+        self._closed = True
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
     def depth(self) -> int:
         with self._lock:
@@ -107,7 +137,18 @@ class MicroBatcher:
             return False
         if len(q) >= self.ladder.max_batch:
             return True
-        return (self.clock() - q[0].t_submit) >= self.max_wait_s
+        now = self.clock()
+        if (now - q[0].t_submit) >= self.max_wait_s:
+            return True
+        # per-request deadlines can be tighter than the oldest-waiter
+        # wait: release as soon as the tightest deadline has only the
+        # score headroom left (popping exactly at the threshold keeps the
+        # request servable — expiry in the engine is strict '>')
+        for p in q:
+            if (p.deadline is not None
+                    and now >= p.deadline - self.deadline_headroom_s):
+                return True
+        return False
 
     def next_batch(self, flush: bool = False
                    ) -> Optional[Tuple[Sequence[Pending], int]]:
@@ -123,11 +164,21 @@ class MicroBatcher:
             del self._queue[:take]
             return items, self.ladder.bucket_for(take)
 
+    def pop_all(self) -> List[Pending]:
+        """Take everything still queued (drain-budget exhaustion: the
+        engine refuses these with typed SHUTTING_DOWN responses)."""
+        with self._lock:
+            items = self._queue[:]
+            self._queue.clear()
+            return items
+
     def wait_for_work(self, timeout: Optional[float] = None) -> bool:
         """Block until something is queued (background drain loops);
         returns queue non-emptiness. Never used by synchronous paths."""
         with self._cond:
             if self._queue:
                 return True
+            if self._closed:
+                return False
             self._cond.wait(timeout)
             return bool(self._queue)

@@ -1,0 +1,316 @@
+"""Validated live model swap with automatic rollback.
+
+Replacing the model under live traffic is the serving half of the
+nearline story: a retrained GAME model lands in a directory, and the
+engine must start scoring with it without dropping requests, recompiling
+on the hot path, or trusting it blindly. Every candidate runs a gate
+ladder; the first failing gate rejects the swap and the live model keeps
+serving, untouched::
+
+    integrity   swap-manifest.json per-file crc32 (torn/corrupt copy)
+    load        load_for_serving parses (schema errors, bad Avro)
+    finite      every coefficient table is finite on the host (NaN/inf
+                poison caught with zero traffic dependence)
+    staging     DeviceResidentModel built + full (mode x bucket) ladder
+                warmed — compiles happen HERE, tagged phase="warmup"
+    shadow      the engine's captured recent requests scored through
+                live and candidate; max abs deviation must stay within
+                ``SwapConfig.max_shadow_deviation``
+    compiles    zero steady-state compiles across staging + shadow
+                (the no-recompile contract extends over swaps)
+
+Only then does :meth:`ServingEngine.publish_model` install the candidate
+— an attribute swap under the model lock, landing exactly between
+micro-batches. The prior model object (and its compiled programs) is
+retained; rollback is a pointer restore, so the restored tables are
+bitwise-identical. Post-publish, the engine watches the circuit breaker
+for ``SwapConfig.probation_s`` and rolls back automatically on a trip.
+
+Every attempt lands in ``engine.swap_history`` (gate outcomes, shadow
+stats), the ``serving.swap_*`` counters, and the RunReport ``swap``
+section.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from photon_tpu.obs.metrics import registry as _metrics
+from photon_tpu.resilience import chaos as _chaos
+from photon_tpu.resilience import io as rio
+from photon_tpu.resilience.failures import record_failure
+from photon_tpu.serving.engine import ServingEngine
+from photon_tpu.serving.model_state import DeviceResidentModel
+from photon_tpu.serving.scorer import get_scorer, warmup_scorers
+from photon_tpu.utils import compile_cache
+
+MANIFEST_FILE = "swap-manifest.json"
+MANIFEST_SCHEMA = "photon_tpu.swapmanifest.v1"
+
+#: shadow |live - candidate| deviation histogram (log-spaced around the
+#: parity scales that matter: fp32 epsilon up to order-1 disagreement)
+DEVIATION_BUCKETS = tuple(1e-9 * 10 ** (0.5 * i) for i in range(20))
+
+
+@dataclasses.dataclass
+class SwapResult:
+    """Outcome of one swap attempt."""
+
+    accepted: bool
+    label: str
+    #: live version after the attempt (new version when accepted)
+    version: int
+    #: gate name -> "pass" | "fail" | "skip"
+    gates: Dict[str, str]
+    #: first failing gate's human-readable reason (empty when accepted)
+    reason: str = ""
+    #: shadow stats: requests compared, max abs deviation
+    shadow_requests: int = 0
+    shadow_max_deviation: Optional[float] = None
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# -- integrity manifest ------------------------------------------------------
+
+
+def write_swap_manifest(model_dir: str) -> str:
+    """Stamp ``model_dir`` with per-file crc32 checksums (the checkpoint
+    schema-v2 discipline applied to the exported model layout). The
+    trainer/exporter calls this last, after every model file is final."""
+    checksums: Dict[str, int] = {}
+    for root, _dirs, names in os.walk(model_dir):
+        for name in sorted(names):
+            if name == MANIFEST_FILE:
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, model_dir)
+            with open(path, "rb") as f:
+                checksums[rel] = zlib.crc32(f.read())
+    doc = {"schema": MANIFEST_SCHEMA, "files": checksums}
+    path = os.path.join(model_dir, MANIFEST_FILE)
+    rio.atomic_write_bytes(path, json.dumps(doc, indent=2).encode("utf-8"),
+                           op="swap_manifest")
+    return path
+
+
+def verify_swap_manifest(model_dir: str) -> Dict[str, object]:
+    """Check ``model_dir`` against its manifest. Returns
+    ``{"present": bool, "ok": bool, "detail": str}`` — a missing manifest
+    is ``present=False, ok=True`` (the require_manifest knob decides
+    whether that refuses the swap)."""
+    path = os.path.join(model_dir, MANIFEST_FILE)
+    if not os.path.exists(path):
+        return {"present": False, "ok": True, "detail": "no manifest"}
+    try:
+        with open(path, "rb") as f:
+            doc = json.loads(f.read().decode("utf-8"))
+        if doc.get("schema") != MANIFEST_SCHEMA:
+            return {"present": True, "ok": False,
+                    "detail": f"unknown manifest schema {doc.get('schema')!r}"}
+        files = doc["files"]
+    except (OSError, ValueError, KeyError) as e:
+        return {"present": True, "ok": False,
+                "detail": f"unreadable manifest: {e!r}"}
+    for rel, want in sorted(files.items()):
+        path = os.path.join(model_dir, rel)
+        try:
+            with open(path, "rb") as f:
+                got = zlib.crc32(f.read())
+        except OSError as e:
+            return {"present": True, "ok": False,
+                    "detail": f"missing file {rel!r}: {e!r}"}
+        if got != int(want):
+            return {"present": True, "ok": False,
+                    "detail": f"crc mismatch on {rel!r}: "
+                              f"{got:#010x} != {int(want):#010x}"}
+    # files on disk but not in the manifest are torn-copy evidence too
+    for root, _dirs, names in os.walk(model_dir):
+        for name in names:
+            if name == MANIFEST_FILE:
+                continue
+            rel = os.path.relpath(os.path.join(root, name), model_dir)
+            if rel not in files:
+                return {"present": True, "ok": False,
+                        "detail": f"unmanifested file {rel!r}"}
+    return {"present": True, "ok": True,
+            "detail": f"{len(files)} files verified"}
+
+
+# -- shadow scoring ----------------------------------------------------------
+
+
+def _shadow_scores(model: DeviceResidentModel, requests: List,
+                   ladder) -> np.ndarray:
+    """Score ``requests`` through ``model`` full-effort, chunked over the
+    engine's bucket ladder (every (mode, bucket) program is warmed, so
+    this dispatches zero new compiles)."""
+    out: List[np.ndarray] = []
+    top = ladder.max_batch
+    for lo in range(0, len(requests), top):
+        chunk = requests[lo:lo + top]
+        bucket = ladder.bucket_for(len(chunk))
+        args, _fallbacks, _counters = model.assemble(chunk, bucket)
+        scores = np.asarray(get_scorer(model, "full", bucket)(*args))
+        out.append(scores[:len(chunk)])
+    return np.concatenate(out) if out else np.zeros(0, np.float32)
+
+
+# -- the gate ladder ---------------------------------------------------------
+
+
+def _reject(engine: ServingEngine, label: str, gates: Dict[str, str],
+            gate: str, reason: str, shadow_requests: int = 0,
+            shadow_max_deviation: Optional[float] = None) -> SwapResult:
+    gates[gate] = "fail"
+    _metrics.counter("serving.swap_rejected", gate=gate).inc()
+    record_failure("serving_swap_rejected", label=label, gate=gate,
+                   reason=reason)
+    result = SwapResult(False, label, engine.model_version, dict(gates),
+                        reason=reason, shadow_requests=shadow_requests,
+                        shadow_max_deviation=shadow_max_deviation)
+    engine.swap_history.append({
+        "outcome": "rejected", "label": label, "gate": gate, "why": reason,
+        "gates": dict(gates), "version": engine.model_version,
+        "shadow_requests": shadow_requests,
+        "shadow_max_deviation": shadow_max_deviation,
+    })
+    return result
+
+
+def swap_staged(engine: ServingEngine, serving_model, label: str,
+                mesh=None) -> SwapResult:
+    """Run the in-memory half of the gate ladder (finite -> staging ->
+    shadow -> compiles) over an already-loaded ServingGameModel, and
+    publish on success. ``swap_from_dir`` is the on-disk front half."""
+    cfg = engine.config.swap
+    gates: Dict[str, str] = {}
+    _metrics.counter("serving.swap_attempts").inc()
+
+    # finite: host-side scan of every coefficient table — a poisoned
+    # candidate is refused before it touches the device, no traffic needed
+    bad = []
+    for fe in serving_model.fixed:
+        if not np.all(np.isfinite(np.asarray(fe.coefficients))):
+            bad.append(fe.coordinate_id)
+    for re in serving_model.random:
+        if not np.all(np.isfinite(np.asarray(re.coefficients))):
+            bad.append(re.coordinate_id)
+    if bad:
+        return _reject(engine, label, gates, "finite",
+                       f"non-finite coefficients in {bad}")
+    gates["finite"] = "pass"
+
+    steady0 = compile_cache.compile_counts().get("steady_state", 0)
+
+    # staging: device residency + the full program ladder, compiled under
+    # the warmup phase tag (a new model token = new logical programs, so
+    # these compiles are expected and excluded from the steady-state gate)
+    try:
+        staged = DeviceResidentModel(
+            serving_model, mesh=mesh if mesh is not None else engine.model.mesh,
+            feature_pad=engine.config.feature_pad)
+        warmup_scorers(staged, engine.ladder.buckets)
+    except Exception as e:  # any staging fault refuses, live keeps serving
+        return _reject(engine, label, gates, "staging",
+                       f"staging failed: {e!r}")
+    gates["staging"] = "pass"
+
+    # shadow: recent captured traffic through both models
+    sample = engine.recent_requests(cfg.capture_size)
+    shadow_n = len(sample)
+    max_dev: Optional[float] = None
+    if shadow_n >= cfg.min_shadow_requests:
+        try:
+            live_scores = _shadow_scores(engine.model, sample, engine.ladder)
+            cand_scores = _shadow_scores(staged, sample, engine.ladder)
+        except Exception as e:
+            return _reject(engine, label, gates, "shadow",
+                           f"shadow scoring failed: {e!r}",
+                           shadow_requests=shadow_n)
+        if not np.all(np.isfinite(cand_scores)):
+            return _reject(engine, label, gates, "shadow",
+                           "candidate produced non-finite shadow scores",
+                           shadow_requests=shadow_n)
+        max_dev = float(np.max(np.abs(live_scores - cand_scores))) \
+            if shadow_n else 0.0
+        _metrics.histogram("serving.swap_shadow_deviation",
+                           DEVIATION_BUCKETS).observe(max_dev)
+        if max_dev > cfg.max_shadow_deviation:
+            return _reject(engine, label, gates, "shadow",
+                           f"shadow deviation {max_dev:.3e} > "
+                           f"{cfg.max_shadow_deviation:.3e} "
+                           f"over {shadow_n} requests",
+                           shadow_requests=shadow_n,
+                           shadow_max_deviation=max_dev)
+        gates["shadow"] = "pass"
+    else:
+        gates["shadow"] = "skip"
+
+    # compiles: staging+shadow must not have compiled on the steady path
+    steady1 = compile_cache.compile_counts().get("steady_state", 0)
+    if steady1 != steady0:
+        return _reject(engine, label, gates, "compiles",
+                       f"{steady1 - steady0} steady-state compiles during "
+                       f"staging/shadow", shadow_requests=shadow_n,
+                       shadow_max_deviation=max_dev)
+    gates["compiles"] = "pass"
+
+    published = engine.publish_model(staged, label)
+    engine.swap_history.append({
+        "outcome": "published", "label": label, "gates": dict(gates),
+        "version": published["version"], "shadow_requests": shadow_n,
+        "shadow_max_deviation": max_dev,
+    })
+    return SwapResult(True, label, published["version"], gates,
+                      shadow_requests=shadow_n, shadow_max_deviation=max_dev)
+
+
+def swap_from_dir(engine: ServingEngine, model_dir: str,
+                  label: Optional[str] = None, mesh=None,
+                  coordinates_to_load=None) -> SwapResult:
+    """Full gate ladder over an exported model directory: integrity ->
+    load -> (chaos poison hook) -> swap_staged. The canonical entry point
+    for the CLI control line and operator tooling."""
+    from photon_tpu.io.model_io import load_for_serving
+
+    label = label or os.path.basename(os.path.normpath(model_dir))
+    gates: Dict[str, str] = {}
+
+    verdict = verify_swap_manifest(model_dir)
+    if not verdict["ok"]:
+        _metrics.counter("serving.swap_attempts").inc()
+        return _reject(engine, label, gates, "integrity",
+                       str(verdict["detail"]))
+    if not verdict["present"] and engine.config.swap.require_manifest:
+        _metrics.counter("serving.swap_attempts").inc()
+        return _reject(engine, label, gates, "integrity",
+                       "manifest required but absent")
+    gates["integrity"] = "pass" if verdict["present"] else "skip"
+
+    try:
+        serving_model = load_for_serving(
+            model_dir, coordinates_to_load=coordinates_to_load)
+    except Exception as e:  # torn dir past the manifest, schema drift
+        _metrics.counter("serving.swap_attempts").inc()
+        return _reject(engine, label, gates, "load",
+                       f"load_for_serving failed: {e!r}")
+    gates["load"] = "pass"
+
+    if _chaos.should_poison_swap_candidate():
+        for fe in serving_model.fixed:
+            fe.coefficients = np.full_like(np.asarray(fe.coefficients), np.nan)
+
+    result = swap_staged(engine, serving_model, label, mesh=mesh)
+    # fold the on-disk gate outcomes into the ladder's result/history
+    result.gates = {**gates, **result.gates}
+    if engine.swap_history:
+        engine.swap_history[-1]["gates"] = dict(result.gates)
+    return result

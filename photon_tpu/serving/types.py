@@ -32,6 +32,23 @@ class FallbackReason(str, enum.Enum):
     #: request carried more features than the padded width for a shard;
     #: overflow features dropped (first-N kept, deterministic)
     FEATURE_OVERFLOW = "feature_overflow"
+    #: the request's absolute deadline cannot be met — either refused at
+    #: admission (budget below the service floor) or expired while queued;
+    #: it never occupies a bucket slot it cannot use
+    DEADLINE_EXCEEDED = "deadline_exceeded"
+    #: the engine is draining (SIGTERM / operator drain): admission
+    #: refuses instead of queueing work that may never score
+    SHUTTING_DOWN = "shutting_down"
+    #: circuit breaker tripped to fixed-effect-only scoring (stage
+    #: latency or failure-rate breach; distinct from the SLO shed so
+    #: operators can tell load from fault)
+    BREAKER_SHED_RANDOM_EFFECTS = "breaker_shed_random_effects"
+    #: circuit breaker open: admission refuses outright until the
+    #: half-open probe succeeds
+    BREAKER_REJECTED = "breaker_rejected"
+    #: the compiled scorer raised or produced non-finite scores; the
+    #: request gets a typed failure, never a hot-path exception
+    SCORER_FAILURE = "scorer_failure"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +80,11 @@ class ScoreRequest:
     features: Dict[str, Sequence[Tuple[str, str, float]]]
     entity_ids: Dict[str, str] = dataclasses.field(default_factory=dict)
     offset: float = 0.0
+    #: per-request latency budget in seconds; the engine turns it into an
+    #: absolute deadline on its own clock at admission. None falls back
+    #: to ``DeadlineConfig.default_timeout_s`` (which may also be None =
+    #: no deadline).
+    timeout_s: Optional[float] = None
 
     @staticmethod
     def from_json(obj: dict) -> "ScoreRequest":
@@ -74,7 +96,9 @@ class ScoreRequest:
             features=feats,
             entity_ids={str(k): str(v)
                         for k, v in (obj.get("ids") or {}).items()},
-            offset=float(obj.get("offset", 0.0)))
+            offset=float(obj.get("offset", 0.0)),
+            timeout_s=(float(obj["timeout_ms"]) / 1000.0
+                       if obj.get("timeout_ms") is not None else None))
 
 
 @dataclasses.dataclass
@@ -120,6 +144,100 @@ class SLOConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DeadlineConfig:
+    """Per-request deadline propagation: admission -> queue -> scoring.
+
+    A request's absolute deadline is ``admission_time + timeout``; the
+    per-stage budgets below decide where along the pipeline it is refused
+    rather than scored late:
+
+      admission  budget < min_service_s          DEADLINE_EXCEEDED now
+      queue      now > deadline - score_headroom DEADLINE_EXCEEDED at pop
+      release    a batch ships early enough that its tightest deadline
+                 still has score_headroom_s left (overriding the
+                 oldest-waiter coalescing wait)
+    """
+
+    #: deadline applied to requests that carry no ``timeout_s`` of their
+    #: own; None = such requests never expire
+    default_timeout_s: Optional[float] = None
+    #: the assemble+score floor: a request whose whole budget is below
+    #: this cannot be served in time no matter what, so admission refuses
+    #: it immediately instead of letting it occupy a bucket slot
+    min_service_s: float = 0.0
+    #: time reserved for assemble+score after a request leaves the queue;
+    #: a queued request is expired once ``now > deadline - this``
+    score_headroom_s: float = 0.0
+
+    def __post_init__(self):
+        if self.min_service_s < 0 or self.score_headroom_s < 0:
+            raise ValueError("deadline budgets must be >= 0")
+        if self.default_timeout_s is not None and self.default_timeout_s <= 0:
+            raise ValueError("default_timeout_s must be positive")
+
+
+@dataclasses.dataclass(frozen=True)
+class BreakerConfig:
+    """Sliding-window circuit breaker over the scorer stage.
+
+    State ladder: ``closed`` (full scoring) -> ``shed`` (fixed-effect
+    only) -> ``open`` (reject at admission) -> ``half_open`` (bounded
+    full-effort probes after ``cooldown_s``) -> ``closed`` again when the
+    probes come back healthy. A breach is either the window's p99 scorer
+    latency above ``latency_p99_s`` or its failure rate above
+    ``failure_rate``, evaluated once ``min_samples`` observations exist.
+    """
+
+    #: number of most-recent scorer-stage observations kept
+    window: int = 256
+    #: observations required before the breaker may trip (a single slow
+    #: batch on a cold window must not flap the state)
+    min_samples: int = 16
+    #: p99 scorer-stage latency threshold; inf disables the latency trip
+    latency_p99_s: float = float("inf")
+    #: scorer failure-rate threshold (exceptions / non-finite scores)
+    failure_rate: float = 0.5
+    #: time spent open before half-open probing starts
+    cooldown_s: float = 1.0
+    #: healthy full-effort probe batches required to close again
+    probe_batches: int = 2
+
+    def __post_init__(self):
+        if self.window < 1 or self.min_samples < 1 or self.probe_batches < 1:
+            raise ValueError("breaker window/min_samples/probe_batches >= 1")
+        if not 0.0 < self.failure_rate <= 1.0:
+            raise ValueError("failure_rate must be in (0, 1]")
+        if self.cooldown_s < 0:
+            raise ValueError("cooldown_s must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class SwapConfig:
+    """Gates for validated live model swap (serving/swap.py)."""
+
+    #: how many recent admitted requests the engine captures for shadow
+    #: scoring a candidate (ring buffer; also the shadow sample ceiling)
+    capture_size: int = 256
+    #: reject a candidate whose shadow scores deviate from the live
+    #: model's by more than this (max abs); inf = only finiteness gates
+    max_shadow_deviation: float = float("inf")
+    #: minimum captured requests the shadow gate needs; below it the
+    #: deviation gate is skipped (finite/compile gates still apply)
+    min_shadow_requests: int = 1
+    #: refuse candidates without a crc32 swap manifest (swap-manifest.json)
+    require_manifest: bool = False
+    #: post-publish probation: a breaker trip within this window triggers
+    #: automatic rollback to the prior version; 0 disables the guard
+    probation_s: float = 30.0
+
+    def __post_init__(self):
+        if self.capture_size < 1:
+            raise ValueError("capture_size must be >= 1")
+        if self.probation_s < 0:
+            raise ValueError("probation_s must be >= 0")
+
+
+@dataclasses.dataclass(frozen=True)
 class ServingConfig:
     """Engine knobs. Every shape-bearing value here is part of the
     compiled-program key: changing it after warmup would recompile, so
@@ -136,3 +254,10 @@ class ServingConfig:
     #: covering the shard dimension, capped at 256
     feature_pad: Optional[int] = None
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
+    deadline: DeadlineConfig = dataclasses.field(default_factory=DeadlineConfig)
+    breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
+    swap: SwapConfig = dataclasses.field(default_factory=SwapConfig)
+    #: graceful drain: after ``begin_drain`` the engine keeps flushing
+    #: in-flight micro-batches for at most this long; whatever is still
+    #: queued past the budget gets a typed SHUTTING_DOWN refusal
+    drain_budget_s: float = 5.0

@@ -24,6 +24,14 @@ and fails if any of three independent compile monitors moved:
   3. per-program ``jax.jit`` ``_cache_size()`` (re-traces of an existing
      program — the silent killer the first two cannot see).
 
+The contract extends over live model swaps: mid-run the script swaps in
+a second model (new coefficients, same shapes) through the full gate
+ladder. The staged model's program builds are tagged phase="warmup" and
+land as new jitcache entries — expected, re-baselined — but the
+steady-state compile counter must stay frozen across the entire run,
+swap included, and post-swap traffic (old + new programs) must not move
+any monitor.
+
 Wired into tier-1 via tests/test_serving.py; also runnable standalone::
 
     JAX_PLATFORMS=cpu python scripts/check_serving_no_recompile.py
@@ -38,7 +46,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_engine():
+def build_serving_model(seed: int):
+    """Synthetic GAME model over a fixed 17-feature space; the seed only
+    varies coefficient values, so two seeds make a valid swap pair."""
     import numpy as np
 
     from photon_tpu.io.index_map import IndexMapBuilder, feature_key
@@ -47,15 +57,9 @@ def build_engine():
         ServingGameModel,
         ServingRandomEffect,
     )
-    from photon_tpu.serving import (
-        DeviceResidentModel,
-        ServingConfig,
-        ServingEngine,
-        SLOConfig,
-    )
     from photon_tpu.types import TaskType
 
-    rng = np.random.default_rng(7)
+    rng = np.random.default_rng(seed)
     b = IndexMapBuilder()
     names = [f"f{j}" for j in range(17)]          # odd, forces padding
     for n in names:
@@ -76,6 +80,18 @@ def build_engine():
         [ServingRandomEffect("per-user", "userId", "shardA", coef, proj,
                              {f"u{e}": e for e in range(E)})],
         {"shardA": imap}, {})
+    return model, names
+
+
+def build_engine():
+    from photon_tpu.serving import (
+        DeviceResidentModel,
+        ServingConfig,
+        ServingEngine,
+        SLOConfig,
+    )
+
+    model, names = build_serving_model(7)
     engine = ServingEngine(
         DeviceResidentModel(model),
         ServingConfig(max_batch=8, max_wait_s=0.0,
@@ -111,9 +127,23 @@ def drive_traffic(engine, names):
     return served
 
 
+def _jitted_programs(model, ladder):
+    from photon_tpu.serving.scorer import MODES, get_scorer
+
+    programs = [get_scorer(model, mode, b)
+                for mode in MODES for b in ladder.buckets]
+    # unwrap telemetry first-call timers to reach the jitted fn (a jit fn
+    # itself carries __wrapped__, so test for the jit API, don't unwrap
+    # unconditionally)
+    jitted = [p if hasattr(p, "_cache_size")
+              else getattr(p, "__wrapped__", p) for p in programs]
+    return [f for f in jitted if hasattr(f, "_cache_size")]
+
+
 def main() -> int:
     from photon_tpu.obs.metrics import registry
-    from photon_tpu.serving.scorer import MODES, get_scorer
+    from photon_tpu.serving.scorer import MODES
+    from photon_tpu.serving.swap import swap_staged
     from photon_tpu.utils import compile_cache
 
     engine, names = build_engine()
@@ -125,14 +155,7 @@ def main() -> int:
 
     baseline = compile_cache.compile_counts()
     misses0 = registry.counter("jitcache.misses").value
-    programs = [get_scorer(engine.model, mode, b)
-                for mode in MODES for b in engine.ladder.buckets]
-    # unwrap telemetry first-call timers to reach the jitted fn (a jit fn
-    # itself carries __wrapped__, so test for the jit API, don't unwrap
-    # unconditionally)
-    jitted = [p if hasattr(p, "_cache_size")
-              else getattr(p, "__wrapped__", p) for p in programs]
-    jitted = [f for f in jitted if hasattr(f, "_cache_size")]
+    jitted = _jitted_programs(engine.model, engine.ladder)
     traces0 = [f._cache_size() for f in jitted]
 
     served = drive_traffic(engine, names)
@@ -157,9 +180,53 @@ def main() -> int:
         for f in failures:
             print("  " + f)
         return 1
+
+    # -- live swap mid-run: staging compiles are warmup-tagged; the
+    # steady-state counter must stay frozen across the entire swap
+    model_v2, _ = build_serving_model(23)
+    result = swap_staged(engine, model_v2, "v2")
+    if not result.accepted:
+        print(f"FAIL: swap rejected: {result.reason} (gates {result.gates})")
+        return 1
+    after_swap = compile_cache.compile_counts()
+    if after_swap["steady_state"] != baseline["steady_state"]:
+        print(f"FAIL: swap moved the steady-state compile counter: "
+              f"{baseline['steady_state']} -> {after_swap['steady_state']}")
+        return 1
+
+    # re-baseline the entry monitors (the staged ladder added warmup
+    # entries by design) and watch old + new programs through v2 traffic
+    misses2 = registry.counter("jitcache.misses").value
+    jitted += _jitted_programs(engine.model, engine.ladder)
+    traces2 = [f._cache_size() for f in jitted]
+
+    served += drive_traffic(engine, names)
+
+    final = compile_cache.compile_counts()
+    misses3 = registry.counter("jitcache.misses").value
+    traces3 = [f._cache_size() for f in jitted]
+
+    if final["steady_state"] != baseline["steady_state"]:
+        failures.append(
+            f"post-swap steady-state compiles moved: "
+            f"{baseline['steady_state']} -> {final['steady_state']}")
+    if misses3 != misses2:
+        failures.append(f"post-swap jitcache.misses moved: "
+                        f"{misses2} -> {misses3}")
+    for i, (t0, t1) in enumerate(zip(traces2, traces3)):
+        if t1 > t0:
+            failures.append(f"post-swap program {i} re-traced: _cache_size "
+                            f"{t0} -> {t1}")
+    if failures:
+        print("FAIL: serving compiled across the live swap:")
+        for f in failures:
+            print("  " + f)
+        return 1
     print(f"ok: {served} responses over buckets {list(engine.ladder.buckets)}"
-          f" x modes {list(MODES)}, warmup compiles="
-          f"{int(after['warmup'])}, steady-state compiles=0")
+          f" x modes {list(MODES)}, live swap to v{result.version} "
+          f"(shadow dev {result.shadow_max_deviation:.3e} over "
+          f"{result.shadow_requests} reqs), warmup compiles="
+          f"{int(final['warmup'])}, steady-state compiles=0")
     return 0
 
 

@@ -1,0 +1,620 @@
+"""Serving resilience: deadlines, circuit breaker, drain, live swap.
+
+ISSUE 6. Complements tests/test_serving.py (parity + SLO ladder): here
+the engine is exercised under fault and change — expiring deadlines,
+a slow/failing scorer stage tripping the breaker, SIGTERM drain, and
+validated live model swap with automatic rollback. Chaos injection
+(photon_tpu/resilience/chaos.py) provides the faults deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from photon_tpu.game.dataset import EntityVocabulary
+from photon_tpu.game.model import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    GeneralizedLinearModel,
+    RandomEffectModel,
+)
+from photon_tpu.io.index_map import IndexMap, feature_key
+from photon_tpu.io.model_io import (
+    ServingFixedEffect,
+    ServingGameModel,
+    ServingRandomEffect,
+    save_game_model,
+)
+from photon_tpu.obs.metrics import registry as metrics_registry
+from photon_tpu.resilience import chaos, shutdown
+from photon_tpu.serving import (
+    BreakerConfig,
+    BucketLadder,
+    DeadlineConfig,
+    DeviceResidentModel,
+    FallbackReason,
+    MicroBatcher,
+    QueueClosedError,
+    ScoreRequest,
+    ServingConfig,
+    ServingEngine,
+    SwapConfig,
+    swap_from_dir,
+    verify_swap_manifest,
+    write_swap_manifest,
+)
+from photon_tpu.types import TaskType
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+D_GLOBAL, D_USER, N_USERS = 8, 6, 4
+
+
+def _reasons(resp):
+    return {f.reason for f in resp.fallbacks}
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _build_model_dir(tmp_path, name, coef_shift=0.0):
+    """Reference-layout GAME model dir; ``coef_shift`` offsets every
+    coefficient, so two dirs form a swap pair with a known score diff."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(42)    # same draw for v1 and v2
+    im_g = IndexMap.from_keys([feature_key("g", str(j))
+                               for j in range(D_GLOBAL)])
+    im_u = IndexMap.from_keys([feature_key("u", str(j))
+                               for j in range(D_USER)])
+    theta = rng.normal(size=D_GLOBAL) + coef_shift
+    K = 3
+    proj = np.full((N_USERS, K), -1, np.int32)
+    coef = np.zeros((N_USERS, K))
+    for e in range(N_USERS):
+        proj[e] = np.sort(rng.choice(D_USER, size=K, replace=False))
+        coef[e] = rng.normal(size=K) + coef_shift
+    users = [f"user{e}" for e in range(N_USERS)]
+    vocab = EntityVocabulary()
+    vocab.build("userId", users)
+    model = GameModel({
+        "fixed": FixedEffectModel(
+            GeneralizedLinearModel(Coefficients(jnp.asarray(theta)),
+                                   TaskType.LOGISTIC_REGRESSION), "g"),
+        "per_user": RandomEffectModel(jnp.asarray(coef), "userId", "u",
+                                      TaskType.LOGISTIC_REGRESSION),
+    })
+    d = str(tmp_path / name)
+    save_game_model(d, model, {"g": im_g, "u": im_u}, vocab=vocab,
+                    projections={"per_user": proj}, sparsity_threshold=0.0)
+    return d, users
+
+
+def _traffic(users, n=12, seed=3):
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        gf = [("g", str(j), float(rng.normal())) for j in range(D_GLOBAL)]
+        uf = [("u", str(j), float(rng.normal())) for j in range(D_USER)]
+        reqs.append(ScoreRequest(
+            f"r{i}", {"g": gf, "u": uf},
+            {"userId": users[i % len(users)]}, float(rng.normal() * 0.1)))
+    return reqs
+
+
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    """(v1 dir, v2 dir, users): same shapes, shifted coefficients, both
+    manifest-stamped."""
+    tmp_path = tmp_path_factory.mktemp("swap_models")
+    v1, users = _build_model_dir(tmp_path, "v1", coef_shift=0.0)
+    v2, _ = _build_model_dir(tmp_path, "v2", coef_shift=0.5)
+    write_swap_manifest(v1)
+    write_swap_manifest(v2)
+    return v1, v2, users
+
+
+def _synth_model(seed=7, nan_fixed=False):
+    """Small in-memory ServingGameModel (one shard, one random effect)."""
+    rng = np.random.default_rng(seed)
+    imap = IndexMap.from_keys([feature_key(f"f{j}", "") for j in range(5)])
+    theta = rng.normal(size=5).astype(np.float32)
+    if nan_fixed:
+        theta[0] = np.nan
+    E, K = 3, 2
+    proj = np.stack([np.sort(rng.choice(5, size=K, replace=False))
+                     for _ in range(E)]).astype(np.int32)
+    coef = rng.normal(size=(E, K)).astype(np.float32)
+    return ServingGameModel(
+        TaskType.LOGISTIC_REGRESSION,
+        [ServingFixedEffect("global", "s", theta)],
+        [ServingRandomEffect("per-u", "uid", "s", coef, proj,
+                             {f"u{e}": e for e in range(E)})],
+        {"s": imap}, {})
+
+
+def _synth_req(uid, user="u0", timeout_s=None):
+    return ScoreRequest(uid, {"s": [(f"f{j}", "", 1.0) for j in range(5)]},
+                        {"uid": user}, timeout_s=timeout_s)
+
+
+def _mk_engine(config=None, clock=None, model=None, warm=True):
+    engine = ServingEngine(
+        DeviceResidentModel(model if model is not None else _synth_model()),
+        config or ServingConfig(max_batch=2, max_wait_s=0.0),
+        clock=clock)
+    if warm:
+        engine.warmup()
+    return engine
+
+
+# -- deadline semantics (batching) -------------------------------------------
+
+
+def test_batcher_deadline_release_with_injectable_clock():
+    """A queued request's absolute deadline releases the batch as soon as
+    only the score headroom is left — even though the oldest-waiter
+    coalescing window is far from over."""
+    now = [0.0]
+    b = MicroBatcher(BucketLadder(max_batch=4), max_wait_s=10.0,
+                     clock=lambda: now[0], deadline_headroom_s=0.010)
+    b.submit(_synth_req("a"), deadline=0.100)
+    assert not b.ready()
+    now[0] = 0.089
+    assert not b.ready()                 # headroom not yet reached
+    now[0] = 0.091                       # inside the headroom: release now
+    items, bucket = b.next_batch()
+    assert [p.request.uid for p in items] == ["a"] and bucket == 1
+    # a deadline-free request alone still waits for the full window
+    b.submit(_synth_req("b"))
+    now[0] = 5.0
+    assert not b.ready()
+    now[0] = 10.1
+    assert b.ready()
+
+
+def test_batcher_tighter_deadline_beats_oldest_waiter():
+    """The release check scans every queued request: a NEWER request with
+    a tighter deadline must not be starved by the oldest's long budget."""
+    now = [0.0]
+    b = MicroBatcher(BucketLadder(max_batch=4), max_wait_s=1.0,
+                     clock=lambda: now[0])
+    b.submit(_synth_req("slow"), deadline=100.0)
+    now[0] = 0.010
+    b.submit(_synth_req("tight"), deadline=0.050)
+    now[0] = 0.050                       # tight's deadline, oldest is 40ms old
+    items, _ = b.next_batch()
+    assert {p.request.uid for p in items} == {"slow", "tight"}
+
+
+def test_batcher_close_refuses_submit_lock_free():
+    b = MicroBatcher(BucketLadder(max_batch=2))
+    b.submit(_synth_req("a"))
+    b.close()
+    assert b.closed
+    with pytest.raises(QueueClosedError):
+        b.submit(_synth_req("b"))
+    assert [p.request.uid for p in b.pop_all()] == ["a"]
+    assert b.depth() == 0
+    assert b.wait_for_work(timeout=0.001) is False
+
+
+# -- deadline semantics (engine) ---------------------------------------------
+
+
+def test_deadline_admission_refusal_below_service_floor():
+    engine = _mk_engine(ServingConfig(
+        max_batch=2, max_wait_s=0.0,
+        deadline=DeadlineConfig(min_service_s=0.010)))
+    resp = engine.submit(_synth_req("x", timeout_s=0.005))
+    assert resp is not None and resp.score is None and resp.degraded
+    assert _reasons(resp) == {FallbackReason.DEADLINE_EXCEEDED}
+    # a feasible budget is admitted normally
+    assert engine.submit(_synth_req("y", timeout_s=0.5)) is None
+    [ok] = engine.drain()
+    assert ok.uid == "y" and ok.score is not None
+
+
+def test_deadline_queue_expiry_while_bucket_mates_score():
+    """A request that expires in the queue gets DEADLINE_EXCEEDED; the
+    rest of its batch still scores, in the smallest covering bucket."""
+    now = [0.0]
+    engine = _mk_engine(ServingConfig(max_batch=4, max_wait_s=10.0),
+                        clock=lambda: now[0])
+    engine.submit(_synth_req("doomed", timeout_s=0.050))
+    engine.submit(_synth_req("fine1"))
+    engine.submit(_synth_req("fine2"))
+    assert engine.pump() == []           # nothing released yet
+    now[0] = 0.060                       # past doomed's deadline
+    resps = {r.uid: r for r in engine.pump()}
+    assert set(resps) == {"doomed", "fine1", "fine2"}
+    assert resps["doomed"].score is None
+    assert _reasons(resps["doomed"]) == {FallbackReason.DEADLINE_EXCEEDED}
+    for uid in ("fine1", "fine2"):
+        assert resps[uid].score is not None and not resps[uid].degraded
+
+
+def test_deadline_release_scores_in_time():
+    """Released at deadline-minus-headroom, a request still scores: the
+    deadline path refuses only requests that genuinely cannot make it."""
+    now = [0.0]
+    engine = _mk_engine(ServingConfig(max_batch=4, max_wait_s=10.0),
+                        clock=lambda: now[0])
+    engine.submit(_synth_req("t", timeout_s=0.050))
+    now[0] = 0.050                       # release boundary, not yet expired
+    [resp] = engine.pump()
+    assert resp.uid == "t" and resp.score is not None
+
+
+def test_default_timeout_applies_to_bare_requests():
+    now = [0.0]
+    engine = _mk_engine(ServingConfig(
+        max_batch=4, max_wait_s=10.0,
+        deadline=DeadlineConfig(default_timeout_s=0.030)),
+        clock=lambda: now[0])
+    engine.submit(_synth_req("bare"))    # no per-request timeout
+    now[0] = 0.031
+    [resp] = engine.pump()
+    assert _reasons(resp) == {FallbackReason.DEADLINE_EXCEEDED}
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+def test_breaker_latency_trip_shed_open_recover():
+    """Slow scorer (chaos) trips closed->shed->open; admission refuses
+    while open; after cooldown a healthy probe closes the breaker."""
+    now = [0.0]
+    cfg = ServingConfig(
+        max_batch=1, max_wait_s=0.0,
+        breaker=BreakerConfig(window=8, min_samples=2, latency_p99_s=0.02,
+                              failure_rate=0.99, cooldown_s=5.0,
+                              probe_batches=1),
+        swap=SwapConfig(probation_s=0.0))
+    engine = _mk_engine(cfg, clock=lambda: now[0])
+    with chaos.active(chaos.ChaosConfig(scorer_delay_s=0.2,
+                                        scorer_delay_batches=4)):
+        shed_seen = False
+        for i in range(4):
+            engine.submit(_synth_req(f"s{i}"))
+            [resp] = engine.pump(flush=True)
+            if FallbackReason.BREAKER_SHED_RANDOM_EFFECTS in _reasons(resp):
+                shed_seen = True
+        assert shed_seen
+        assert engine.breaker.state() == "open"
+        # open: admission refuses outright
+        resp = engine.submit(_synth_req("refused"))
+        assert resp is not None
+        assert _reasons(resp) == {FallbackReason.BREAKER_REJECTED}
+        # cooldown elapses on the injected clock -> half-open probe
+        now[0] += 5.1
+        assert engine.breaker.state() == "half_open"
+        assert engine.submit(_synth_req("probe")) is None   # delay budget spent
+        [resp] = engine.pump(flush=True)
+        assert resp.score is not None
+    assert engine.breaker.state() == "closed"
+    snap = engine.breaker.snapshot()
+    assert snap["trips"] >= 2
+    assert engine.stats()["breaker"]["state"] == "closed"
+
+
+def test_breaker_failure_trip_on_nonfinite_scores():
+    """A model that yields NaN scores produces typed SCORER_FAILURE
+    responses (never an exception) and trips the failure-rate breach."""
+    engine = _mk_engine(
+        ServingConfig(max_batch=1, max_wait_s=0.0,
+                      breaker=BreakerConfig(window=8, min_samples=2,
+                                            failure_rate=0.4),
+                      swap=SwapConfig(probation_s=0.0)),
+        model=_synth_model(nan_fixed=True))
+    resps = []
+    for i in range(2):
+        engine.submit(_synth_req(f"n{i}"))
+        resps.extend(engine.pump(flush=True))
+    assert all(r.score is None for r in resps)
+    assert all(_reasons(r) == {FallbackReason.SCORER_FAILURE} for r in resps)
+    assert engine.breaker.state() == "shed"
+
+
+# -- graceful drain -----------------------------------------------------------
+
+
+def test_drain_refuses_with_typed_shutting_down():
+    engine = _mk_engine()
+    engine.begin_drain("test drain")
+    resp = engine.submit(_synth_req("late"))
+    assert resp is not None and resp.score is None
+    assert _reasons(resp) == {FallbackReason.SHUTTING_DOWN}
+    assert engine.stats()["draining"] is True
+
+
+def test_shutdown_flushes_within_budget():
+    engine = _mk_engine(ServingConfig(max_batch=2, max_wait_s=10.0))
+    for i in range(3):
+        engine.submit(_synth_req(f"q{i}"))
+    out = engine.shutdown(drain_budget_s=30.0)
+    assert {r.uid for r in out} == {"q0", "q1", "q2"}
+    assert all(r.score is not None for r in out)
+    drain = engine.stats()["drain"]
+    assert drain["flushed"] == 3 and drain["refused"] == 0
+
+
+def test_shutdown_budget_exhaustion_refuses_remainder():
+    engine = _mk_engine(ServingConfig(max_batch=2, max_wait_s=10.0))
+    for i in range(3):
+        engine.submit(_synth_req(f"q{i}"))
+    out = engine.shutdown(drain_budget_s=0.0)    # no flush time at all
+    assert {r.uid for r in out} == {"q0", "q1", "q2"}
+    assert all(_reasons(r) == {FallbackReason.SHUTTING_DOWN} for r in out)
+    assert engine.stats()["drain"]["refused"] == 3
+
+
+def test_shutdown_callback_flips_engine_to_draining():
+    """resilience/shutdown.py request() drives begin_drain through the
+    callback registry — the SIGTERM -> drain wiring, minus the signal."""
+    engine = _mk_engine(warm=False)
+
+    def cb(reason):
+        engine.begin_drain(reason)
+
+    shutdown.reset()
+    shutdown.add_callback(cb)
+    try:
+        shutdown.request("test sigterm")
+        assert engine.draining and engine.batcher.closed
+    finally:
+        shutdown.remove_callback(cb)
+        shutdown.reset()
+
+
+# -- live model swap ----------------------------------------------------------
+
+
+def _fresh_engine_from_dir(model_dir, config=None):
+    engine = ServingEngine.from_model_dir(
+        model_dir, config=config or ServingConfig(max_batch=4, max_wait_s=0.0))
+    engine.warmup()
+    return engine
+
+
+def test_swap_e2e_v1_to_v2_parity(model_dirs):
+    """The acceptance path: serve v1, swap to v2 under captured traffic,
+    post-swap scores match a from-scratch v2 engine to 1e-6, zero
+    steady-state compiles across the swap."""
+    from photon_tpu.utils import compile_cache
+
+    v1, v2, users = model_dirs
+    engine = _fresh_engine_from_dir(v1)
+    reqs = _traffic(users)
+    before = [r.score for r in engine.serve(reqs)]
+
+    steady0 = compile_cache.compile_counts()["steady_state"]
+    result = swap_from_dir(engine, v2, label="v2")
+    assert result.accepted, result.reason
+    assert result.gates["integrity"] == "pass"
+    assert result.gates["shadow"] == "pass"
+    assert result.shadow_requests == len(reqs)
+    assert result.shadow_max_deviation > 0.0     # the models really differ
+    assert engine.model_version == 2 and engine.model_label == "v2"
+    assert compile_cache.compile_counts()["steady_state"] == steady0
+
+    after = [r.score for r in engine.serve(reqs)]
+    oracle = [r.score for r in _fresh_engine_from_dir(v2).serve(reqs)]
+    np.testing.assert_allclose(after, oracle, atol=1e-6)
+    # and the swap genuinely changed the scores
+    assert max(abs(a - b) for a, b in zip(before, after)) > 1e-3
+    assert engine.swap_stats()["published"] == 1
+
+
+def test_swap_nan_poisoned_candidate_rejected_live_intact(model_dirs):
+    """Chaos NaN-poisons the candidate: the finite gate refuses it and
+    the live model keeps serving bitwise-identical scores."""
+    v1, v2, users = model_dirs
+    engine = _fresh_engine_from_dir(v1)
+    reqs = _traffic(users)
+    before = [r.score for r in engine.serve(reqs)]
+
+    with chaos.active(chaos.ChaosConfig(swap_poison_nan=True)):
+        result = swap_from_dir(engine, v2, label="poisoned")
+    assert not result.accepted
+    assert result.gates["finite"] == "fail"
+    assert engine.model_version == 1
+
+    after = [r.score for r in engine.serve(reqs)]
+    assert before == after               # bitwise: same model, same programs
+    hist = engine.swap_stats()
+    assert hist["rejected"] == 1 and hist["published"] == 0
+    assert engine.swap_history[-1]["gate"] == "finite"
+
+
+def test_swap_corrupt_candidate_dir_rejected(model_dirs, tmp_path):
+    """A torn candidate directory (chaos truncation) fails the crc32
+    manifest gate before any load is attempted."""
+    v1, v2, users = model_dirs
+    torn = str(tmp_path / "torn")
+    shutil.copytree(v2, torn)
+    victim = chaos.corrupt_model_dir(torn, seed=1)
+    assert os.path.exists(victim)
+    verdict = verify_swap_manifest(torn)
+    assert verdict["present"] and not verdict["ok"]
+
+    engine = _fresh_engine_from_dir(v1)
+    engine.serve(_traffic(users, n=4))
+    result = swap_from_dir(engine, torn, label="torn")
+    assert not result.accepted and result.gates["integrity"] == "fail"
+    assert engine.model_version == 1
+
+
+def test_swap_requires_manifest_when_configured(model_dirs, tmp_path):
+    v1, v2, users = model_dirs
+    bare = str(tmp_path / "bare")
+    shutil.copytree(v2, bare)
+    os.remove(os.path.join(bare, "swap-manifest.json"))
+    engine = _fresh_engine_from_dir(
+        v1, ServingConfig(max_batch=4, max_wait_s=0.0,
+                          swap=SwapConfig(require_manifest=True)))
+    result = swap_from_dir(engine, bare)
+    assert not result.accepted and result.gates["integrity"] == "fail"
+    assert "manifest required" in result.reason
+
+
+def test_swap_shadow_deviation_gate(model_dirs):
+    """A candidate whose scores move more than the configured bound is
+    rejected by the shadow gate."""
+    v1, v2, users = model_dirs
+    engine = _fresh_engine_from_dir(
+        v1, ServingConfig(max_batch=4, max_wait_s=0.0,
+                          swap=SwapConfig(max_shadow_deviation=1e-9)))
+    engine.serve(_traffic(users))
+    result = swap_from_dir(engine, v2, label="too-different")
+    assert not result.accepted and result.gates["shadow"] == "fail"
+    assert result.shadow_max_deviation > 1e-9
+    assert engine.model_version == 1
+
+
+def test_post_swap_breaker_trip_rolls_back(model_dirs):
+    """A breaker trip inside the probation window restores the prior
+    model object — rollback is a pointer swap, bitwise by construction."""
+    v1, v2, users = model_dirs
+    engine = _fresh_engine_from_dir(
+        v1, ServingConfig(
+            max_batch=4, max_wait_s=0.0,
+            breaker=BreakerConfig(window=8, min_samples=1,
+                                  latency_p99_s=0.02),
+            swap=SwapConfig(probation_s=3600.0)))
+    engine.serve(_traffic(users))
+    v1_model = engine.model
+    result = swap_from_dir(engine, v2, label="v2")
+    assert result.accepted and engine.model_version == 2
+
+    with chaos.active(chaos.ChaosConfig(scorer_delay_s=0.2,
+                                        scorer_delay_batches=1)):
+        engine.submit(_traffic(users, n=1)[0])
+        engine.pump(flush=True)
+    assert engine.model_version == 1
+    assert engine.model is v1_model      # the very same object/tables
+    stats = engine.swap_stats()
+    assert stats["rollbacks"] == 1
+    assert engine.swap_history[-1]["outcome"] == "rolled_back"
+    rollbacks = metrics_registry.counter("serving.swap_rollbacks").value
+    assert rollbacks >= 1
+
+
+# -- RunReport ----------------------------------------------------------------
+
+
+def test_runreport_swap_section_roundtrip(model_dirs):
+    import photon_tpu.serving as serving_pkg
+    from photon_tpu.obs.report import build_run_report, validate_run_report
+
+    v1, v2, users = model_dirs
+    engine = _fresh_engine_from_dir(v1)
+    engine.serve(_traffic(users))
+    swap_from_dir(engine, v2, label="v2")
+    serving_pkg.set_active_engine(engine)
+    try:
+        report = build_run_report("swap-test")
+        assert validate_run_report(report) == []
+        swap = report["serving"]["swap"]
+        assert swap["version"] == 2 and swap["label"] == "v2"
+        assert swap["history"][-1]["outcome"] == "published"
+        # round-trip through JSON, still valid
+        back = json.loads(json.dumps(report))
+        assert validate_run_report(back) == []
+        # a swap section missing its keys is flagged
+        del back["serving"]["swap"]["version"]
+        assert any("serving.swap" in e for e in validate_run_report(back))
+    finally:
+        serving_pkg.set_active_engine(None)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def _cli_env():
+    return {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def test_cli_sigterm_drains_and_exits_zero(model_dirs, tmp_path):
+    """SIGTERM under load: pre-signal uids all answered, process drains
+    within the budget and exits 0."""
+    v1, _, users = model_dirs
+    stats_path = str(tmp_path / "stats.json")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "photon_tpu.cli.serve",
+         "--model-input-directory", v1,
+         "--max-batch", "4", "--max-wait-ms", "0",
+         "--drain-budget-s", "5", "--stats-output", stats_path,
+         "--log-level", "ERROR"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=_cli_env(), cwd=REPO)
+    reqs = _traffic(users, n=6)
+    for r in reqs:
+        p.stdin.write(json.dumps({
+            "uid": r.uid,
+            "features": {k: [list(f) for f in v]
+                         for k, v in r.features.items()},
+            "ids": r.entity_ids, "offset": r.offset}) + "\n")
+    p.stdin.flush()
+    answered = [json.loads(p.stdout.readline()) for _ in reqs]
+    p.send_signal(signal.SIGTERM)        # stdin stays open: drain must win
+    try:
+        out, err = p.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        pytest.fail("serve did not exit within the drain budget")
+    assert p.returncode == 0, err
+    assert {a["uid"] for a in answered} == {r.uid for r in reqs}
+    assert all(a["score"] is not None for a in answered)
+    stats = json.load(open(stats_path))
+    assert stats["draining"] is True and "drain" in stats
+
+
+def test_cli_control_line_swap_under_traffic(model_dirs):
+    """The stdin control plane: a swap control line mid-stream publishes
+    v2; subsequent requests score with the new model."""
+    v1, v2, users = model_dirs
+    reqs = _traffic(users, n=4)
+
+    def req_line(r, uid):
+        return json.dumps({
+            "uid": uid,
+            "features": {k: [list(f) for f in v] for k, v in r.features.items()},
+            "ids": r.entity_ids, "offset": r.offset})
+
+    lines = [req_line(r, f"pre-{r.uid}") for r in reqs]
+    lines.append(json.dumps({"control": "swap", "model_dir": v2,
+                             "label": "v2"}))
+    lines += [req_line(r, f"post-{r.uid}") for r in reqs]
+    r = subprocess.run(
+        [sys.executable, "-m", "photon_tpu.cli.serve",
+         "--model-input-directory", v1,
+         "--max-batch", "4", "--max-wait-ms", "0", "--log-level", "ERROR"],
+        input="\n".join(lines) + "\n", text=True, timeout=300,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=_cli_env(), cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    out = [json.loads(l) for l in r.stdout.splitlines() if l.strip()]
+    controls = [o for o in out if "control" in o]
+    assert len(controls) == 1 and controls[0]["ok"] is True
+    assert controls[0]["version"] == 2
+
+    scores = {o["uid"]: o["score"] for o in out if "uid" in o}
+    oracle_v1 = {x.uid: x.score
+                 for x in _fresh_engine_from_dir(v1).serve(reqs)}
+    oracle_v2 = {x.uid: x.score
+                 for x in _fresh_engine_from_dir(v2).serve(reqs)}
+    for q in reqs:
+        assert scores[f"pre-{q.uid}"] == pytest.approx(oracle_v1[q.uid],
+                                                       abs=1e-6)
+        assert scores[f"post-{q.uid}"] == pytest.approx(oracle_v2[q.uid],
+                                                        abs=1e-6)
