@@ -1916,6 +1916,187 @@ def run_serving_bench(scale: float):
     return rec
 
 
+# --------------------------------------------------------------------------
+# game_cd mode: --mode game_cd -> BENCH_GAME_CD_r01.json
+# --------------------------------------------------------------------------
+
+def run_game_cd_bench(scale: float, quick: bool = False):
+    """Parallel-vs-sequential coordinate-descent sweep wall-clock
+    (ISSUE 7): one fixed effect + three random-effect coordinates, the
+    workload shape whose sequential sweep is the SUM of four solves. The
+    parallel mode groups the three random effects into one concurrency
+    group (frozen-score solves dispatched from worker threads, canonical
+    ordered reconciliation, staleness guard ON), and the bench records
+    both sweep wall-clocks, the speedup, coefficient parity, and the
+    staleness-fallback counter — which must be 0 on this workload.
+
+    ``quick`` is the tier-1 smoke shape: tiny frame, one timed run per
+    mode, and NO artifact write (the committed BENCH_GAME_CD_r01.json
+    only ever comes from a full run)."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.estimators.game_estimator import (
+        CoordinateConfiguration,
+        FixedEffectDataConfiguration,
+        GameEstimator,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game import parallel_cd
+    from photon_tpu.game.dataset import FeatureShard, GameDataFrame
+    from photon_tpu.game.descent import (
+        CoordinateDescentConfig,
+        run_coordinate_descent,
+    )
+    from photon_tpu.game.random_effect import RandomEffectDataConfiguration
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    n = max(int((1_200 if quick else 24_000) * scale), 300)
+    # validation as large as training: Photon's training loop validates as
+    # it goes, and the group-commit cadence (one validation per concurrent
+    # group vs per coordinate) is the structural win being measured
+    n_val = max(n, 300)
+    d_g = 16
+    d_u = 4
+    res = [("per_user", "userId", max(int((24 if quick else 360) * scale), 6)),
+           ("per_item", "itemId", max(int((18 if quick else 240) * scale), 5)),
+           ("per_ctx", "ctxId", max(int((12 if quick else 120) * scale), 4))]
+    sweeps = 2 if quick else 6
+    rng = np.random.default_rng(7)
+
+    theta = rng.normal(size=d_g)
+    w_ents = {cid: rng.normal(size=(n_ent, d_u)) for cid, _t, n_ent in res}
+
+    def make_frame(m):
+        Xg = rng.normal(size=(m, d_g))
+        logits = Xg @ theta
+        shards = {"g": FeatureShard(Xg, d_g)}
+        id_tags = {}
+        iu = np.arange(d_u, dtype=np.int32)
+        for cid, tag, n_ent in res:
+            Xe = rng.normal(size=(m, d_u))
+            ent = rng.integers(0, n_ent, size=m)
+            # per-entity signal so every coordinate has something real to fit
+            logits = logits + np.einsum("ij,ij->i", Xe, w_ents[cid][ent])
+            shards[cid] = FeatureShard([(iu, Xe[i]) for i in range(m)], d_u)
+            id_tags[tag] = [str(v) for v in ent]
+        y = (rng.random(m) < 1.0 / (1.0 + np.exp(-logits))).astype(np.float64)
+        return GameDataFrame(num_samples=m, response=y, feature_shards=shards,
+                             id_tags=id_tags)
+
+    df = make_frame(n)
+    val_df = make_frame(n_val)
+
+    opt = GLMOptimizationConfiguration(
+        optimizer=OptimizerConfig(max_iterations=40, tolerance=1e-8),
+        regularization=L2Regularization, regularization_weight=1.0)
+    configs = {"fixed": CoordinateConfiguration(
+        FixedEffectDataConfiguration("g"), opt)}
+    for cid, tag, _n_ent in res:
+        configs[cid] = CoordinateConfiguration(
+            RandomEffectDataConfiguration(tag, cid), opt)
+    seq_ids = ["fixed"] + [cid for cid, _t, _e in res]
+    est = GameEstimator(TaskType.LOGISTIC_REGRESSION, configs,
+                        update_sequence=seq_ids, num_iterations=1)
+    # warmup: ingest + compile every sequential-path program, including
+    # the validation scorer (Photon's training loop validates as it goes
+    # — the timed region below keeps that cadence: per coordinate update
+    # in sequential mode, per group boundary in parallel mode)
+    est.fit(df, validation_df=val_df)
+    coords = est._coordinates
+    vocab, _c, re_datasets = est._prep_cache[2]
+    scorer = est._build_scorer(val_df, vocab, re_datasets)
+    validation_fn = est._validation_fn(scorer, val_df)
+
+    seq_cfg = CoordinateDescentConfig(update_sequence=seq_ids,
+                                      num_iterations=sweeps)
+    par_cfg = _dc.replace(seq_cfg, parallel=True)
+    # warm the parallel-only programs (data_loss_at guard jits) off the clock
+    run_coordinate_descent(coords, _dc.replace(par_cfg, num_iterations=1), n,
+                           validation_fn=validation_fn)
+    parallel_cd.reset()
+
+    def _block(result):
+        for cid in seq_ids:
+            m = result.model[cid]
+            np.asarray(m.model.coefficients.means if cid == "fixed"
+                       else m.coefficients)
+        return result
+
+    k = 1 if quick else 3
+    t_seq, r_seq, seq_times = timed_median(
+        lambda: _block(run_coordinate_descent(
+            coords, seq_cfg, n, validation_fn=validation_fn)),
+        k=k, budget_s=300.0)
+    t_par, r_par, par_times = timed_median(
+        lambda: _block(run_coordinate_descent(
+            coords, par_cfg, n, validation_fn=validation_fn)),
+        k=k, budget_s=300.0)
+
+    # primary-validation-metric parity between the two modes (the
+    # tests assert <=1e-4 on the repo fixtures; recorded here too)
+    m_seq = validation_fn(r_seq.model)
+    m_par = validation_fn(r_par.model)
+    primary = next(iter(m_seq))
+    metric_rel = (abs(m_seq[primary] - m_par[primary])
+                  / (abs(m_seq[primary]) + 1e-12))
+
+    rel = 0.0
+    for cid in seq_ids:
+        a = np.asarray(r_seq.model[cid].model.coefficients.means
+                       if cid == "fixed" else r_seq.model[cid].coefficients)
+        b = np.asarray(r_par.model[cid].model.coefficients.means
+                       if cid == "fixed" else r_par.model[cid].coefficients)
+        rel = max(rel, float(np.max(np.abs(a - b))
+                             / (np.max(np.abs(a)) + 1e-12)))
+
+    stats = (parallel_cd.report_section() or {}).get("parallel", {})
+    fallbacks = int(stats.get("fallbacks", 0))
+    rec = {
+        "metric": "game_cd_sweep_speedup",
+        "value": round(t_seq / t_par, 3) if t_par > 0 else 0.0,
+        "unit": "x (sequential wall-clock / parallel wall-clock)",
+        "sequential_s": round(t_seq, 3),
+        "parallel_s": round(t_par, 3),
+        "sequential_runs_s": seq_times,
+        "parallel_runs_s": par_times,
+        "parallel_strictly_faster": bool(t_par < t_seq),
+        "validation_metric": {"name": primary,
+                              "sequential": m_seq[primary],
+                              "parallel": m_par[primary],
+                              "rel_diff": metric_rel},
+        "parity_max_rel_diff": rel,
+        "staleness_fallbacks": fallbacks,
+        "stale_regressions": int(stats.get("stale_regressions", 0)),
+        "groups": stats.get("groups"),
+        "groups_run": int(stats.get("groups_run", 0)),
+        "workload": {"n": n, "n_validation": n_val,
+                     "d_fixed": d_g, "d_entity": d_u,
+                     "sweeps": sweeps,
+                     "re_entities": {cid: n_ent for cid, _t, n_ent in res},
+                     "solver_max_iterations": 40},
+        "quick": quick,
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "tpu_unavailable": _STATE["tpu_unavailable"],
+    }
+    if not quick:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_GAME_CD_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"game_cd: sequential {t_seq:.3f}s vs parallel {t_par:.3f}s "
+        f"({rec['value']}x), fallbacks {fallbacks}, "
+        f"parity {rel:.2e}")
+    return rec
+
+
 # Order = on-chip capture priority (each config emits its JSON line the
 # moment it completes, so when the flaky relay dies mid-run the most
 # decision-relevant numbers are already on disk): the NEWTON flagship,
@@ -1944,9 +2125,14 @@ def main():
     ap.add_argument("--configs", default=os.environ.get("BENCH_CONFIGS", ""),
                     help="comma-separated subset of config names")
     ap.add_argument("--mode", default=os.environ.get("BENCH_MODE", "train"),
-                    choices=("train", "serving"),
+                    choices=("train", "serving", "game_cd"),
                     help="train = the solver configs (default); serving = "
-                         "the online-serving bench -> BENCH_SERVING_r01.json")
+                         "the online-serving bench -> BENCH_SERVING_r01.json; "
+                         "game_cd = parallel-vs-sequential CD sweeps "
+                         "-> BENCH_GAME_CD_r01.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="game_cd: tiny tier-1 smoke shape (one timed run "
+                         "per mode, no artifact write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -2005,6 +2191,21 @@ def main():
             emit({"metric": "serving_throughput_qps", "value": 0.0,
                   "unit": "requests/s", "error": repr(e)})
         _DONE.set()     # serving mode: the record above IS the summary
+        return
+
+    if args.mode == "game_cd":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/game_cd"):
+                emit(run_game_cd_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"game_cd bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "game_cd_sweep_speedup", "value": 0.0,
+                  "unit": "x", "error": repr(e)})
+        _DONE.set()     # game_cd mode: the record above IS the summary
         return
 
     selected = [s.strip() for s in args.configs.split(",") if s.strip()]

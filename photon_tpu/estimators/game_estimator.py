@@ -108,6 +108,11 @@ class GameEstimator:
         normalization_contexts=None,
         intercept_indices=None,
         feature_dtype=None,
+        parallel_cd: bool = False,
+        parallel_groups: Optional[List[List[str]]] = None,
+        staleness_tol: float = 1e-3,
+        staleness_ratio: float = 0.5,
+        staleness_patience: int = 2,
     ):
         """``mesh``: a `jax.sharding.Mesh` — fixed-effect batches are
         sample-sharded and random-effect entity blocks entity-sharded over
@@ -119,7 +124,12 @@ class GameEstimator:
         their solve; random effects gather it through each entity's
         projection (NormalizationContextWrapper analog). Published models
         are ALWAYS in original feature space. ``intercept_indices``:
-        {feature_shard_id: index} — required by shift-ful types."""
+        {feature_shard_id: index} — required by shift-ful types.
+
+        ``parallel_cd``: run parallel (concurrency-grouped, bounded-stale)
+        coordinate-descent sweeps; ``parallel_groups`` / ``staleness_tol``
+        / ``staleness_patience`` forward to
+        :class:`CoordinateDescentConfig` (game/descent.py)."""
         self.task = task
         self.coordinate_configs = coordinate_configs
         self.update_sequence = update_sequence or list(coordinate_configs.keys())
@@ -139,6 +149,11 @@ class GameEstimator:
         # bandwidth-bound fixed-effect solve reads half the HBM bytes
         # while solver math stays at `dtype` via in-register promotion
         self.feature_dtype = feature_dtype
+        self.parallel_cd = parallel_cd
+        self.parallel_groups = parallel_groups
+        self.staleness_tol = staleness_tol
+        self.staleness_ratio = staleness_ratio
+        self.staleness_patience = staleness_patience
         from photon_tpu.types import VarianceComputationType
         self.variance_computation_type = (
             variance_computation_type or VarianceComputationType.NONE)
@@ -265,6 +280,11 @@ class GameEstimator:
             update_sequence=self.update_sequence,
             num_iterations=self.num_iterations,
             locked_coordinates=self.locked,
+            parallel=self.parallel_cd,
+            parallel_groups=self.parallel_groups,
+            staleness_tol=self.staleness_tol,
+            staleness_ratio=self.staleness_ratio,
+            staleness_patience=self.staleness_patience,
         )
 
         validation_fn = None

@@ -69,7 +69,12 @@ Array = jax.Array
 logger = logging.getLogger(__name__)
 
 _SWEEP_PREFIX = "sweep_"
-SCHEMA_VERSION = 2
+# v3: adds ``group_boundary`` — whether a partial checkpoint's
+# ``next_coordinate`` is a parallel-mode concurrency-group boundary
+# (game/parallel_cd.py) rather than an arbitrary coordinate boundary.
+# Resume handles both (a mid-group index re-enters the group with
+# sequential semantics); v2 checkpoints load unchanged (flag False).
+SCHEMA_VERSION = 3
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -134,6 +139,8 @@ class CheckpointState:
     next_coordinate: int = 0
     scores: Optional[Dict[str, np.ndarray]] = None
     full_score: Optional[np.ndarray] = None
+    # v3: next_coordinate is a parallel concurrency-group boundary
+    group_boundary: bool = False
 
 
 def _npz_bytes(arrays: dict) -> bytes:
@@ -155,6 +162,7 @@ def save_checkpoint(
     next_coordinate: int = 0,
     scores: Optional[Dict[str, np.ndarray]] = None,
     full_score: Optional[np.ndarray] = None,
+    group_boundary: bool = False,
 ) -> str:
     """Atomically publish one checkpoint; returns its path.
 
@@ -212,6 +220,7 @@ def save_checkpoint(
                         "checksums": checksums,
                         "sweep_in_progress": sweep_in_progress,
                         "next_coordinate": next_coordinate,
+                        "group_boundary": group_boundary,
                         "score_coordinates":
                             None if scores is None else sorted(scores)}
             put("meta.json", json.dumps(meta_doc, indent=2).encode())
@@ -301,6 +310,7 @@ def load_checkpoint(path: str) -> CheckpointState:
         next_coordinate=int(meta.get("next_coordinate") or 0),
         scores=scores,
         full_score=full_score,
+        group_boundary=bool(meta.get("group_boundary", False)),
     )
 
 

@@ -145,7 +145,11 @@ class FixedEffectCoordinate:
         self, prev: Optional[FixedEffectModel], residual_scores: Optional[Array]
     ) -> FixedEffectModel:
         """Train against residual-injected offsets
-        (= dataset.addScoresToOffsets + runWithSampling)."""
+        (= dataset.addScoresToOffsets + runWithSampling).
+
+        ``residual_scores`` is either the live partial score (sequential
+        sweep) or a frozen group-entry snapshot (parallel sweep) — the
+        solve is a pure function of it either way."""
         batch = self.batch
         if residual_scores is not None:
             extra = batch.num_samples - residual_scores.shape[0]
@@ -229,6 +233,84 @@ class FixedEffectCoordinate:
         if s.shape[0] != self._n_orig:
             s = s[: self._n_orig]
         return s
+
+    @functools.cached_property
+    def _objective_value_fn(self):
+        obj = GLMObjective(loss_for_task(self.task))
+
+        def build():
+            @jax.jit
+            def value(feats, labels, offsets, weights, coef, l2):
+                return obj.value(coef, DataBatch(feats, labels, offsets,
+                                                 weights), Hyper(l2_weight=l2))
+            return value
+
+        return jitcache.get_or_build(("fe_objval", self.task), build)
+
+    def objective_value(self, model: Optional[FixedEffectModel],
+                        residual_scores: Optional[Array]) -> Optional[Array]:
+        """L2-regularized objective of ``model`` against a residual
+        snapshot, as a DEVICE scalar (no host sync — the parallel-CD
+        staleness guard sums these and reads one bool per group).
+        ``None`` when the coordinate is model-axis sharded: the guard is
+        skipped there rather than re-deriving the shard_map margin
+        machinery for a diagnostic."""
+        if self._model_sharded:
+            return None
+        batch = self.batch
+        if residual_scores is not None:
+            extra = batch.num_samples - residual_scores.shape[0]
+            if extra:  # mesh padding: zero residual on zero-weight pad rows
+                residual_scores = jnp.pad(residual_scores, (0, extra))
+            batch = batch.add_scores_to_offsets(residual_scores)
+        coef = (jnp.zeros((self.dim,), batch.labels.dtype) if model is None
+                else jnp.asarray(model.model.coefficients.means))
+        l2 = jnp.asarray(self.config.regularization.l2_weight(
+            self.config.regularization_weight), batch.labels.dtype)
+        return self._objective_value_fn(batch.features, batch.labels,
+                                        batch.offsets, batch.weights,
+                                        coef, l2)
+
+    def predicted_decrease(self, prev: Optional[FixedEffectModel],
+                           new: FixedEffectModel,
+                           residual_scores: Optional[Array]
+                           ) -> Optional[Array]:
+        """Solver-predicted objective decrease for ``prev -> new`` against
+        the FROZEN residual the solve actually saw (device scalar)."""
+        a = self.objective_value(prev, residual_scores)
+        b = self.objective_value(new, residual_scores)
+        return None if a is None or b is None else a - b
+
+    @functools.cached_property
+    def _data_loss_fn(self):
+        loss = loss_for_task(self.task)
+
+        def build():
+            @jax.jit
+            def value(labels, offsets, weights, scores):
+                l, _ = loss.loss_and_dz(offsets + scores, labels)
+                return jnp.sum(l * weights) if weights is not None \
+                    else jnp.sum(l)
+            return value
+
+        return jitcache.get_or_build(("fe_dataloss", self.task), build)
+
+    def data_loss_at(self, total_scores: Array) -> Array:
+        """Weighted GLM data loss at a TOTAL score vector (no features, no
+        regularization), as a device scalar: ``sum_i w_i * l(y_i,
+        base_offset_i + s_i)``. This is the score-space primitive of the
+        parallel-CD staleness guard: every objective difference the guard
+        needs is a difference of these at score vectors the group
+        reconciliation already materialized, so the guard costs O(n)
+        elementwise work instead of per-member feature passes (see
+        descent._run_group). Mesh pad rows carry zero weight and
+        contribute exactly 0."""
+        batch = self.batch
+        extra = batch.num_samples - total_scores.shape[0]
+        if extra:
+            total_scores = jnp.pad(total_scores, (0, extra))
+        return self._data_loss_fn(batch.labels, batch.offsets,
+                                  batch.weights, total_scores)
 
 
 class RandomEffectCoordinate:
@@ -647,6 +729,100 @@ class RandomEffectCoordinate:
         with _obs_annotate("re/score"):
             return self._score_fn(self.dataset,
                                   self._pad_entity_rows(model.coefficients))
+
+    @functools.cached_property
+    def _objective_value_fn(self):
+        obj = self.objective
+        dense_flags = self._dense_local_blocks
+
+        def build():
+            def one_core(feats, labels, offsets, weights, coef, l2):
+                return obj.value(coef, DataBatch(feats, labels, offsets,
+                                                 weights), Hyper(l2_weight=l2))
+
+            def one_sparse(feat_idx, feat_val, *rest):
+                return one_core(F.SparseFeatures(feat_idx, feat_val), *rest)
+
+            @jax.jit
+            def value_all(ds: RandomEffectDataset,
+                          residual_flat: Optional[Array],
+                          coef_block: Array, l2: Array) -> Array:
+                total = jnp.zeros((), coef_block.dtype)
+                for blk, dense in zip(ds.blocks, dense_flags):
+                    offsets = blk.offsets
+                    if residual_flat is not None:
+                        offsets = offsets + residual_flat.at[
+                            blk.sample_rows].get(mode="fill", fill_value=0.0)
+                    rows = coef_block.at[blk.entity_rows].get(
+                        mode="fill", fill_value=0.0)
+                    if dense:
+                        vals = jax.vmap(one_core,
+                                        in_axes=(0, 0, 0, 0, 0, None))(
+                            blk.features.values, blk.labels, offsets,
+                            blk.weights, rows, l2)
+                    else:
+                        vals = jax.vmap(one_sparse,
+                                        in_axes=(0, 0, 0, 0, 0, 0, None))(
+                            blk.features.indices, blk.features.values,
+                            blk.labels, offsets, blk.weights, rows, l2)
+                    total = total + jnp.sum(vals)
+                return total
+
+            return value_all
+
+        return jitcache.get_or_build(("re_objval", self.task, dense_flags),
+                                     build)
+
+    def objective_value(self, model: Optional[RandomEffectModel],
+                        residual_scores: Optional[Array]) -> Array:
+        """Sum of per-entity L2-regularized objectives against a residual
+        snapshot, as a DEVICE scalar (no host sync; see the fixed-effect
+        counterpart). Pad entities carry zero weights and zero coefficient
+        rows, so they contribute exactly 0."""
+        ds = self.dataset
+        dtype = (model.coefficients.dtype if model is not None
+                 else (ds.blocks[0].labels.dtype if ds.blocks
+                       else jnp.float32))
+        coef = (model.coefficients if model is not None
+                else jnp.zeros((ds.num_entities, ds.projected_dim), dtype))
+        coef = self._pad_entity_rows(jnp.asarray(coef))
+        l2 = jnp.asarray(self.config.regularization.l2_weight(
+            self.config.regularization_weight), coef.dtype)
+        return self._objective_value_fn(ds, residual_scores, coef, l2)
+
+    def predicted_decrease(self, prev: Optional[RandomEffectModel],
+                           new: RandomEffectModel,
+                           residual_scores: Optional[Array]) -> Array:
+        """Solver-predicted objective decrease for ``prev -> new`` against
+        the FROZEN residual the solve actually saw (device scalar)."""
+        return (self.objective_value(prev, residual_scores)
+                - self.objective_value(new, residual_scores))
+
+    @functools.cached_property
+    def _data_loss_fn(self):
+        loss = self.objective.loss
+
+        def build():
+            @jax.jit
+            def loss_all(ds: RandomEffectDataset, scores_flat: Array) -> Array:
+                total = jnp.zeros((), scores_flat.dtype)
+                for blk in ds.blocks:
+                    z = blk.offsets + scores_flat.at[blk.sample_rows].get(
+                        mode="fill", fill_value=0.0)
+                    l, _ = loss.loss_and_dz(z, blk.labels)
+                    total = total + jnp.sum(l * blk.weights)
+                return total
+            return loss_all
+
+        return jitcache.get_or_build(("re_dataloss", self.task), build)
+
+    def data_loss_at(self, total_scores: Array) -> Array:
+        """Weighted GLM data loss at a TOTAL score vector (no features, no
+        regularization), as a device scalar — the random-effect counterpart
+        of ``FixedEffectCoordinate.data_loss_at`` (the entity blocks
+        partition the sample space, so the block-sum equals the flat
+        weighted loss; pad rows carry zero weight)."""
+        return self._data_loss_fn(self.dataset, total_scores)
 
 
 def _re_score_builder(n: int, dense_flags=()):

@@ -1,0 +1,213 @@
+"""Parallel coordinate descent: concurrency groups + staleness accounting.
+
+The sequential GAME sweep (game/descent.py) updates one coordinate at a
+time, so sweep wall-clock is the SUM of per-coordinate solves. This
+module holds the host-side scheduling pieces of the parallel sweep mode
+(arXiv 1811.01564 "Parallel training of linear models without
+compromising convergence"; arXiv 1611.02101 distributed block CD):
+
+- :func:`auto_groups` — the default partition of the update sequence
+  into CONTIGUOUS concurrency groups: the fixed effect(s) stay alone,
+  consecutive random-effect coordinates merge into one group. Random
+  effects touch disjoint coefficient blocks and only couple through the
+  shared score container, which the parallel sweep freezes per group —
+  so they are the safely-concurrent set. Contiguity is load-bearing:
+  the mid-sweep checkpoint contract indexes into the flat update
+  sequence (``next_coordinate``), and contiguous groups mean every
+  group boundary IS a valid coordinate boundary for resume.
+- :func:`validate_groups` — checks a user-supplied
+  ``CoordinateDescentConfig.parallel_groups`` override covers the
+  update sequence exactly, in order.
+- run statistics (:func:`begin_run` / :func:`record_group` /
+  :func:`record_fallback` ...) feeding the RunReport ``cd.parallel``
+  section (:func:`report_section`), mirroring how serving exposes its
+  stats to obs/report.py via ``sys.modules`` — an offline sequential
+  run that never imports this module pays nothing.
+
+The actual frozen-score dispatch, reconciliation, and the staleness
+guard live in game/descent.py next to the sequential sweep they must
+stay in parity with.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# (start index in the flat update sequence, member coordinate ids).
+# Members are contiguous: group g covers update_sequence[start:start+len].
+GroupSpan = Tuple[int, List[str]]
+
+_MAX_GROUP_RECORDS = 256  # bounded per-group detail ring for the report
+
+
+def auto_groups(update_sequence: Sequence[str],
+                coordinates: Dict[str, object]) -> List[List[str]]:
+    """Default grouping by coordinate independence.
+
+    Consecutive random-effect coordinates (identified by their
+    ``random_effect_type`` attribute) form one concurrency group; every
+    other coordinate — the fixed effect(s) — is a singleton. Singleton
+    groups run with exactly the sequential arithmetic, so a sequence
+    with no adjacent random effects degenerates to sequential mode.
+    """
+    groups: List[List[str]] = []
+    run: List[str] = []
+    for cid in update_sequence:
+        if hasattr(coordinates[cid], "random_effect_type"):
+            run.append(cid)
+        else:
+            if run:
+                groups.append(run)
+                run = []
+            groups.append([cid])
+    if run:
+        groups.append(run)
+    return groups
+
+
+def validate_groups(groups: Sequence[Sequence[str]],
+                    update_sequence: Sequence[str]) -> List[List[str]]:
+    """A user override must be an in-order partition of the update
+    sequence into non-empty contiguous groups (see module docstring for
+    why contiguity is required)."""
+    out = [list(g) for g in groups]
+    if any(not g for g in out):
+        raise ValueError("parallel_groups contains an empty group")
+    flat = [cid for g in out for cid in g]
+    if flat != list(update_sequence):
+        raise ValueError(
+            f"parallel_groups must partition the update sequence in order: "
+            f"flattened groups {flat!r} != update_sequence "
+            f"{list(update_sequence)!r}")
+    return out
+
+
+def resolve_groups(config, coordinates: Dict[str, object]) -> List[GroupSpan]:
+    """Concrete (start, members) spans for this config — user override
+    when given, :func:`auto_groups` otherwise."""
+    if config.parallel_groups is not None:
+        groups = validate_groups(config.parallel_groups,
+                                 config.update_sequence)
+    else:
+        groups = auto_groups(config.update_sequence, coordinates)
+    spans: List[GroupSpan] = []
+    k = 0
+    for g in groups:
+        spans.append((k, g))
+        k += len(g)
+    return spans
+
+
+# -- run statistics (RunReport cd.parallel section) ---------------------------
+
+class _Stats:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.runs = 0
+        self.groups: List[List[str]] = []
+        self.placement: Optional[Dict[str, List[int]]] = None
+        self.groups_run = 0
+        self.concurrent_groups = 0
+        self.members_solved = 0
+        self.member_failures = 0
+        self.stale_regressions = 0
+        self.fallbacks = 0
+        self.sequentialized_groups = 0
+        self.group_records: List[Dict[str, Any]] = []
+
+
+_stats = _Stats()
+
+
+def reset() -> None:
+    """Test isolation: drop all accumulated statistics."""
+    global _stats
+    _stats = _Stats()
+
+
+def begin_run(spans: Sequence[GroupSpan],
+              placement: Optional[Dict[str, List[int]]] = None) -> None:
+    with _stats.lock:
+        _stats.runs += 1
+        _stats.groups = [list(members) for _start, members in spans]
+        if placement is not None:
+            _stats.placement = {cid: list(devs)
+                                for cid, devs in placement.items()}
+
+
+def record_group(sweep: int, group: int, size: int, committed: int,
+                 seconds: float,
+                 predicted: Optional[float] = None,
+                 realized: Optional[float] = None,
+                 regressed: bool = False,
+                 sequentialized: bool = False) -> None:
+    from photon_tpu.obs.metrics import registry
+    registry.counter("cd.parallel.groups").inc()
+    registry.counter("cd.parallel.members").inc(size)
+    with _stats.lock:
+        _stats.groups_run += 1
+        _stats.members_solved += size
+        if sequentialized:
+            _stats.sequentialized_groups += 1
+        else:
+            _stats.concurrent_groups += 1
+        if regressed:
+            _stats.stale_regressions += 1
+        rec: Dict[str, Any] = {"sweep": sweep, "group": group, "size": size,
+                               "committed": committed,
+                               "seconds": round(seconds, 6)}
+        if predicted is not None:
+            rec["predicted_decrease"] = predicted
+            rec["realized_decrease"] = realized
+            rec["stale_regression"] = regressed
+        if sequentialized:
+            rec["sequentialized"] = True
+        _stats.group_records.append(rec)
+        del _stats.group_records[:-_MAX_GROUP_RECORDS]
+    if regressed:
+        registry.counter("cd.parallel.stale_regressions").inc()
+
+
+def record_member_failure(coordinate: str, sweep: int) -> None:
+    from photon_tpu.obs.metrics import registry
+    registry.counter("cd.parallel.member_failures").inc()
+    with _stats.lock:
+        _stats.member_failures += 1
+
+
+def record_fallback(sweep: int, group: int, streak: int) -> None:
+    """Staleness tripped the convergence guard ``staleness_patience``
+    groups in a row: typed event + counter, never an exception — the
+    run continues sequentially."""
+    from photon_tpu.obs.metrics import registry
+    from photon_tpu.resilience import failures
+    registry.counter("cd.parallel.fallbacks").inc()
+    failures.record_failure("parallel_staleness_fallback", sweep=sweep,
+                            group=group, consecutive_regressions=streak)
+    with _stats.lock:
+        _stats.fallbacks += 1
+
+
+def report_section() -> Optional[Dict[str, Any]]:
+    """The RunReport ``cd`` section (obs/report.py reads it via
+    ``sys.modules`` so sequential-only processes pay nothing). ``None``
+    until a parallel run actually started."""
+    with _stats.lock:
+        if _stats.runs == 0:
+            return None
+        section: Dict[str, Any] = {
+            "runs": _stats.runs,
+            "groups": [list(g) for g in _stats.groups],
+            "groups_run": _stats.groups_run,
+            "concurrent_groups": _stats.concurrent_groups,
+            "sequentialized_groups": _stats.sequentialized_groups,
+            "members_solved": _stats.members_solved,
+            "member_failures": _stats.member_failures,
+            "stale_regressions": _stats.stale_regressions,
+            "fallbacks": _stats.fallbacks,
+            "group_records": list(_stats.group_records),
+        }
+        if _stats.placement is not None:
+            section["placement"] = dict(_stats.placement)
+    return {"parallel": section}

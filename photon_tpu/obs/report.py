@@ -85,6 +85,9 @@ def build_run_report(driver: str,
     serving = _serving_section()
     if serving is not None:
         report["serving"] = serving
+    cd = _cd_section()
+    if cd is not None:
+        report["cd"] = cd
     if extra:
         report["extra"] = extra
     return report
@@ -100,6 +103,20 @@ def _serving_section() -> Optional[Dict[str, Any]]:
         return None
     try:
         return mod.serving_report_section()
+    except Exception:  # noqa: BLE001 — reporting must not kill a run
+        return None
+
+
+def _cd_section() -> Optional[Dict[str, Any]]:
+    """Parallel coordinate-descent statistics (group/staleness/fallback
+    accounting), when this process ran a parallel sweep. Same
+    ``sys.modules`` pattern as :func:`_serving_section` — sequential-only
+    and non-training processes pay nothing."""
+    mod = sys.modules.get("photon_tpu.game.parallel_cd")
+    if mod is None:
+        return None
+    try:
+        return mod.report_section()
     except Exception:  # noqa: BLE001 — reporting must not kill a run
         return None
 
@@ -223,4 +240,17 @@ def validate_run_report(report: Dict[str, Any]) -> List[str]:
                             errors.append(f"serving.swap missing {k!r}")
                     if not isinstance(swap.get("history", []), list):
                         errors.append("serving.swap history must be a list")
+    if "cd" in report:  # optional: only parallel-CD training processes
+        cd = report["cd"]
+        if not isinstance(cd, dict) or not isinstance(
+                cd.get("parallel"), dict):
+            errors.append("cd must be {'parallel': {...}}")
+        else:
+            par = cd["parallel"]
+            for k in ("runs", "groups", "groups_run", "members_solved",
+                      "stale_regressions", "fallbacks", "group_records"):
+                if k not in par:
+                    errors.append(f"cd.parallel missing {k!r}")
+            if not isinstance(par.get("group_records", []), list):
+                errors.append("cd.parallel group_records must be a list")
     return errors

@@ -31,7 +31,7 @@ aggregator (every per-sample term is multiplied by its weight) or metric
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -475,6 +475,32 @@ def shard_sparse_features_model_parallel(
     return DataBatch(features=feats, labels=put_vec(batch.labels),
                      offsets=put_vec(batch.offsets),
                      weights=put_vec(batch.weights))
+
+
+def plan_group_placement(members: Sequence[str],
+                         mesh: Mesh) -> Dict[str, List[int]]:
+    """Disjoint device subsets for one parallel-CD concurrency group:
+    the mesh's devices are split into ``len(members)`` contiguous
+    near-equal chunks (update-sequence order), so concurrent member
+    solves target non-overlapping hardware. Returns coordinate id ->
+    device ids; a member's list is empty when there are more members
+    than devices (it shares by time-slicing instead).
+
+    This is the host-side PLAN recorded in the RunReport ``cd.parallel``
+    section. Actually re-placing each coordinate's construction-time
+    sharded arrays onto its subset needs a live multi-chip topology to
+    validate against and stays open (ROADMAP: mesh placement on real TPU
+    topology); on a single host the overlap comes from async dispatch.
+    """
+    devs = [int(getattr(d, "id", i))
+            for i, d in enumerate(mesh.devices.flat)]
+    n, m = len(devs), len(members)
+    plan: Dict[str, List[int]] = {}
+    for i, cid in enumerate(members):
+        lo = (i * n) // m
+        hi = ((i + 1) * n) // m
+        plan[cid] = devs[lo:hi]
+    return plan
 
 
 def mesh_topology(mesh: Optional[Mesh] = None) -> dict:

@@ -16,6 +16,10 @@ hook points consult it:
   between tmp-write and rename; a hit raises ``SimulatedKill``, which
   deliberately bypasses tmp cleanup so the partial state stays on disk
   exactly as a real SIGKILL would leave it.
+- ``straggler_delay(coordinate, sweep)`` — game/descent.py's parallel
+  sweep asks in each group member's worker thread; a hit sleeps that
+  member's solve, making it a straggler inside its concurrency group
+  while the other members keep overlapping.
 - ``scorer_delay()`` — serving/engine.py asks inside the scorer stage;
   returns seconds to sleep for the first ``scorer_delay_batches``
   batches, driving the serving circuit breaker's latency trip.
@@ -72,6 +76,11 @@ class ChaosConfig:
     scorer_delay_batches: int = 0
     # serving: NaN-poison the next loaded swap candidate's coefficients
     swap_poison_nan: bool = False
+    # parallel CD: (coordinate id, sweep) whose group-member solve sleeps
+    # straggler_delay_s before dispatch — a straggler inside a
+    # concurrency group (fires once)
+    straggler_at: Optional[Tuple[str, int]] = None
+    straggler_delay_s: float = 0.0
 
 
 class _State:
@@ -84,6 +93,7 @@ class _State:
         self.kill_fired = False
         self.preempt_fired = False
         self.scorer_delays_done = 0
+        self.straggler_fired = False
 
 
 _active: Optional[_State] = None
@@ -166,6 +176,22 @@ def scorer_delay() -> float:
             return 0.0
         s.scorer_delays_done += 1
     return s.config.scorer_delay_s
+
+
+def straggler_delay(coordinate: str, sweep: int) -> float:
+    """Seconds this parallel-group member should sleep before its solve
+    (0 when inactive / not the configured member / already fired). Real
+    wall time, in the member's worker thread — the group's other members
+    must keep overlapping while this one lags."""
+    s = _active
+    if (s is None or s.config.straggler_at is None
+            or s.config.straggler_delay_s <= 0):
+        return 0.0
+    with s.lock:
+        if s.straggler_fired or s.config.straggler_at != (coordinate, sweep):
+            return 0.0
+        s.straggler_fired = True
+    return s.config.straggler_delay_s
 
 
 def should_poison_swap_candidate() -> bool:

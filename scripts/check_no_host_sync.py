@@ -10,12 +10,17 @@ round-trip per iteration and silently serialize every solve; a
 ``.block_until_ready`` in solver code would stall the dispatch pipeline.
 
 This script walks ``photon_tpu/optim/`` (plus ``photon_tpu/game/``,
-which drives the jitted solves) with an AST visitor and fails — with
-file:line — on any of:
+which drives the jitted solves — including the parallel-sweep scheduler
+in ``game/descent.py`` / ``game/parallel_cd.py``, whose worker threads
+must dispatch solves asynchronously: one blocking transfer inside a
+group member would serialize the whole concurrency group) with an AST
+visitor and fails — with file:line — on any of:
 
   * ``jax.debug.callback`` / ``jax.debug.print``
   * ``io_callback`` / ``jax.experimental.io_callback`` / ``pure_callback``
   * ``<expr>.block_until_ready(...)``
+  * ``jax.device_get`` (an eager full-tree transfer; boundary-time host
+    reads spell themselves ``np.asarray`` at a coordinate/group boundary)
 
 Escape hatch for genuinely host-side helpers (NOT loop bodies): put the
 marker comment ``host-sync-ok`` on the offending line.
@@ -49,6 +54,7 @@ BANNED_PATHS = (
     ("debug", "callback"),
     ("debug", "print"),
     ("experimental", "io_callback"),
+    ("jax", "device_get"),
 )
 
 
