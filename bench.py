@@ -1917,6 +1917,251 @@ def run_serving_bench(scale: float):
 
 
 # --------------------------------------------------------------------------
+# coldtier mode: --mode coldtier -> BENCH_COLDTIER_r01.json
+# --------------------------------------------------------------------------
+
+def run_coldtier_bench(scale: float, quick: bool = False):
+    """Two-tier coefficient store benchmark (ISSUE 8): serve a
+    10M-entity random effect from a hot-set gather cache holding <=2% of
+    the coefficients in device memory, cold tier mmap-backed on host.
+    Zipf-distributed traffic (alpha=1.5) is driven through a warm phase
+    (prefetch promotes the hot set) and a measured steady phase; the
+    bench records the steady-state hit rate (target >=0.95), the
+    single-request p99 against a 100k-entity FULL-RESIDENT baseline
+    (target <=3x), hot-row score parity against the host oracle
+    (<=1e-6), and the three zero-compile monitors across the steady
+    phase.
+
+    ``quick`` is the tier-1 smoke shape: 2k entities, capacity 256, no
+    artifact write (the committed BENCH_COLDTIER_r01.json only ever
+    comes from a full run)."""
+    import tempfile
+
+    import jax
+
+    from photon_tpu.io.cold_store import write_cold_store
+    from photon_tpu.io.index_map import IndexMap, feature_key
+    from photon_tpu.io.model_io import (
+        ServingFixedEffect,
+        ServingGameModel,
+        ServingRandomEffect,
+    )
+    from photon_tpu.obs.metrics import registry as _registry
+    from photon_tpu.serving import (
+        CoeffStoreConfig,
+        DeviceResidentModel,
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+    )
+    from photon_tpu.types import TaskType
+    from photon_tpu.utils import compile_cache
+
+    if quick:
+        E, K, d_global = 2_000, 2, 32
+        hot_capacity, transfer_batch = 256, 64
+        n_warm, n_steady, n_probe = 400, 600, 50
+        E_base = 500
+    else:
+        E, K, d_global = int(10_000_000 * scale) or 1000, 2, 64
+        hot_capacity, transfer_batch = 131_072, 1024
+        n_warm, n_steady, n_probe = 8_000, 20_000, 200
+        E_base = 100_000
+    rng = np.random.default_rng(13)
+
+    # -- cold store: E rows, fixed-width ids, vectorized write ------------
+    t0 = time.perf_counter()
+    ids = np.char.add(b"e", np.char.zfill(
+        np.arange(E).astype("S9"), 9))       # b'e000000000'.. sorted
+    coef = rng.normal(size=(E, K)).astype(np.float32)
+    lo = rng.integers(0, d_global - 1, size=E)
+    hi = rng.integers(lo + 1, d_global)
+    proj = np.stack([lo, hi], axis=1).astype(np.int32)
+    tdir = tempfile.mkdtemp(prefix="coldtier_bench_")
+    cold_path = os.path.join(tdir, "per_user.coldstore")
+    write_cold_store(cold_path, "per_user", "userId", "g",
+                     coef, proj, ids)
+    gen_s = time.perf_counter() - t0
+    cold_bytes = os.path.getsize(cold_path)
+
+    names = [f"g{j}" for j in range(d_global)]
+    imap = IndexMap({feature_key(n, ""): i for i, n in enumerate(names)})
+    theta = rng.normal(size=d_global).astype(np.float32)
+
+    def build_engine(two_tier: bool, n_entities: int):
+        if two_tier:
+            re = ServingRandomEffect("per_user", "userId", "g",
+                                     cold_store_path=cold_path)
+            cs_cfg = CoeffStoreConfig(hot_capacity=hot_capacity,
+                                      transfer_batch=transfer_batch)
+        else:
+            re = ServingRandomEffect(
+                "per_user", "userId", "g", coef[:n_entities], proj[:n_entities],
+                {ids[e].decode(): e for e in range(n_entities)})
+            cs_cfg = None
+        m = ServingGameModel(
+            TaskType.LINEAR_REGRESSION,
+            [ServingFixedEffect("fixed", "g", theta)], [re], {"g": imap}, {})
+        model = DeviceResidentModel(m, coeff_store=cs_cfg)
+        eng = ServingEngine(model, ServingConfig(
+            max_batch=64, max_wait_s=0.001, coeff_store=cs_cfg))
+        return eng, eng.warmup()
+
+    engine, winfo = build_engine(True, E)
+    log(f"coldtier: {E} entities, cold {cold_bytes / 1e6:.0f}MB written in "
+        f"{gen_s:.1f}s, warmed {winfo['programs']} programs")
+    store_stats = lambda: next(iter(
+        engine.model.coeff_store_stats().values()))
+    hot_bytes = store_stats()["hot_bytes"]
+    hot_fraction = hot_bytes / max(coef.nbytes, 1)
+
+    nnz = 16
+    zipf_rows = (rng.zipf(1.5, size=n_warm + n_steady + 4 * n_probe) - 1) % E
+
+    def make_request(i, row):
+        cols = rng.choice(d_global, size=nnz, replace=False)
+        return ScoreRequest(
+            f"q{i}", {"g": [(names[c], "", float(rng.normal()))
+                            for c in cols]},
+            {"userId": ids[row].decode()})
+
+    # -- warm phase: traffic promotes the Zipf head through prefetch ------
+    t0 = time.perf_counter()
+    for i in range(n_warm):
+        engine.submit(make_request(i, zipf_rows[i]))
+        if i % 256 == 255:
+            engine.pump()
+    engine.drain()
+    engine.model.drain_prefetch()
+    warm_s = time.perf_counter() - t0
+    st_warm = store_stats()
+
+    # -- steady phase: hit rate + the three zero-compile monitors ---------
+    from photon_tpu.serving.scorer import MODES, get_scorer
+    programs = [get_scorer(engine.model, mode, b)
+                for mode in MODES for b in engine.ladder.buckets]
+    jitted = [p if hasattr(p, "_cache_size")
+              else getattr(p, "__wrapped__", p) for p in programs]
+    jitted = [f for f in jitted if hasattr(f, "_cache_size")]
+    compiles0 = compile_cache.compile_counts()
+    misses0 = _registry.counter("jitcache.misses").value
+    traces0 = [f._cache_size() for f in jitted]
+    hits0, cm0 = st_warm["hits"], st_warm["cold_misses"]
+
+    t0 = time.perf_counter()
+    done = 0
+    for i in range(n_steady):
+        engine.submit(make_request(n_warm + i, zipf_rows[n_warm + i]))
+        done += len(engine.pump())
+        if i % 1024 == 1023:
+            engine.model.drain_prefetch()  # keep promoting the tail
+    done += len(engine.drain())
+    engine.model.drain_prefetch()
+    steady_s = time.perf_counter() - t0
+    st = store_stats()
+    lookups = (st["hits"] - hits0) + (st["cold_misses"] - cm0)
+    hit_rate = (st["hits"] - hits0) / max(lookups, 1)
+
+    compiles1 = compile_cache.compile_counts()
+    misses1 = _registry.counter("jitcache.misses").value
+    traces1 = [f._cache_size() for f in jitted]
+    zero_compiles = (
+        compiles1["steady_state"] == compiles0["steady_state"]
+        and misses1 == misses0
+        and all(t1 <= t0 for t0, t1 in zip(traces0, traces1)))
+
+    # -- single-request p99: two-tier (hot) vs full-resident baseline -----
+    def probe(eng, offset):
+        lat = []
+        for i in range(n_probe):
+            r = make_request(100_000_000 + offset + i,
+                             zipf_rows[n_warm + n_steady + offset + i])
+            t = time.perf_counter()
+            eng.serve([r])
+            lat.append(time.perf_counter() - t)
+        return (float(np.percentile(lat, 50)), float(np.percentile(lat, 99)))
+
+    p50_tt, p99_tt = probe(engine, 0)
+    base_engine, _ = build_engine(False, E_base)
+    base_rows = zipf_rows % E_base      # same shape, in-range entities
+    zipf_rows = base_rows               # probe() reads zipf_rows
+    p50_base, p99_base = probe(base_engine, n_probe)
+    p99_ratio = p99_tt / max(p99_base, 1e-9)
+
+    # -- hot parity: served score vs host oracle --------------------------
+    hot_row = int(np.argmax(np.bincount(
+        (rng.zipf(1.5, size=512) - 1) % E)))  # a Zipf-head row, surely hot
+    cols = list(range(nnz))
+    vals = rng.normal(size=nnz)
+    preq = ScoreRequest("parity", {"g": [(names[c], "", float(vals[j]))
+                                         for j, c in enumerate(cols)]},
+                        {"userId": ids[hot_row].decode()})
+    engine.serve([preq])                # promote if somehow cold
+    engine.model.drain_prefetch()
+    resp = engine.serve([preq])[0]
+    x = np.zeros(d_global, np.float32)
+    x[cols] = vals.astype(np.float32)
+    oracle = float(x @ theta) + float(
+        sum(coef[hot_row, k] * x[proj[hot_row, k]] for k in range(K)))
+    parity_err = abs(resp.score - oracle)
+    parity_ok = parity_err <= 1e-6 and not resp.fallbacks
+
+    compiles = compile_cache.compile_counts()
+    rec = {
+        "metric": "coldtier_steady_hit_rate",
+        "value": round(hit_rate, 4),
+        "unit": "fraction",
+        "hit_rate_target": 0.95,
+        "entities": E,
+        "slot_width": K,
+        "hot_capacity": store_stats()["capacity"],
+        "hot_budget_fraction": round(hot_fraction, 4),
+        "hot_budget_target": 0.02,
+        "cold_store_bytes": cold_bytes,
+        "hot_bytes": hot_bytes,
+        "store": {k: st[k] for k in ("hits", "cold_misses", "promotes",
+                                     "evictions", "occupancy", "transfers")},
+        "warm_requests": n_warm,
+        "warm_seconds": round(warm_s, 3),
+        "steady_requests": done,
+        "steady_seconds": round(steady_s, 3),
+        "steady_qps": round(done / max(steady_s, 1e-9), 1),
+        "single_request_p50_s": round(p50_tt, 6),
+        "single_request_p99_s": round(p99_tt, 6),
+        "baseline_entities": E_base,
+        "baseline_p50_s": round(p50_base, 6),
+        "baseline_p99_s": round(p99_base, 6),
+        "p99_vs_full_resident": round(p99_ratio, 3),
+        "p99_target_max": 3.0,
+        "hot_parity_abs_err": parity_err,
+        "hot_parity_ok": parity_ok,
+        "zero_steady_state_compiles": zero_compiles,
+        "compile_counts": compiles,
+        "generation_seconds": round(gen_s, 3),
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "tpu_unavailable": _STATE["tpu_unavailable"],
+        "quick": quick,
+    }
+    engine.shutdown()
+    base_engine.shutdown()
+    try:
+        import shutil as _sh
+        _sh.rmtree(tdir, ignore_errors=True)
+    except Exception:
+        pass
+    if not quick:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_COLDTIER_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"coldtier: hit rate {hit_rate:.3f}, p99 {p99_tt * 1e3:.2f}ms "
+        f"({p99_ratio:.2f}x full-resident), parity {parity_err:.2e}, "
+        f"steady compiles frozen={zero_compiles}")
+    return rec
+
+
+# --------------------------------------------------------------------------
 # game_cd mode: --mode game_cd -> BENCH_GAME_CD_r01.json
 # --------------------------------------------------------------------------
 
@@ -2125,14 +2370,16 @@ def main():
     ap.add_argument("--configs", default=os.environ.get("BENCH_CONFIGS", ""),
                     help="comma-separated subset of config names")
     ap.add_argument("--mode", default=os.environ.get("BENCH_MODE", "train"),
-                    choices=("train", "serving", "game_cd"),
+                    choices=("train", "serving", "game_cd", "coldtier"),
                     help="train = the solver configs (default); serving = "
                          "the online-serving bench -> BENCH_SERVING_r01.json; "
                          "game_cd = parallel-vs-sequential CD sweeps "
-                         "-> BENCH_GAME_CD_r01.json")
+                         "-> BENCH_GAME_CD_r01.json; coldtier = two-tier "
+                         "coefficient store under Zipf traffic "
+                         "-> BENCH_COLDTIER_r01.json")
     ap.add_argument("--quick", action="store_true",
-                    help="game_cd: tiny tier-1 smoke shape (one timed run "
-                         "per mode, no artifact write)")
+                    help="game_cd/coldtier: tiny tier-1 smoke shape "
+                         "(no artifact write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -2191,6 +2438,21 @@ def main():
             emit({"metric": "serving_throughput_qps", "value": 0.0,
                   "unit": "requests/s", "error": repr(e)})
         _DONE.set()     # serving mode: the record above IS the summary
+        return
+
+    if args.mode == "coldtier":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/coldtier"):
+                emit(run_coldtier_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"coldtier bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "coldtier_steady_hit_rate", "value": 0.0,
+                  "unit": "fraction", "error": repr(e)})
+        _DONE.set()     # coldtier mode: the record above IS the summary
         return
 
     if args.mode == "game_cd":

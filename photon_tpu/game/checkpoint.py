@@ -74,7 +74,15 @@ _SWEEP_PREFIX = "sweep_"
 # (game/parallel_cd.py) rather than an arbitrary coordinate boundary.
 # Resume handles both (a mid-group index re-enters the group with
 # sequential semantics); v2 checkpoints load unchanged (flag False).
-SCHEMA_VERSION = 3
+# v4: adds ``re_block_cursor`` — per-coordinate next-block index for a
+# random effect whose BLOCKED update (coordinate.update_model_blocked,
+# cold-tier streaming) was mid-stream at preemption. The partial
+# checkpoint's model arrays for that coordinate hold the host table as
+# of the cursor (solved blocks fresh, later blocks still warm-start);
+# resume re-enters update_model_blocked(start_block=cursor,
+# warm_start=checkpointed coefficients). v2/v3 checkpoints load
+# unchanged (empty cursor map).
+SCHEMA_VERSION = 4
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -141,6 +149,9 @@ class CheckpointState:
     full_score: Optional[np.ndarray] = None
     # v3: next_coordinate is a parallel concurrency-group boundary
     group_boundary: bool = False
+    # v4: coordinate id -> next block index of a mid-stream blocked
+    # random-effect update (empty when no blocked update was in flight)
+    re_block_cursor: Dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 def _npz_bytes(arrays: dict) -> bytes:
@@ -163,6 +174,7 @@ def save_checkpoint(
     scores: Optional[Dict[str, np.ndarray]] = None,
     full_score: Optional[np.ndarray] = None,
     group_boundary: bool = False,
+    re_block_cursor: Optional[Dict[str, int]] = None,
 ) -> str:
     """Atomically publish one checkpoint; returns its path.
 
@@ -221,6 +233,7 @@ def save_checkpoint(
                         "sweep_in_progress": sweep_in_progress,
                         "next_coordinate": next_coordinate,
                         "group_boundary": group_boundary,
+                        "re_block_cursor": re_block_cursor or {},
                         "score_coordinates":
                             None if scores is None else sorted(scores)}
             put("meta.json", json.dumps(meta_doc, indent=2).encode())
@@ -311,6 +324,8 @@ def load_checkpoint(path: str) -> CheckpointState:
         scores=scores,
         full_score=full_score,
         group_boundary=bool(meta.get("group_boundary", False)),
+        re_block_cursor={k: int(v) for k, v in
+                         (meta.get("re_block_cursor") or {}).items()},
     )
 
 

@@ -29,7 +29,7 @@ from photon_tpu.data.dataset import DataBatch
 from photon_tpu.data.sampling import maybe_downsample
 from photon_tpu.function.objective import GLMObjective, Hyper
 from photon_tpu.game.model import FixedEffectModel, RandomEffectModel
-from photon_tpu.game.random_effect import RandomEffectDataset
+from photon_tpu.game.random_effect import EntityBlock, RandomEffectDataset
 from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
 from photon_tpu.ops import features as F
 from photon_tpu.ops.losses import loss_for_task
@@ -420,16 +420,12 @@ class RandomEffectCoordinate:
             flags.append(bool(np.all((val == 0) | idx_ok)))
         return tuple(flags)
 
-    @functools.cached_property
-    def _solve_fn(self):
-        obj = self.objective
+    def _validate_solver(self) -> None:
         opt = self.config.optimizer
-        solver_cfg = opt.solver_config()
-        opt_type = opt.optimizer_type
-        if opt_type == OptimizerType.DIRECT:
+        if opt.optimizer_type == OptimizerType.DIRECT:
             from photon_tpu.optim.problem import _validate_direct
             _validate_direct(self.task, opt, self.config.regularization)
-        if opt_type == OptimizerType.NEWTON:
+        if opt.optimizer_type == OptimizerType.NEWTON:
             from photon_tpu.optim.problem import _validate_newton
             _validate_newton(self.task, opt, self.config.regularization)
             if (opt.explicit_hessian is not True
@@ -443,94 +439,111 @@ class RandomEffectCoordinate:
                     f"dim {self.dataset.projected_dim} > 64 would dwarf the "
                     f"data. Use TRON (matrix-free above K=64) or set "
                     f"explicit_hessian=True to override")
+
+    def _make_entity_solvers(self):
+        """(solve_sparse, solve_dense): one entity's local solve, shared
+        by the all-at-once program (``_solve_fn``) and the sequential
+        blocked program (``_block_solve_fn``)."""
+        obj = self.objective
+        opt = self.config.optimizer
+        solver_cfg = opt.solver_config()
+        opt_type = opt.optimizer_type
+        from photon_tpu.ops.normalization import NormalizationContext
+
+        def solve_core(feats, labels, offsets, weights, x0,
+                       l2, l1, f_row=None, s_row=None, islot=None):
+            batch = DataBatch(feats, labels, offsets, weights)
+            hyper = Hyper(l2_weight=l2)
+            if f_row is not None:
+                # per-entity transformed space (NormalizationContext
+                # Wrapper analog); x0/coef cross the boundary via the
+                # margin-invariant maps, islot the dynamic intercept slot
+                ctx = NormalizationContext(f_row, s_row)
+                obj_e = GLMObjective(obj.loss, ctx)
+                x0 = ctx.model_to_transformed_space(
+                    x0, islot if s_row is not None else None)
+            else:
+                obj_e = obj
+            vg = lambda c: obj_e.value_and_gradient(c, batch, hyper)
+            if opt_type == OptimizerType.DIRECT:
+                # one [K, K] normal-equations solve per entity; under
+                # vmap this is a single batched [E, K, K] Cholesky
+                # (optim/direct.py) — no sequential iterations at all
+                from photon_tpu.optim import direct
+                r = direct.minimize(
+                    vg, lambda c: obj_e.hessian_matrix(c, batch, hyper),
+                    x0)
+            elif opt_type == OptimizerType.NEWTON:
+                # damped Newton/IRLS: DIRECT's [E, K, K] batched
+                # Cholesky machinery for logistic/Poisson — a handful
+                # of outer iterations, each one batched weighted-Gram
+                # contraction + factorization, zero inner CG
+                # (optim/newton.py; replaces per-entity iterative TRON,
+                # SingleNodeOptimizationProblem.scala:40)
+                from photon_tpu.optim import newton
+                K = x0.shape[0]
+                r = newton.minimize(
+                    vg,
+                    lambda c: obj_e.hessian_matrix_from_weights(
+                        obj_e.hessian_weights(c, batch), K, batch,
+                        hyper),
+                    x0, config=solver_cfg)
+            elif opt_type == OptimizerType.OWLQN:
+                r = owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
+            elif opt_type == OptimizerType.TRON:
+                # explicit K x K Gauss-Newton per outer iteration when
+                # the per-entity dim is small (the common projected
+                # case): under vmap it becomes one batched [E, K, K]
+                # contraction (MXU) and CG touches no sample data.
+                # IDENTITY projectors / fat entities keep the
+                # matrix-free operator — an [E, K, K] block at large K
+                # would dwarf the data itself. opt.explicit_hessian
+                # overrides, mirroring the fixed-effect gate
+                # (optim/problem.py).
+                K = x0.shape[0]
+                explicit = opt.explicit_hessian
+                if explicit is None:
+                    explicit = K <= 64
+                if explicit:
+                    hs = lambda c: obj_e.hessian_matrix_from_weights(
+                        obj_e.hessian_weights(c, batch), K, batch, hyper)
+                    ha = lambda h, v: h @ v
+                else:
+                    hs = lambda c: obj_e.hessian_weights(c, batch)
+                    ha = lambda d2, v: obj_e.hessian_vector_from_weights(
+                        d2, v, batch, hyper)
+                r = tron.minimize(vg, None, x0, config=solver_cfg,
+                                  hess_setup=hs, hess_apply=ha)
+            else:
+                r = lbfgs.minimize(vg, x0, config=solver_cfg)
+            coef = r.coef
+            if f_row is not None:
+                coef = ctx.transformed_space_to_model(
+                    coef, islot if s_row is not None else None)
+            fail = (jnp.asarray(0, jnp.int32) if r.failure is None
+                    else r.failure)
+            return coef, r.iterations, r.reason, fail
+
+        def solve_sparse(feat_idx, feat_val, *rest):
+            return solve_core(F.SparseFeatures(feat_idx, feat_val), *rest)
+
+        def solve_dense(feat_val, *rest):
+            # dense-local block: ELL slot == local index everywhere,
+            # so values ARE the entity's dense [S, K] design matrix
+            return solve_core(feat_val, *rest)
+
+        return solve_sparse, solve_dense
+
+    @functools.cached_property
+    def _solve_fn(self):
+        self._validate_solver()
+        opt = self.config.optimizer
         dense_flags = self._dense_local_blocks
         has_norm = self._norm_local is not None
         has_shifts = has_norm and self._norm_local[1] is not None
 
         def build():
-            from photon_tpu.ops.normalization import NormalizationContext
-
-            def solve_core(feats, labels, offsets, weights, x0,
-                           l2, l1, f_row=None, s_row=None, islot=None):
-                batch = DataBatch(feats, labels, offsets, weights)
-                hyper = Hyper(l2_weight=l2)
-                if f_row is not None:
-                    # per-entity transformed space (NormalizationContext
-                    # Wrapper analog); x0/coef cross the boundary via the
-                    # margin-invariant maps, islot the dynamic intercept slot
-                    ctx = NormalizationContext(f_row, s_row)
-                    obj_e = GLMObjective(obj.loss, ctx)
-                    x0 = ctx.model_to_transformed_space(
-                        x0, islot if s_row is not None else None)
-                else:
-                    obj_e = obj
-                vg = lambda c: obj_e.value_and_gradient(c, batch, hyper)
-                if opt_type == OptimizerType.DIRECT:
-                    # one [K, K] normal-equations solve per entity; under
-                    # vmap this is a single batched [E, K, K] Cholesky
-                    # (optim/direct.py) — no sequential iterations at all
-                    from photon_tpu.optim import direct
-                    r = direct.minimize(
-                        vg, lambda c: obj_e.hessian_matrix(c, batch, hyper),
-                        x0)
-                elif opt_type == OptimizerType.NEWTON:
-                    # damped Newton/IRLS: DIRECT's [E, K, K] batched
-                    # Cholesky machinery for logistic/Poisson — a handful
-                    # of outer iterations, each one batched weighted-Gram
-                    # contraction + factorization, zero inner CG
-                    # (optim/newton.py; replaces per-entity iterative TRON,
-                    # SingleNodeOptimizationProblem.scala:40)
-                    from photon_tpu.optim import newton
-                    K = x0.shape[0]
-                    r = newton.minimize(
-                        vg,
-                        lambda c: obj_e.hessian_matrix_from_weights(
-                            obj_e.hessian_weights(c, batch), K, batch,
-                            hyper),
-                        x0, config=solver_cfg)
-                elif opt_type == OptimizerType.OWLQN:
-                    r = owlqn.minimize(vg, x0, l1_weight=l1, config=solver_cfg)
-                elif opt_type == OptimizerType.TRON:
-                    # explicit K x K Gauss-Newton per outer iteration when
-                    # the per-entity dim is small (the common projected
-                    # case): under vmap it becomes one batched [E, K, K]
-                    # contraction (MXU) and CG touches no sample data.
-                    # IDENTITY projectors / fat entities keep the
-                    # matrix-free operator — an [E, K, K] block at large K
-                    # would dwarf the data itself. opt.explicit_hessian
-                    # overrides, mirroring the fixed-effect gate
-                    # (optim/problem.py).
-                    K = x0.shape[0]
-                    explicit = opt.explicit_hessian
-                    if explicit is None:
-                        explicit = K <= 64
-                    if explicit:
-                        hs = lambda c: obj_e.hessian_matrix_from_weights(
-                            obj_e.hessian_weights(c, batch), K, batch, hyper)
-                        ha = lambda h, v: h @ v
-                    else:
-                        hs = lambda c: obj_e.hessian_weights(c, batch)
-                        ha = lambda d2, v: obj_e.hessian_vector_from_weights(
-                            d2, v, batch, hyper)
-                    r = tron.minimize(vg, None, x0, config=solver_cfg,
-                                      hess_setup=hs, hess_apply=ha)
-                else:
-                    r = lbfgs.minimize(vg, x0, config=solver_cfg)
-                coef = r.coef
-                if f_row is not None:
-                    coef = ctx.transformed_space_to_model(
-                        coef, islot if s_row is not None else None)
-                fail = (jnp.asarray(0, jnp.int32) if r.failure is None
-                        else r.failure)
-                return coef, r.iterations, r.reason, fail
-
-            def solve_sparse(feat_idx, feat_val, *rest):
-                return solve_core(F.SparseFeatures(feat_idx, feat_val), *rest)
-
-            def solve_dense(feat_val, *rest):
-                # dense-local block: ELL slot == local index everywhere,
-                # so values ARE the entity's dense [S, K] design matrix
-                return solve_core(feat_val, *rest)
+            solve_sparse, solve_dense = self._make_entity_solvers()
 
             # the dataset enters as a pytree argument, never a closure (a
             # closed-over array would be baked into the HLO as a constant);
@@ -654,6 +667,172 @@ class RandomEffectCoordinate:
             feature_shard_id=self.feature_shard_id,
             task=self.task,
             variances=variances,
+        )
+
+    def _block_solve_fn(self, dense: bool):
+        """One size bucket's per-entity solves as a standalone program —
+        the streaming unit of ``update_model_blocked``. Two cached
+        programs per coordinate config (dense / sparse block), reused
+        across every block of that flavor."""
+        self._validate_solver()
+        opt = self.config.optimizer
+        has_norm = self._norm_local is not None
+        has_shifts = has_norm and self._norm_local[1] is not None
+
+        def build():
+            solve_sparse, solve_dense = self._make_entity_solvers()
+
+            @jax.jit
+            def solve_block(blk: EntityBlock, residual_flat: Optional[Array],
+                            x0: Array, l2: Array, l1: Array,
+                            norm_f: Optional[Array] = None,
+                            norm_s: Optional[Array] = None,
+                            norm_islot: Optional[Array] = None):
+                offsets = blk.offsets
+                if residual_flat is not None:
+                    offsets = offsets + residual_flat.at[blk.sample_rows].get(
+                        mode="fill", fill_value=0.0)
+                if dense:
+                    fn = solve_dense
+                    args = [blk.features.values,
+                            blk.labels, offsets, blk.weights, x0, l2, l1]
+                    axes = [0, 0, 0, 0, 0, None, None]
+                else:
+                    fn = solve_sparse
+                    args = [blk.features.indices, blk.features.values,
+                            blk.labels, offsets, blk.weights, x0, l2, l1]
+                    axes = [0, 0, 0, 0, 0, 0, None, None]
+                if norm_f is not None:
+                    args.append(norm_f.at[blk.entity_rows].get(
+                        mode="fill", fill_value=1.0))
+                    axes.append(0)
+                    if norm_s is not None:
+                        args.append(norm_s.at[blk.entity_rows].get(
+                            mode="fill", fill_value=0.0))
+                        args.append(norm_islot.at[blk.entity_rows].get(
+                            mode="fill", fill_value=-1))
+                        axes.extend([0, 0])
+                solved, it_b, reason_b, fail_b = jax.vmap(
+                    fn, in_axes=tuple(axes))(*args)
+                solved = jnp.where((fail_b != 0)[:, None], x0, solved)
+                return solved, it_b, reason_b, fail_b
+
+            return solve_block
+
+        key = ("re_solve_block", self.task, solver_cache_key(opt),
+               has_norm, has_shifts, bool(dense))
+        return jitcache.get_or_build(key, build)
+
+    def update_model_blocked(
+        self,
+        residual_scores: Optional[Array],
+        *,
+        warm_start=None,
+        entity_names: Optional[Tuple[str, ...]] = None,
+        start_block: int = 0,
+        on_block=None,
+    ) -> RandomEffectModel:
+        """Larger-than-HBM training: sequential per-bucket solves with the
+        coefficient table resident in HOST RAM, warm starts streamed from
+        the cold tier.
+
+        ``update_model`` keeps the full [E, K] table plus every solve on
+        device at once; here the device only ever holds ONE size bucket's
+        samples-with-warm-starts-and-results, and the [E, K] table lives
+        in host memory — the training-side counterpart of serving's
+        two-tier store. Semantics match ``update_model`` per entity
+        (same per-entity program, same failure isolation: a failed entity
+        keeps its warm start) but the blocks run sequentially with a host
+        round-trip between them, so use it only when [E, K] doesn't fit.
+
+        ``warm_start``: ``None`` (zeros), a host/device [E, K] array, or
+        an ``io.cold_store.ColdStore`` (requires ``entity_names``: the
+        entity id of each dataset row, i.e. the ingest vocabulary order).
+        ``start_block`` is the resume cursor — buckets before it are
+        skipped and keep their ``warm_start`` rows, so resuming a
+        preempted run must pass the checkpointed coefficients (schema v4
+        records the cursor per coordinate; game/checkpoint.py).
+        ``on_block(next_block, num_blocks)`` fires after each bucket —
+        the checkpoint hook."""
+        ds = self.dataset
+        n_blocks = len(ds.blocks)
+        if not 0 <= start_block <= n_blocks:
+            raise ValueError(
+                f"start_block {start_block} outside [0, {n_blocks}]")
+        E_pad = ds.num_entities
+        K = ds.projected_dim
+        # solve in the dataset's dtype, matching update_model's coef0 —
+        # the per-entity programs must see identical input dtypes for
+        # blocked/all-at-once parity to be bitwise
+        dtype = np.dtype(ds.blocks[0].labels.dtype) if ds.blocks \
+            else np.dtype(np.float32)
+        # host-resident coefficient table: init from the warm-start source
+        if warm_start is None:
+            out = np.zeros((E_pad, K), dtype)
+        elif isinstance(warm_start, np.ndarray) or isinstance(
+                warm_start, jax.Array):
+            out = np.zeros((E_pad, K), dtype)
+            w = np.asarray(warm_start, dtype)
+            out[: min(E_pad, w.shape[0])] = w[:E_pad]
+        else:  # ColdStore
+            if entity_names is None:
+                raise ValueError(
+                    "ColdStore warm_start requires entity_names (entity id "
+                    "per dataset row, vocabulary order)")
+            from photon_tpu.game.random_effect import warm_start_from_cold_store
+            out = warm_start_from_cold_store(
+                warm_start, entity_names, ds.projection).astype(dtype)
+            extra = E_pad - out.shape[0]
+            if extra > 0:
+                out = np.pad(out, [(0, extra), (0, 0)])
+        lam = self.config.regularization_weight
+        l2 = jnp.asarray(self.config.regularization.l2_weight(lam), dtype)
+        l1 = jnp.asarray(self.config.regularization.l1_weight(lam), dtype)
+        norm_args = ()
+        if self._norm_local is not None:
+            f, s, islot = self._norm_local
+            norm_args = (f,) if s is None else (f, s, islot)
+        iters = np.full((E_pad,), -1, np.int32)
+        reasons = np.full((E_pad,), -1, np.int32)
+        fails = np.zeros((E_pad,), np.int32)
+        for bi, (blk, dense) in enumerate(
+                zip(ds.blocks, self._dense_local_blocks)):
+            if bi < start_block:
+                continue
+            ents = np.asarray(blk.entity_rows)
+            valid = (ents >= 0) & (ents < E_pad)
+            x0 = np.zeros((ents.shape[0], K), dtype)
+            x0[valid] = out[ents[valid]]
+            with _obs_annotate("re/solve_block"):
+                solved, it_b, reason_b, fail_b = self._block_solve_fn(dense)(
+                    blk, residual_scores, jnp.asarray(x0), l2, l1,
+                    *norm_args)
+            # the sequential host round-trip IS the design here: device
+            # peak memory stays one bucket, results land in host RAM
+            out[ents[valid]] = np.asarray(solved)[valid]
+            iters[ents[valid]] = np.asarray(it_b)[valid]
+            reasons[ents[valid]] = np.asarray(reason_b)[valid]
+            fails[ents[valid]] = np.asarray(fail_b)[valid]
+            if on_block is not None:
+                on_block(bi + 1, n_blocks)
+        from photon_tpu.optim.tracking import RandomEffectOptimizationTracker
+        e_orig = self._num_entities_orig
+        self.last_tracker = RandomEffectOptimizationTracker(
+            iterations=iters[:e_orig], reasons=reasons[:e_orig])
+        n_failed = int(np.sum(fails[:e_orig] != 0))
+        self.last_failed_entities = n_failed
+        self.last_failure = None
+        if n_failed and e_orig and n_failed == e_orig:
+            self.last_failure = FailureMode(int(fails[:e_orig].max()))
+        # coefficients stay a HOST array — materializing [E, K] on device
+        # would defeat the mode; downstream jnp ops accept numpy, and
+        # io.model_io.save_game_model writes cold stores straight from it
+        return RandomEffectModel(
+            coefficients=out[:e_orig],
+            random_effect_type=self.random_effect_type,
+            feature_shard_id=self.feature_shard_id,
+            task=self.task,
+            variances=None,
         )
 
     @functools.cached_property

@@ -506,3 +506,57 @@ def project_for_scoring(
     out_val[s_nz[kept], k_pos[kept]] = vals[kept]
     return (F.SparseFeatures(jnp.asarray(out_idx), jnp.asarray(out_val)),
             jnp.asarray(ent_out))
+
+
+# -- cold-tier warm starts ----------------------------------------------------
+
+def replay_cold_rows(ds_proj: np.ndarray, cold_proj: np.ndarray,
+                     cold_coef: np.ndarray) -> np.ndarray:
+    """Map cold-store coefficient rows into this dataset's local slot
+    layout by global column id.
+
+    Both layouts are slot-sorted ascending with -1 padding (the dataset
+    by construction, the cold store normalized at write —
+    io/cold_store.py), but the two column SETS can differ: the cold model
+    may have been trained on a different sample of each entity's
+    features. Columns present in both carry their cold value; dataset
+    slots with no cold counterpart warm-start at zero."""
+    if ds_proj.shape[0] != cold_proj.shape[0]:
+        raise ValueError(
+            f"row count mismatch: {ds_proj.shape[0]} dataset rows vs "
+            f"{cold_proj.shape[0]} cold rows")
+    # pairwise column match per entity; slot widths are small, so the
+    # [E_b, D, K] broadcast stays cheap relative to the mmap read itself
+    eq = ((ds_proj[:, :, None] == cold_proj[:, None, :])
+          & (ds_proj[:, :, None] >= 0))
+    hit = eq.any(axis=2)
+    pos = eq.argmax(axis=2)
+    vals = np.take_along_axis(
+        np.asarray(cold_coef, np.float32), pos, axis=1)
+    return np.where(hit, vals, np.float32(0.0))
+
+
+def warm_start_from_cold_store(cold, entity_names: Sequence[str],
+                               projection, *,
+                               block_rows: int = 262144) -> np.ndarray:
+    """Stream a ``ColdStore`` into a host-RAM warm-start block aligned to
+    this dataset's entity rows and slot layout.
+
+    ``entity_names[r]`` is the entity id of dataset row ``r`` (the ingest
+    vocabulary's ordering). Entities absent from the cold store — new
+    since the warm model was written — start at zero. Peak memory is the
+    host [E, K] output plus one streamed block; nothing touches the
+    device."""
+    proj = np.asarray(projection)
+    out = np.zeros(proj.shape, np.float32)
+    row_of = {str(name): r for r, name in enumerate(entity_names)}
+    for _lo, ids, coef_b, proj_b in cold.iter_blocks(block_rows):
+        rows = np.fromiter((row_of.get(str(i), -1) for i in ids),
+                           np.int64, count=len(ids))
+        sel = rows >= 0
+        if not sel.any():
+            continue
+        ds_rows = rows[sel]
+        out[ds_rows] = replay_cold_rows(proj[ds_rows], proj_b[sel],
+                                        np.asarray(coef_b)[sel])
+    return out

@@ -1,0 +1,362 @@
+"""Host-RAM cold tier for per-entity coefficient tables.
+
+One columnar file per random-effect coordinate, holding ALL entity rows
+sorted by entity id — the photon_tpu analog of Photon ML's PalDB
+off-heap coefficient index. Serving keeps only a fixed-budget hot set of
+rows in device HBM (serving/coeff_store.py); everything else lives here,
+loaded zero-copy via ``np.memmap`` so a 10M-entity table costs page
+cache, not process heap, and training's blocked iteration mode streams
+entity blocks through the per-entity solve without ever materializing
+the full table on device.
+
+On-disk layout (``photon_tpu.coldstore.v1``)::
+
+    magic      8 bytes   b"PHOTCOLD"
+    header     u32 little-endian JSON length, then the JSON header
+    sections   each 64-byte aligned, offsets recorded in the header:
+        coef   float32 [num_entities, slot_width]   dense coefficients
+        proj   int32   [num_entities, slot_width]   global col per local
+                                                    slot, -1 padded
+        ids    entity-id table: fixed-width byte rows (id_width > 0) or
+               u64 offsets[num_entities + 1] + utf-8 blob (id_width == 0)
+    footer     u32 crc32 of every preceding byte
+
+Rows are sorted by utf-8-encoded entity id, so lookup is one binary
+search over the mmapped id table — no host dict of N entries is ever
+built. The crc footer makes torn or bit-flipped files refusable at swap
+validation (``verify()``); the chaos harness's ``corrupt_cold_store``
+drives that gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from photon_tpu.resilience import chaos as _chaos
+
+MAGIC = b"PHOTCOLD"
+SCHEMA = "photon_tpu.coldstore.v1"
+COLD_STORE_DIR = "cold-store"
+COLD_STORE_SUFFIX = ".coldstore"
+_ALIGN = 64
+
+
+class ColdStoreCorruptError(RuntimeError):
+    """A cold-store file failed magic/header/crc validation."""
+
+    def __init__(self, path: str, detail: str):
+        self.path = path
+        super().__init__(f"corrupt cold store at {path}: {detail}")
+
+
+def cold_store_path(model_dir: str, coordinate_id: str) -> str:
+    """Canonical location of a coordinate's cold-tier file in a model
+    directory, alongside the reference per-coordinate Avro layout."""
+    return os.path.join(model_dir, COLD_STORE_DIR,
+                        coordinate_id + COLD_STORE_SUFFIX)
+
+
+def _encode_ids(entity_ids) -> Tuple[np.ndarray, int]:
+    """(bytes array [E] dtype S*, fixed width or 0). Ids are compared and
+    sorted as utf-8 bytes — the same order ``ColdStore.entity_row``'s
+    binary search uses."""
+    arr = np.asarray(entity_ids)
+    if arr.dtype.kind == "U":
+        arr = np.char.encode(arr, "utf-8")
+    elif arr.dtype.kind != "S":
+        arr = np.asarray([str(e).encode("utf-8") for e in entity_ids],
+                         dtype=bytes)
+    lengths = np.char.str_len(arr)
+    if arr.size and lengths.min() == lengths.max() == arr.dtype.itemsize:
+        return arr, int(arr.dtype.itemsize)
+    return arr, 0
+
+
+def _pad(f, crc: int, pos: int) -> Tuple[int, int]:
+    gap = (-pos) % _ALIGN
+    if gap:
+        pad = b"\x00" * gap
+        f.write(pad)
+        crc = zlib.crc32(pad, crc)
+    return crc, pos + gap
+
+
+def write_cold_store(
+    path: str,
+    coordinate_id: str,
+    random_effect_type: str,
+    feature_shard_id: str,
+    coefficients: np.ndarray,
+    projection: np.ndarray,
+    entity_ids: Union[Sequence[str], np.ndarray],
+    chunk_rows: int = 262144,
+) -> str:
+    """Write one coordinate's cold-tier file; returns its path.
+
+    Rows are re-sorted by entity id internally, so callers pass arrays in
+    any order. Streams in ``chunk_rows`` chunks (a 10M-entity table never
+    needs a second full copy in RAM beyond the sort permutation) and
+    publishes atomically (tmp + fsync + rename).
+    """
+    coefficients = np.asarray(coefficients, dtype=np.float32)
+    projection = np.asarray(projection, dtype=np.int32)
+    ids, id_width = _encode_ids(entity_ids)
+    num_entities, slot_width = coefficients.shape
+    if projection.shape != coefficients.shape:
+        raise ValueError(f"projection shape {projection.shape} != "
+                         f"coefficients shape {coefficients.shape}")
+    if ids.shape != (num_entities,):
+        raise ValueError(f"{ids.shape[0]} entity ids for "
+                         f"{num_entities} rows")
+
+    # normalize every row to (valid slots sorted ascending by global
+    # column, -1 pads last) — the invariant the serving hot-tier slot
+    # replay (searchsorted over the valid prefix) depends on; rows
+    # already in that form pass through unchanged (stable sort)
+    if num_entities and slot_width > 1:
+        key = np.where(projection < 0, np.iinfo(np.int32).max, projection)
+        slot_order = np.argsort(key, axis=1, kind="stable")
+        projection = np.take_along_axis(projection, slot_order, axis=1)
+        coefficients = np.take_along_axis(coefficients, slot_order, axis=1)
+
+    order = np.argsort(ids, kind="stable")
+    ids = ids[order]
+
+    header = {
+        "schema": SCHEMA,
+        "coordinate_id": coordinate_id,
+        "random_effect_type": random_effect_type,
+        "feature_shard_id": feature_shard_id,
+        "num_entities": int(num_entities),
+        "slot_width": int(slot_width),
+        "coef_dtype": "<f4",
+        "proj_dtype": "<i4",
+        "id_width": id_width,
+    }
+    # one-pass header layout: reserve maximal-width offset fields (15
+    # digits covers any sub-petabyte file), measure the serialized
+    # length, then fill real offsets and pad back to the reserved length
+    # — the header's byte length never depends on the offset values
+    _SENTINEL = 10 ** 14
+    for key in ("coef_off", "proj_off", "id_offsets_off", "id_blob_off",
+                "id_blob_len"):
+        header[key] = _SENTINEL
+    reserved = len(json.dumps(header).encode())
+    base = len(MAGIC) + 4 + reserved
+
+    def aligned(pos: int) -> int:
+        return pos + ((-pos) % _ALIGN)
+
+    coef_off = aligned(base)
+    proj_off = aligned(coef_off + num_entities * slot_width * 4)
+    id_offsets_off = aligned(proj_off + num_entities * slot_width * 4)
+    if id_width:
+        id_blob_off = id_offsets_off
+        id_offsets_off = 0
+        id_blob_len = num_entities * id_width
+    else:
+        id_blob_off = aligned(id_offsets_off + (num_entities + 1) * 8)
+        id_blob_len = int(np.char.str_len(ids).sum()) if num_entities else 0
+    header.update(coef_off=coef_off, proj_off=proj_off,
+                  id_offsets_off=id_offsets_off, id_blob_off=id_blob_off,
+                  id_blob_len=id_blob_len)
+    header_bytes = json.dumps(header).encode()
+    header_bytes += b" " * (reserved - len(header_bytes))
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    crc = 0
+    with open(tmp, "wb") as f:
+        pos = 0
+
+        def put(data: bytes) -> None:
+            nonlocal crc, pos
+            f.write(data)
+            crc = zlib.crc32(data, crc)
+            pos += len(data)
+
+        put(MAGIC)
+        put(len(header_bytes).to_bytes(4, "little"))
+        put(header_bytes)
+        crc, pos = _pad(f, crc, pos)
+        assert pos == header["coef_off"], (pos, header["coef_off"])
+        for lo in range(0, num_entities, chunk_rows):
+            sel = order[lo:lo + chunk_rows]
+            put(np.ascontiguousarray(coefficients[sel]).tobytes())
+        crc, pos = _pad(f, crc, pos)
+        for lo in range(0, num_entities, chunk_rows):
+            sel = order[lo:lo + chunk_rows]
+            put(np.ascontiguousarray(projection[sel]).tobytes())
+        crc, pos = _pad(f, crc, pos)
+        if id_width:
+            put(ids.tobytes())
+        else:
+            lengths = np.char.str_len(ids).astype(np.uint64)
+            offsets = np.zeros(num_entities + 1, dtype=np.uint64)
+            np.cumsum(lengths, out=offsets[1:])
+            put(offsets.tobytes())
+            crc, pos = _pad(f, crc, pos)
+            for lo in range(0, num_entities, chunk_rows):
+                put(b"".join(bytes(s) for s in ids[lo:lo + chunk_rows]))
+        f.write(crc.to_bytes(4, "little"))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+class ColdStore:
+    """Zero-copy reader over one coordinate's cold-tier file.
+
+    ``coef``/``proj`` are read-only ``np.memmap`` views — opening a
+    10M-entity store touches only the header. ``entity_row`` binary
+    searches the mmapped sorted-id table; ``read_rows`` fancy-indexes the
+    requested rows into a fresh host array (the unit the transfer thread
+    uploads). ``verify()`` streams the whole file against the crc footer
+    — swap validation's cold-pair gate.
+    """
+
+    def __init__(self, path: str, *, verify: bool = False):
+        self.path = path
+        with open(path, "rb") as f:
+            magic = f.read(len(MAGIC))
+            if magic != MAGIC:
+                raise ColdStoreCorruptError(path, f"bad magic {magic!r}")
+            hlen = int.from_bytes(f.read(4), "little")
+            if hlen <= 0 or hlen > 1 << 20:
+                raise ColdStoreCorruptError(path, f"bad header length {hlen}")
+            try:
+                h = json.loads(f.read(hlen))
+            except (ValueError, UnicodeDecodeError) as e:
+                raise ColdStoreCorruptError(path, f"unparseable header: {e}")
+        if h.get("schema") != SCHEMA:
+            raise ColdStoreCorruptError(path, f"schema {h.get('schema')!r}")
+        self.coordinate_id: str = h["coordinate_id"]
+        self.random_effect_type: str = h["random_effect_type"]
+        self.feature_shard_id: str = h["feature_shard_id"]
+        self.num_entities: int = h["num_entities"]
+        self.slot_width: int = h["slot_width"]
+        self._id_width: int = h["id_width"]
+        self.file_bytes = os.path.getsize(path)
+        shape = (self.num_entities, self.slot_width)
+        self.coef = np.memmap(path, dtype=np.dtype(h["coef_dtype"]),
+                              mode="r", offset=h["coef_off"], shape=shape)
+        self.proj = np.memmap(path, dtype=np.dtype(h["proj_dtype"]),
+                              mode="r", offset=h["proj_off"], shape=shape)
+        if self._id_width:
+            self._id_blob = np.memmap(
+                path, dtype=np.uint8, mode="r", offset=h["id_blob_off"],
+                shape=(self.num_entities * self._id_width,))
+            self._id_offsets = None
+        else:
+            self._id_offsets = np.memmap(
+                path, dtype=np.uint64, mode="r",
+                offset=h["id_offsets_off"], shape=(self.num_entities + 1,))
+            self._id_blob = np.memmap(
+                path, dtype=np.uint8, mode="r", offset=h["id_blob_off"],
+                shape=(h["id_blob_len"],))
+        if verify:
+            self.verify()
+
+    # -- id table -----------------------------------------------------------
+
+    def _id_bytes(self, row: int) -> bytes:
+        if self._id_width:
+            lo = row * self._id_width
+            return bytes(self._id_blob[lo:lo + self._id_width])
+        lo = int(self._id_offsets[row])
+        hi = int(self._id_offsets[row + 1])
+        return bytes(self._id_blob[lo:hi])
+
+    def entity_id(self, row: int) -> str:
+        return self._id_bytes(row).decode("utf-8")
+
+    def entity_row(self, entity_id: str) -> Optional[int]:
+        """Row index of ``entity_id`` (binary search over the sorted id
+        table), or None when the entity is not in the model — the caller's
+        typed ``UNKNOWN_ENTITY`` signal."""
+        key = entity_id.encode("utf-8")
+        if self._id_width and len(key) != self._id_width:
+            return None
+        lo, hi = 0, self.num_entities
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._id_bytes(mid) < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < self.num_entities and self._id_bytes(lo) == key:
+            return lo
+        return None
+
+    # -- row access ---------------------------------------------------------
+
+    def read_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Coefficient rows [len(rows), slot_width] as a fresh float32
+        host array — the transfer thread's upload unit. Consults the
+        chaos harness's cold-read-delay injector (this path is allowed to
+        be slow; the scoring hot path must not wait on it)."""
+        delay = _chaos.cold_read_delay()
+        if delay > 0:
+            time.sleep(delay)
+        return np.asarray(self.coef[np.asarray(rows, dtype=np.int64)],
+                          dtype=np.float32)
+
+    def read_proj_rows(self, rows: np.ndarray) -> np.ndarray:
+        return np.asarray(self.proj[np.asarray(rows, dtype=np.int64)],
+                          dtype=np.int32)
+
+    def iter_blocks(self, block_rows: int,
+                    start_row: int = 0
+                    ) -> Iterator[Tuple[int, List[str], np.ndarray,
+                                        np.ndarray]]:
+        """Stream ``(start_row, entity_ids, coef_block, proj_block)`` in
+        sorted-id order — training's blocked iteration unit."""
+        if block_rows <= 0:
+            raise ValueError(f"block_rows must be positive, got {block_rows}")
+        for lo in range(start_row, self.num_entities, block_rows):
+            hi = min(lo + block_rows, self.num_entities)
+            idx = np.arange(lo, hi)
+            ids = [self.entity_id(r) for r in range(lo, hi)]
+            yield lo, ids, self.read_rows(idx), self.read_proj_rows(idx)
+
+    # -- integrity ----------------------------------------------------------
+
+    def verify(self, chunk_bytes: int = 4 << 20) -> None:
+        """Stream the file against its crc32 footer; raises
+        ``ColdStoreCorruptError`` on mismatch or truncation."""
+        size = os.path.getsize(self.path)
+        if size < len(MAGIC) + 4 + 4:
+            raise ColdStoreCorruptError(self.path, f"truncated ({size}B)")
+        crc = 0
+        remaining = size - 4
+        with open(self.path, "rb") as f:
+            while remaining > 0:
+                chunk = f.read(min(chunk_bytes, remaining))
+                if not chunk:
+                    raise ColdStoreCorruptError(
+                        self.path, "short read during verify")
+                crc = zlib.crc32(chunk, crc)
+                remaining -= len(chunk)
+            footer = int.from_bytes(f.read(4), "little")
+        if crc != footer:
+            raise ColdStoreCorruptError(
+                self.path,
+                f"crc mismatch: computed {crc:#010x}, footer {footer:#010x}")
+
+    def describe(self) -> dict:
+        return {
+            "path": self.path,
+            "coordinate_id": self.coordinate_id,
+            "random_effect_type": self.random_effect_type,
+            "feature_shard_id": self.feature_shard_id,
+            "num_entities": self.num_entities,
+            "slot_width": self.slot_width,
+            "file_bytes": self.file_bytes,
+        }

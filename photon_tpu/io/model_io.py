@@ -44,6 +44,11 @@ RANDOM_EFFECT = "random-effect"
 COEFFICIENTS = "coefficients"
 ID_INFO = "id-info"
 METADATA_FILE = "model-metadata.json"
+#: per-shard serving column space sidecar, written alongside cold-store
+#: files: without it a lazy (avro-skipping) load could not reproduce the
+#: column numbering the cold store's projection table was written in
+FEATURE_INDEX_DIR = "feature-index"
+FEATURE_INDEX_SCHEMA = "photon_tpu.featureindex.v1"
 
 # Reference: VectorUtils.DEFAULT_SPARSITY_THRESHOLD
 DEFAULT_SPARSITY_THRESHOLD = 1e-4
@@ -156,6 +161,42 @@ def load_model_metadata(model_dir: str) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def save_feature_index(output_dir: str, shard_id: str,
+                       index_map: IndexMap) -> str:
+    """Persist one shard's column space (feature key per column) so lazy
+    loads can reproduce it without replaying every Avro record."""
+    fdir = os.path.join(output_dir, FEATURE_INDEX_DIR)
+    os.makedirs(fdir, exist_ok=True)
+    keys = [index_map.get_feature_name(i)
+            for i in range(index_map.feature_dimension)]
+    path = os.path.join(fdir, shard_id + ".json")
+    doc = {"schema": FEATURE_INDEX_SCHEMA, "feature_shard_id": shard_id,
+           "features": keys}
+    rio.atomic_write_bytes(path, json.dumps(doc).encode("utf-8"),
+                           op="model_write")
+    return path
+
+
+def load_feature_indexes(model_dir: str) -> Dict[str, IndexMap]:
+    """Read every feature-index sidecar in ``model_dir``; {} when the
+    model predates them (pure Avro layout)."""
+    fdir = os.path.join(model_dir, FEATURE_INDEX_DIR)
+    out: Dict[str, IndexMap] = {}
+    if not os.path.isdir(fdir):
+        return out
+    for name in sorted(os.listdir(fdir)):
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(fdir, name)) as f:
+            doc = json.load(f)
+        if doc.get("schema") != FEATURE_INDEX_SCHEMA:
+            raise ValueError(f"unknown feature-index schema "
+                             f"{doc.get('schema')!r} in {name}")
+        out[doc["feature_shard_id"]] = IndexMap(
+            {k: i for i, k in enumerate(doc["features"]) if k is not None})
+    return out
+
+
 def save_game_model(
     output_dir: str,
     model: GameModel,
@@ -165,6 +206,7 @@ def save_game_model(
     coordinate_configs: Optional[dict] = None,
     sparsity_threshold: float = DEFAULT_SPARSITY_THRESHOLD,
     records_per_file: Optional[int] = None,
+    write_cold_stores: bool = True,
 ) -> None:
     """Write a GAME model in the reference layout.
 
@@ -173,9 +215,20 @@ def save_game_model(
     effects (entity row -> REId string; local slot -> global column).
     ``records_per_file``: max per-entity records per part file (the
     reference's randomEffectModelFileLimit).
+    ``write_cold_stores``: also write each random-effect coordinate's
+    cold-tier columnar file (io/cold_store.py) plus the per-shard
+    feature-index sidecars — the pair the two-tier serving store and
+    lazy ``load_for_serving`` consume. The Avro layout stays byte-level
+    reference-compatible either way; the extra files are additive.
     """
     os.makedirs(output_dir, exist_ok=True)
     save_model_metadata(output_dir, model.task, coordinate_configs)
+    has_random = any(isinstance(model[cid], RandomEffectModel)
+                     for cid in model.coordinate_ids)
+    if write_cold_stores and has_random:
+        from photon_tpu.io.cold_store import cold_store_path, write_cold_store
+        for sid, imap in index_maps.items():
+            save_feature_index(output_dir, sid, imap)
 
     for cid in model.coordinate_ids:
         m = model[cid]
@@ -240,6 +293,12 @@ def save_game_model(
                     os.path.join(cdir, COEFFICIENTS, f"part-{p:05d}.avro"),
                     BAYESIAN_LINEAR_MODEL_AVRO,
                     recs[p * per_file:(p + 1) * per_file])
+            if write_cold_stores:
+                write_cold_store(
+                    cold_store_path(output_dir, cid), cid,
+                    m.random_effect_type, m.feature_shard_id,
+                    coef, proj.astype(np.int32, copy=False),
+                    np.asarray(list(names)))
         else:
             raise TypeError(f"unknown model type for coordinate {cid}: {type(m)}")
 
@@ -328,20 +387,78 @@ class ServingFixedEffect:
     coefficients: np.ndarray          # [D_shard] in the serving index space
 
 
-@dataclasses.dataclass
 class ServingRandomEffect:
-    """One random-effect coordinate as a gather table + entity lookup."""
+    """One random-effect coordinate as a gather table + entity lookup.
 
-    coordinate_id: str
-    random_effect_type: str
-    feature_shard_id: str
-    coefficients: np.ndarray          # [E, K] per-entity local-slot coefs
-    projection: np.ndarray            # [E, K] int32 global column (-1 pad)
-    entity_rows: Dict[str, int]       # REId string -> entity row
+    Two residency flavors behind one interface:
+
+    * eager — ``coefficients`` [E, K] float32, ``projection`` [E, K]
+      int32 (-1 pad), ``entity_rows`` {REId -> row} passed at
+      construction (the classic fully-resident load).
+    * cold-backed — only ``cold_store_path`` is set; the dense arrays
+      materialize from the mmap-backed cold store on FIRST attribute
+      access. The two-tier serving path reads rows straight off the
+      ColdStore and never touches these properties, so loading a
+      10M-entity model for two-tier serving costs one header read; the
+      full-resident fallback (no CoeffStoreConfig) still works, paying
+      the materialization exactly when it asks for the arrays.
+    """
+
+    def __init__(self, coordinate_id: str, random_effect_type: str,
+                 feature_shard_id: str,
+                 coefficients: Optional[np.ndarray] = None,
+                 projection: Optional[np.ndarray] = None,
+                 entity_rows: Optional[Dict[str, int]] = None,
+                 cold_store_path: Optional[str] = None):
+        if coefficients is None and cold_store_path is None:
+            raise ValueError(
+                f"random effect {coordinate_id!r} needs either eager "
+                f"arrays or a cold_store_path")
+        self.coordinate_id = coordinate_id
+        self.random_effect_type = random_effect_type
+        self.feature_shard_id = feature_shard_id
+        self.cold_store_path = cold_store_path
+        self._coefficients = coefficients
+        self._projection = projection
+        self._entity_rows = entity_rows
+        self._num_entities: Optional[int] = (
+            None if coefficients is None else int(coefficients.shape[0]))
+
+    def _materialize(self) -> None:
+        from photon_tpu.io.cold_store import ColdStore
+
+        cs = ColdStore(self.cold_store_path)
+        self._coefficients = np.asarray(cs.coef, dtype=np.float32)
+        self._projection = np.asarray(cs.proj, dtype=np.int32)
+        self._entity_rows = {cs.entity_id(r): r
+                             for r in range(cs.num_entities)}
+        self._num_entities = cs.num_entities
+
+    @property
+    def coefficients(self) -> np.ndarray:
+        if self._coefficients is None:
+            self._materialize()
+        return self._coefficients
+
+    @property
+    def projection(self) -> np.ndarray:
+        if self._projection is None:
+            self._materialize()
+        return self._projection
+
+    @property
+    def entity_rows(self) -> Dict[str, int]:
+        if self._entity_rows is None:
+            self._materialize()
+        return self._entity_rows
 
     @property
     def num_entities(self) -> int:
-        return self.coefficients.shape[0]
+        if self._num_entities is None:
+            from photon_tpu.io.cold_store import ColdStore
+
+            self._num_entities = ColdStore(self.cold_store_path).num_entities
+        return self._num_entities
 
 
 @dataclasses.dataclass
@@ -376,17 +493,30 @@ def load_for_serving(
     Without ``index_maps`` the serving column space is built from the
     model's own support (a feature the model never weights scores zero
     either way, so dropping out-of-support request features preserves
-    scores exactly). Variances are never parsed — serving only scores.
+    scores exactly) — unless the model dir carries feature-index
+    sidecars, in which case those fix the column space up front (the
+    numbering the cold-store projection tables were written in).
+
+    Random-effect coordinates with a cold-store file are opened LAZILY:
+    their per-entity Avro records are never read, and the returned
+    :class:`ServingRandomEffect` materializes dense arrays from the cold
+    file only if something asks for them. Variances are never parsed —
+    serving only scores.
     """
+    from photon_tpu.io.cold_store import cold_store_path
+
     metadata = load_model_metadata(model_dir)
     task = TaskType(metadata["modelType"])
     wanted = set(coordinates_to_load) if coordinates_to_load else None
     external = index_maps is not None
+    sidecars = {} if external else load_feature_indexes(model_dir)
     builders: Dict[str, IndexMapBuilder] = {}
 
     def col_of(shard_id: str, name: str, term: str) -> int:
         if external:
             return index_maps[shard_id].index_of(name, term)
+        if shard_id in sidecars:
+            return sidecars[shard_id].index_of(name, term)
         return builders.setdefault(shard_id, IndexMapBuilder()).put(
             feature_key(name, term))
 
@@ -394,6 +524,7 @@ def load_for_serving(
     # dense packing waits until every coordinate has grown the builders
     fixed_raw: List[Tuple[str, str, Dict[int, float]]] = []
     random_raw: List[Tuple[str, str, str, List[str], List[Dict[int, float]]]] = []
+    cold_raw: List[Tuple[str, str, str, str]] = []  # cid, type, shard, path
 
     fixed_dir = os.path.join(model_dir, FIXED_EFFECT)
     if os.path.isdir(fixed_dir):
@@ -426,6 +557,13 @@ def load_for_serving(
                 re_type, shard_id = f.read().split()[:2]
             if external and shard_id not in index_maps:
                 raise KeyError(f"no index map for feature shard {shard_id!r}")
+            cold_path = cold_store_path(model_dir, cid)
+            if not external and os.path.exists(cold_path):
+                # lazy: the cold file IS the coefficient table (its
+                # projection columns are the sidecar column space), so
+                # the per-entity Avro records never get read
+                cold_raw.append((cid, re_type, shard_id, cold_path))
+                continue
             names: List[str] = []
             per_entity: List[Dict[int, float]] = []
             for rec in avro_io.iter_avro_dir(os.path.join(cdir, COEFFICIENTS)):
@@ -439,7 +577,8 @@ def load_for_serving(
             random_raw.append((cid, re_type, shard_id, names, per_entity))
 
     maps = dict(index_maps) if external else {
-        sid: b.build() for sid, b in builders.items()}
+        **{sid: b.build() for sid, b in builders.items()},
+        **sidecars}
 
     fixed = []
     for cid, shard_id, slots in fixed_raw:
@@ -462,6 +601,10 @@ def load_for_serving(
         random_.append(ServingRandomEffect(
             cid, re_type, shard_id, coef, proj,
             {name: i for i, name in enumerate(names)}))
+    for cid, re_type, shard_id, cold_path in cold_raw:
+        random_.append(ServingRandomEffect(
+            cid, re_type, shard_id, cold_store_path=cold_path))
+    random_.sort(key=lambda r: r.coordinate_id)
 
     return ServingGameModel(task, fixed, random_, maps, metadata)
 

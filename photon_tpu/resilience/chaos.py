@@ -30,6 +30,14 @@ hook points consult it:
   helper: truncates one file (chosen by seed) to half its bytes, the
   on-disk shape a kill mid-copy leaves behind; the swap's crc32
   manifest gate must refuse the directory.
+- ``cold_read_delay()`` — serving/coeff_store's background transfer
+  thread asks before each cold-tier row read; returns seconds to sleep
+  for the first ``cold_read_delay_reads`` reads, simulating a slow /
+  page-faulting host-RAM cold tier. The score hot path must stay
+  typed-degradation-only (``COLD_MISS``) while prefetch lags.
+- ``corrupt_cold_store(path, seed)`` — deterministic cold-file
+  corruption helper: flips one payload byte (chosen by seed) so the
+  cold store's crc32 footer check must refuse the file.
 
 Everything is counter-based off the installed config — two runs with the
 same config and workload inject identically. ``seed`` feeds the optional
@@ -81,6 +89,10 @@ class ChaosConfig:
     # concurrency group (fires once)
     straggler_at: Optional[Tuple[str, int]] = None
     straggler_delay_s: float = 0.0
+    # serving cold tier: seconds of artificial cold-store read latency,
+    # applied to the first cold_read_delay_reads transfer reads (then off)
+    cold_read_delay_s: float = 0.0
+    cold_read_delay_reads: int = 0
 
 
 class _State:
@@ -94,6 +106,7 @@ class _State:
         self.preempt_fired = False
         self.scorer_delays_done = 0
         self.straggler_fired = False
+        self.cold_read_delays_done = 0
 
 
 _active: Optional[_State] = None
@@ -192,6 +205,44 @@ def straggler_delay(coordinate: str, sweep: int) -> float:
             return 0.0
         s.straggler_fired = True
     return s.config.straggler_delay_s
+
+
+def cold_read_delay() -> float:
+    """Seconds of injected cold-tier read latency for this transfer (0
+    when inactive or the read budget is spent). Applied on the background
+    transfer thread only — the scoring hot path never blocks on it; a
+    request whose rows are late gets typed ``COLD_MISS`` degradation."""
+    s = _active
+    if s is None or s.config.cold_read_delay_s <= 0:
+        return 0.0
+    with s.lock:
+        if s.cold_read_delays_done >= s.config.cold_read_delay_reads:
+            return 0.0
+        s.cold_read_delays_done += 1
+    return s.config.cold_read_delay_s
+
+
+def corrupt_cold_store(path: str, seed: int = 0) -> int:
+    """Deterministically flip one payload byte of a cold-store file
+    (offset chosen by crc32(seed) over the body, past the magic, before
+    the crc footer) — the signature of silent media corruption. The
+    store's crc32 verify gate must refuse the file. Returns the flipped
+    offset."""
+    import os
+
+    size = os.path.getsize(path)
+    if size <= 24:
+        raise ValueError(f"cold store file too small to corrupt: {path!r}")
+    # keep the magic (first 8 bytes) and the crc footer (last 4) intact so
+    # the failure is unambiguously a payload-checksum mismatch
+    body = size - 8 - 4
+    offset = 8 + zlib.crc32(str(seed).encode()) % body
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return offset
 
 
 def should_poison_swap_candidate() -> bool:

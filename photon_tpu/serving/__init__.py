@@ -34,6 +34,7 @@ from photon_tpu.serving.batching import (
     QueueClosedError,
 )
 from photon_tpu.serving.breaker import CircuitBreaker
+from photon_tpu.serving.coeff_store import TwoTierCoeffStore
 from photon_tpu.serving.engine import LATENCY_BUCKETS, ServingEngine
 from photon_tpu.serving.model_state import DeviceResidentModel
 from photon_tpu.serving.scorer import MODES, get_scorer, warmup_scorers
@@ -46,6 +47,7 @@ from photon_tpu.serving.swap import (
 )
 from photon_tpu.serving.types import (
     BreakerConfig,
+    CoeffStoreConfig,
     DeadlineConfig,
     Fallback,
     FallbackReason,
@@ -59,6 +61,7 @@ from photon_tpu.serving.types import (
 __all__ = [
     "BreakerConfig",
     "BucketLadder",
+    "CoeffStoreConfig",
     "CircuitBreaker",
     "DeadlineConfig",
     "DeviceResidentModel",
@@ -75,6 +78,7 @@ __all__ = [
     "SLOConfig",
     "SwapConfig",
     "SwapResult",
+    "TwoTierCoeffStore",
     "get_scorer",
     "serving_report_section",
     "swap_from_dir",

@@ -85,13 +85,22 @@ class MicroBatcher:
 
     def __init__(self, ladder: BucketLadder, max_wait_s: float = 0.002,
                  clock: Optional[Callable[[], float]] = None,
-                 deadline_headroom_s: float = 0.0):
+                 deadline_headroom_s: float = 0.0,
+                 on_admit: Optional[Callable[[ScoreRequest], None]] = None):
         import time
 
         self.ladder = ladder
         self.max_wait_s = float(max_wait_s)
         self.deadline_headroom_s = float(deadline_headroom_s)
         self.clock = clock if clock is not None else time.monotonic
+        # admission lookahead hook: called once per admitted request,
+        # BEFORE it is queued — so by the time any release policy
+        # (ladder-top fill, oldest-waiter wait, or a deadline override)
+        # can pop the request, the hook has already seen it. The two-tier
+        # coefficient store hangs its cold->hot prefetch here. Must be
+        # cheap and non-blocking; exceptions are swallowed (a broken
+        # lookahead must never refuse admission).
+        self.on_admit = on_admit
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: List[Pending] = []
@@ -104,6 +113,13 @@ class MicroBatcher:
                deadline: Optional[float] = None) -> None:
         if self._closed:
             raise QueueClosedError("admission queue closed (draining)")
+        if self.on_admit is not None:
+            try:
+                self.on_admit(request)
+            except Exception:  # noqa: BLE001 — lookahead is best-effort
+                from photon_tpu.obs import metrics as _metrics
+
+                _metrics.counter("serving.admit_lookahead_errors").inc()
         with self._cond:
             self._queue.append(Pending(request, self.clock(), deadline))
             self._cond.notify()

@@ -79,7 +79,8 @@ class ServingEngine:
                                    self.config.min_bucket)
         self.batcher = MicroBatcher(
             self.ladder, self.config.max_wait_s, clock=clock,
-            deadline_headroom_s=self.config.deadline.score_headroom_s)
+            deadline_headroom_s=self.config.deadline.score_headroom_s,
+            on_admit=self._prefetch_lookahead)
         self.clock = self.batcher.clock
         self.breaker = CircuitBreaker(self.config.breaker, clock=self.clock,
                                       on_transition=self._on_breaker)
@@ -115,8 +116,18 @@ class ServingEngine:
             model_dir, coordinates_to_load=coordinates_to_load)
         model = DeviceResidentModel(serving_model, mesh=mesh,
                                     feature_pad=(config.feature_pad
+                                                 if config else None),
+                                    coeff_store=(config.coeff_store
                                                  if config else None))
         return cls(model, config=config, clock=clock)
+
+    def _prefetch_lookahead(self, request: ScoreRequest) -> None:
+        """MicroBatcher ``on_admit`` hook: resolve the request's entities
+        against the two-tier stores at admission so their cold->hot
+        uploads are usually done by batch-pop time."""
+        model = self.model
+        if model.has_stores:
+            model.prefetch_request(request)
 
     # -- warmup --------------------------------------------------------------
 
@@ -236,23 +247,41 @@ class ServingEngine:
         model = self.model    # one read: a concurrent publish lands on
         # the next batch, never mid-batch
 
-        t0 = time.perf_counter()
-        args, fallbacks, counters = model.assemble(
-            requests, bucket, shed_random=shed_any)
-        t_assemble = time.perf_counter() - t0
-
-        t0 = time.perf_counter()
+        # two-tier consistency contract: assemble (slot lookups against the
+        # host-side hot maps), the table read, and the scorer DISPATCH all
+        # happen in ONE transfer_lock hold, so the transfer thread cannot
+        # donate a table or remap a slot between the lookup and the gather
+        # that consumes it. Only the dispatch is inside the lock — the
+        # blocking np.asarray materialization happens after release, so
+        # transfers overlap device compute. Full-resident models share the
+        # same (uncontended) lock, keeping one code path.
         scorer_ok = True
         scores = None
-        try:
-            delay = _chaos.scorer_delay()
-            if delay > 0:
-                time.sleep(delay)
-            scores = np.asarray(get_scorer(model, mode, bucket)(*args))
-        except Exception as e:  # device/dispatch fault: typed, counted
-            scorer_ok = False
-            record_failure("serving_scorer_error", error=repr(e),
-                           bucket=bucket, mode=mode)
+        raw = None
+        with model.transfer_lock:
+            t0 = time.perf_counter()
+            args, fallbacks, counters = model.assemble(
+                requests, bucket, shed_random=shed_any)
+            t_assemble = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            try:
+                delay = _chaos.scorer_delay()
+                if delay > 0:
+                    time.sleep(delay)
+                raw = get_scorer(model, mode, bucket)(
+                    *args, model.current_tables())
+            except Exception as e:  # device/dispatch fault: typed, counted
+                scorer_ok = False
+                record_failure("serving_scorer_error", error=repr(e),
+                               bucket=bucket, mode=mode)
+        if scorer_ok:
+            try:
+                scores = np.asarray(raw)
+            except Exception as e:
+                scorer_ok = False
+                record_failure("serving_scorer_error", error=repr(e),
+                               bucket=bucket, mode=mode)
         t_score = time.perf_counter() - t0
 
         n = len(requests)
@@ -309,6 +338,10 @@ class ServingEngine:
             _metrics.counter("serving.degraded",
                              reason=FallbackReason.UNKNOWN_ENTITY.value
                              ).inc(counters["unknown_entities"])
+        if counters.get("cold_misses"):
+            _metrics.counter("serving.degraded",
+                             reason=FallbackReason.COLD_MISS.value
+                             ).inc(counters["cold_misses"])
         if shed:
             _metrics.counter(
                 "serving.degraded",
@@ -439,6 +472,11 @@ class ServingEngine:
         _metrics.gauge("serving.drain_seconds").set(seconds)
         if refused:
             _metrics.counter("serving.drain_refused").inc(refused)
+        # stop two-tier transfer threads with the drain: a drained engine
+        # must not keep background threads uploading to the device
+        self.model.close_stores()
+        if self._prior is not None:
+            self._prior[0].close_stores()
         return out
 
     # -- synchronous convenience --------------------------------------------
@@ -528,6 +566,9 @@ class ServingEngine:
             "draining": self._draining,
             "swap": self.swap_stats(),
         }
+        cs = self.model.coeff_store_stats()
+        if cs is not None:
+            out["coeff_store"] = cs
         if self._drain_info is not None:
             out["drain"] = dict(self._drain_info)
         return out

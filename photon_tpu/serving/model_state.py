@@ -14,18 +14,31 @@ column), per-coordinate entity vocabularies (REId string -> block row),
 and the (entity, feature) -> local-slot tables that replay
 ``game/random_effect.project_for_scoring``'s projection per batch — the
 same math as offline scoring, so serving scores are bitwise-comparable.
+
+Two-tier placement: a random-effect coordinate loaded with a cold-store
+file (io/cold_store.py) and a ``CoeffStoreConfig`` does NOT stage its
+full table; it serves through a ``serving/coeff_store.TwoTierCoeffStore``
+— a fixed-budget HBM hot set over the host-RAM cold tier, with the
+entity->hot-slot map playing the role the full-resident path's
+entity_rows dict plays. Assembly then resolves entities against the hot
+map (HIT -> hot slot, COLD -> typed ``COLD_MISS`` + queued promotion,
+UNKNOWN -> zero row), and the scorer receives the hot table as an
+argument under ``transfer_lock`` so concurrent cold->hot transfers can
+never tear a batch.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import itertools
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from photon_tpu.io.model_io import ServingGameModel
-from photon_tpu.serving.types import Fallback, FallbackReason, ScoreRequest
+from photon_tpu.serving.types import (CoeffStoreConfig, Fallback,
+                                      FallbackReason, ScoreRequest)
 
 _model_counter = itertools.count()
 
@@ -51,6 +64,9 @@ class _RandomState:
     # (the project_for_scoring lookup, built once at load)
     pkeys_sorted: np.ndarray          # [P] int64
     pslots_sorted: np.ndarray         # [P] int64
+    # two-tier mode: the hot-set gather cache; coef/entity_rows/pkeys
+    # are unused and the gather table is read via store.table instead
+    store: Optional[object] = None    # TwoTierCoeffStore
 
 
 class AssembledBatch(Tuple):
@@ -70,7 +86,8 @@ class DeviceResidentModel:
     """A ServingGameModel staged onto the accelerator, plus assembly."""
 
     def __init__(self, model: ServingGameModel, mesh=None,
-                 feature_pad: Optional[int] = None, dtype=None):
+                 feature_pad: Optional[int] = None, dtype=None,
+                 coeff_store: Optional[CoeffStoreConfig] = None):
         import jax
         import jax.numpy as jnp
 
@@ -79,6 +96,12 @@ class DeviceResidentModel:
         self.dtype = dtype or jnp.float32
         self.token = f"servmodel-{next(_model_counter)}"
         self.mesh = mesh
+        # serializes batch assembly + scorer dispatch against the
+        # two-tier stores' cold->hot transfer commits; recursive so the
+        # engine can nest assemble inside its own hold. A model with no
+        # stores pays one uncontended acquire per batch.
+        self.transfer_lock = threading.RLock()
+        self.coeff_store_config = coeff_store
 
         put_rep, put_ent = self._placers(mesh)
 
@@ -105,6 +128,23 @@ class DeviceResidentModel:
 
         self.random: List[_RandomState] = []
         for re in model.random:
+            cold_path = getattr(re, "cold_store_path", None)
+            if coeff_store is not None and cold_path is not None:
+                # two-tier: the table never fully materializes — a
+                # fixed-budget hot set fronts the mmapped cold tier
+                from photon_tpu.io.cold_store import ColdStore
+                from photon_tpu.serving.coeff_store import TwoTierCoeffStore
+
+                store = TwoTierCoeffStore(
+                    ColdStore(cold_path), coeff_store,
+                    lock=self.transfer_lock)
+                self.random.append(_RandomState(
+                    re.coordinate_id, re.random_effect_type,
+                    re.feature_shard_id, None, store.cold.num_entities,
+                    store.unknown_row, store.slot_width, {},
+                    np.empty(0, np.int64), np.empty(0, np.int64),
+                    store=store))
+                continue
             coef = np.asarray(re.coefficients)
             E, K = coef.shape
             D = max(self.shard_dims.get(re.feature_shard_id, 1), 1)
@@ -122,6 +162,51 @@ class DeviceResidentModel:
                         else coef),
                 E, E, K, dict(re.entity_rows),
                 pkeys[order], ps[order].astype(np.int64)))
+
+    # -- two-tier store plumbing --------------------------------------------
+
+    @property
+    def has_stores(self) -> bool:
+        return any(rs.store is not None for rs in self.random)
+
+    def current_tables(self) -> tuple:
+        """The random-effect gather tables the scorer takes as arguments
+        — the live hot table for two-tier coordinates, the static full
+        table otherwise. Two-tier reads must happen under
+        ``transfer_lock``, in the same hold as the assemble and the
+        scorer dispatch that consume them (the donated transfer scatter
+        invalidates superseded table objects)."""
+        return tuple(rs.store.table if rs.store is not None else rs.coef
+                     for rs in self.random)
+
+    def prefetch_request(self, request: ScoreRequest) -> None:
+        """Admission lookahead: queue cold->hot promotion for every
+        two-tier entity this request names. Non-blocking."""
+        for rs in self.random:
+            if rs.store is None:
+                continue
+            re_id = request.entity_ids.get(rs.random_effect_type)
+            if re_id is not None:
+                rs.store.prefetch(re_id)
+
+    def coeff_store_stats(self) -> Optional[dict]:
+        stats = {rs.coordinate_id: rs.store.stats()
+                 for rs in self.random if rs.store is not None}
+        return stats or None
+
+    def drain_prefetch(self, timeout_s: float = 10.0) -> bool:
+        """Flush every store's pending promotions (tests / bench phase
+        boundaries — never the scoring path)."""
+        ok = True
+        for rs in self.random:
+            if rs.store is not None:
+                ok = rs.store.drain_prefetch(timeout_s) and ok
+        return ok
+
+    def close_stores(self) -> None:
+        for rs in self.random:
+            if rs.store is not None:
+                rs.store.close()
 
     # -- device placement ---------------------------------------------------
 
@@ -170,7 +255,8 @@ class DeviceResidentModel:
             raise ValueError(f"{n} requests > bucket {bucket}")
         fallbacks: List[List[Fallback]] = [[] for _ in range(n)]
         counters = {"unknown_features": 0, "truncated_features": 0,
-                    "unknown_entities": 0, "padded_rows": bucket - n}
+                    "unknown_entities": 0, "cold_misses": 0,
+                    "padded_rows": bucket - n}
 
         offsets = np.zeros(bucket, np.float32)
         for i, r in enumerate(requests):
@@ -223,7 +309,52 @@ class DeviceResidentModel:
             ent = np.full(bucket, rs.unknown_row, np.int32)
             sidx = np.zeros((bucket, rs.slot_width), np.int32)
             sval = np.zeros((bucket, rs.slot_width), np.float32)
-            if not shed_random:
+            if not shed_random and rs.store is not None:
+                from photon_tpu.serving import coeff_store as _cs
+
+                for i, r in enumerate(requests):
+                    re_id = r.entity_ids.get(rs.random_effect_type)
+                    if re_id is None:
+                        counters["unknown_entities"] += 1
+                        fallbacks[i].append(Fallback(
+                            FallbackReason.UNKNOWN_ENTITY,
+                            coordinate=rs.coordinate_id, detail="None"))
+                        continue
+                    slot, status = rs.store.lookup_locked(re_id)
+                    if status == _cs.UNKNOWN:
+                        counters["unknown_entities"] += 1
+                        fallbacks[i].append(Fallback(
+                            FallbackReason.UNKNOWN_ENTITY,
+                            coordinate=rs.coordinate_id, detail=re_id))
+                        continue
+                    if status == _cs.COLD:
+                        # rows still in the cold tier at pop time: typed
+                        # degradation (the zero row scores this request
+                        # fixed-effect-only for this coordinate); the
+                        # lookup already queued the promotion
+                        counters["cold_misses"] += 1
+                        fallbacks[i].append(Fallback(
+                            FallbackReason.COLD_MISS,
+                            coordinate=rs.coordinate_id, detail=re_id))
+                        continue
+                    ent[i] = slot
+                    cols = shard_cols[rs.feature_shard_id][i]
+                    if not len(cols):
+                        continue
+                    # replay project_for_scoring against the hot slot's
+                    # projection row (ascending global cols, -1 pad), a
+                    # host mirror — the cold mmap is never touched here
+                    prow = rs.store.proj_row_locked(slot)
+                    pvalid = prow[prow >= 0]
+                    if not len(pvalid):
+                        continue
+                    rank = np.searchsorted(pvalid, cols)
+                    rank = np.minimum(rank, len(pvalid) - 1)
+                    kept = pvalid[rank] == cols
+                    k = int(kept.sum())
+                    sidx[i, :k] = rank[kept]
+                    sval[i, :k] = shard_vals[rs.feature_shard_id][i][kept]
+            elif not shed_random:
                 D = max(self.shard_dims.get(rs.feature_shard_id, 1), 1)
                 for i, r in enumerate(requests):
                     re_id = r.entity_ids.get(rs.random_effect_type)
@@ -274,7 +405,10 @@ class DeviceResidentModel:
                         "type": r.random_effect_type,
                         "shard": r.feature_shard_id,
                         "entities": r.num_entities,
-                        "slot_width": r.slot_width}
+                        "slot_width": r.slot_width,
+                        "two_tier": r.store is not None,
+                        **({"hot_capacity": r.store.capacity}
+                           if r.store is not None else {})}
                        for r in self.random],
             "shard_pad": dict(self.shard_pad),
             "entity_sharded": self.mesh is not None,

@@ -1,8 +1,16 @@
 """Compiled batch scorers for the serving engine.
 
-One jitted program per (model, mode, bucket): the program closes over the
-device-resident coefficient arrays (so they are baked into the executable
-and never re-shipped) and takes only the batch's padded feature arrays.
+One jitted program per (model, mode, bucket): the program closes over
+the device-resident FIXED-effect arrays (static for a model's lifetime,
+baked into the executable) but takes the random-effect gather tables as
+explicit arguments. Tables must be arguments, not closures, because the
+two-tier coefficient store (serving/coeff_store.py) replaces a
+coordinate's hot table object on every cold->hot transfer (the donated
+scatter produces a new array); same-shape/dtype arguments re-dispatch
+the cached executable with zero retraces, where a closure would either
+go stale or force a steady-state recompile. Fully-resident coordinates
+pass the same table every call — one calling convention for both tiers.
+
 The math is the offline ``game/scoring.GameScorer`` expressions verbatim
 — fixed effects as a gathered dot over padded (index, value) pairs,
 random effects as an entity-row gather followed by a slot-aligned dot —
@@ -31,7 +39,13 @@ MODES = ("full", "fixed_only")
 
 def get_scorer(model: DeviceResidentModel, mode: str,
                bucket: int) -> Callable:
-    """Compiled scorer for one (model, mode, bucket); cached process-wide."""
+    """Compiled scorer for one (model, mode, bucket); cached process-wide.
+
+    Call as ``fn(*args, re_tables)`` where ``args`` is the assemble
+    output and ``re_tables`` is ``model.current_tables()`` read inside
+    the same ``model.transfer_lock`` hold as the assemble (the two-tier
+    store's consistency contract).
+    """
     if mode not in MODES:
         raise ValueError(f"unknown serving mode {mode!r}")
     key = ("serving_scorer", model.token, mode, int(bucket))
@@ -44,11 +58,11 @@ def get_scorer(model: DeviceResidentModel, mode: str,
         shard_pos = {sid: i for i, sid in enumerate(model.shard_order)}
         thetas = tuple(f.theta for f in model.fixed)
         fixed_pos = tuple(shard_pos[f.feature_shard_id] for f in model.fixed)
-        coefs = tuple(r.coef for r in model.random)
         with_random = mode == "full"
 
         @jax.jit
-        def fn(fixed_idx, fixed_val, re_sidx, re_sval, re_ent, offsets):
+        def fn(fixed_idx, fixed_val, re_sidx, re_sval, re_ent, offsets,
+               re_tables):
             total = offsets.astype(dtype)
             for theta, pos in zip(thetas, fixed_pos):
                 # ops/features.matvec on the padded ELL layout: pad slots
@@ -57,8 +71,8 @@ def get_scorer(model: DeviceResidentModel, mode: str,
                     fixed_val[pos].astype(dtype) * theta[fixed_idx[pos]],
                     axis=-1)
             if with_random:
-                for coef, sidx, sval, ent in zip(coefs, re_sidx, re_sval,
-                                                 re_ent):
+                for coef, sidx, sval, ent in zip(re_tables, re_sidx,
+                                                 re_sval, re_ent):
                     rows = coef.at[ent].get(mode="fill", fill_value=0.0)
                     total = total + jnp.sum(
                         sval.astype(dtype)
@@ -80,9 +94,10 @@ def warmup_scorers(model: DeviceResidentModel,
     def one_bucket(bucket):
         nonlocal warmed
         args = model.dummy_args(bucket)
+        tables = model.current_tables()
         for mode in MODES:
-            out = get_scorer(model, mode, bucket)(*args)
-            out.block_until_ready()
+            out = get_scorer(model, mode, bucket)(*args, tables)
+            out.block_until_ready()  # host-sync-ok: warmup only
             warmed += 1
 
     compile_cache.warmup(buckets, one_bucket)

@@ -151,15 +151,28 @@ def _shadow_scores(model: DeviceResidentModel, requests: List,
                    ladder) -> np.ndarray:
     """Score ``requests`` through ``model`` full-effort, chunked over the
     engine's bucket ladder (every (mode, bucket) program is warmed, so
-    this dispatches zero new compiles)."""
+    this dispatches zero new compiles).
+
+    Two-tier models first promote the shadow sample's entities into the
+    hot tier and drain the transfer queue — the shadow gate compares real
+    coefficient scores, not COLD_MISS degradations, so live-vs-candidate
+    deviation means what it says regardless of residency tier. Assemble +
+    table read + dispatch hold the model's transfer lock, same contract
+    as the engine hot path."""
+    if model.has_stores:
+        for r in requests:
+            model.prefetch_request(r)
+        model.drain_prefetch()
     out: List[np.ndarray] = []
     top = ladder.max_batch
     for lo in range(0, len(requests), top):
         chunk = requests[lo:lo + top]
         bucket = ladder.bucket_for(len(chunk))
-        args, _fallbacks, _counters = model.assemble(chunk, bucket)
-        scores = np.asarray(get_scorer(model, "full", bucket)(*args))
-        out.append(scores[:len(chunk)])
+        with model.transfer_lock:
+            args, _fallbacks, _counters = model.assemble(chunk, bucket)
+            raw = get_scorer(model, "full", bucket)(
+                *args, model.current_tables())
+        out.append(np.asarray(raw)[:len(chunk)])
     return np.concatenate(out) if out else np.zeros(0, np.float32)
 
 
@@ -195,13 +208,32 @@ def swap_staged(engine: ServingEngine, serving_model, label: str,
     _metrics.counter("serving.swap_attempts").inc()
 
     # finite: host-side scan of every coefficient table — a poisoned
-    # candidate is refused before it touches the device, no traffic needed
+    # candidate is refused before it touches the device, no traffic needed.
+    # Cold-backed coordinates are scanned in streamed blocks off the mmap
+    # (never materialized whole) after a crc verify, so a torn or poisoned
+    # cold file is caught here even when the manifest was skipped.
     bad = []
     for fe in serving_model.fixed:
         if not np.all(np.isfinite(np.asarray(fe.coefficients))):
             bad.append(fe.coordinate_id)
     for re in serving_model.random:
-        if not np.all(np.isfinite(np.asarray(re.coefficients))):
+        cold_path = getattr(re, "cold_store_path", None)
+        if cold_path is not None:
+            from photon_tpu.io.cold_store import (
+                ColdStore,
+                ColdStoreCorruptError,
+            )
+            try:
+                cs = ColdStore(cold_path, verify=True)
+                for _start, _ids, coef_block, _proj in cs.iter_blocks(262144):
+                    if not np.all(np.isfinite(coef_block)):
+                        bad.append(re.coordinate_id)
+                        break
+            except (ColdStoreCorruptError, OSError) as e:
+                return _reject(engine, label, gates, "finite",
+                               f"cold store unreadable for "
+                               f"{re.coordinate_id!r}: {e!r}")
+        elif not np.all(np.isfinite(np.asarray(re.coefficients))):
             bad.append(re.coordinate_id)
     if bad:
         return _reject(engine, label, gates, "finite",
@@ -216,7 +248,8 @@ def swap_staged(engine: ServingEngine, serving_model, label: str,
     try:
         staged = DeviceResidentModel(
             serving_model, mesh=mesh if mesh is not None else engine.model.mesh,
-            feature_pad=engine.config.feature_pad)
+            feature_pad=engine.config.feature_pad,
+            coeff_store=engine.config.coeff_store)
         warmup_scorers(staged, engine.ladder.buckets)
     except Exception as e:  # any staging fault refuses, live keeps serving
         return _reject(engine, label, gates, "staging",
@@ -232,10 +265,12 @@ def swap_staged(engine: ServingEngine, serving_model, label: str,
             live_scores = _shadow_scores(engine.model, sample, engine.ladder)
             cand_scores = _shadow_scores(staged, sample, engine.ladder)
         except Exception as e:
+            staged.close_stores()
             return _reject(engine, label, gates, "shadow",
                            f"shadow scoring failed: {e!r}",
                            shadow_requests=shadow_n)
         if not np.all(np.isfinite(cand_scores)):
+            staged.close_stores()
             return _reject(engine, label, gates, "shadow",
                            "candidate produced non-finite shadow scores",
                            shadow_requests=shadow_n)
@@ -244,6 +279,7 @@ def swap_staged(engine: ServingEngine, serving_model, label: str,
         _metrics.histogram("serving.swap_shadow_deviation",
                            DEVIATION_BUCKETS).observe(max_dev)
         if max_dev > cfg.max_shadow_deviation:
+            staged.close_stores()
             return _reject(engine, label, gates, "shadow",
                            f"shadow deviation {max_dev:.3e} > "
                            f"{cfg.max_shadow_deviation:.3e} "
@@ -257,6 +293,7 @@ def swap_staged(engine: ServingEngine, serving_model, label: str,
     # compiles: staging+shadow must not have compiled on the steady path
     steady1 = compile_cache.compile_counts().get("steady_state", 0)
     if steady1 != steady0:
+        staged.close_stores()
         return _reject(engine, label, gates, "compiles",
                        f"{steady1 - steady0} steady-state compiles during "
                        f"staging/shadow", shadow_requests=shadow_n,

@@ -49,6 +49,13 @@ class FallbackReason(str, enum.Enum):
     #: the compiled scorer raised or produced non-finite scores; the
     #: request gets a typed failure, never a hot-path exception
     SCORER_FAILURE = "scorer_failure"
+    #: the entity exists in the model but its coefficient rows were still
+    #: in the host-RAM cold tier at batch-pop time (two-tier store): the
+    #: coordinate contributes zero for THIS request — like
+    #: SLO_SHED_RANDOM_EFFECTS but per-entity — and the miss promotes the
+    #: rows so the next request finds them hot. Never a synchronous
+    #: host->device stall on the scoring path.
+    COLD_MISS = "cold_miss"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -238,6 +245,45 @@ class SwapConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class CoeffStoreConfig:
+    """Two-tier coefficient store: host-RAM cold tier + HBM hot set.
+
+    The hot tier is a fixed-capacity device gather table per coordinate,
+    LRU-managed over entity traffic with admission-time async prefetch.
+    Capacity is ``hbm_budget_bytes / row_bytes`` rounded DOWN to a power
+    of two (the table's leading dim is a compiled-program shape: pow2
+    sizing keeps scorer programs stable so steady-state serving still
+    performs zero compiles), or ``hot_capacity`` when given explicitly.
+    """
+
+    #: per-coordinate HBM budget for the hot gather table, in bytes;
+    #: capacity = pow2_floor(budget / (slot_width * 4)). Exactly one of
+    #: this and ``hot_capacity`` must be set.
+    hbm_budget_bytes: Optional[int] = None
+    #: explicit hot-row capacity (rounded down to a power of two)
+    hot_capacity: Optional[int] = None
+    #: rows per coalesced cold->hot upload: misses are batched into ONE
+    #: ``jax.device_put`` + one fixed-shape donated scatter per cycle
+    #: (the fixed shape keeps the transfer program compile-free too)
+    transfer_batch: int = 256
+    #: resolve entity ids at admission (MicroBatcher ``on_admit``
+    #: lookahead) and schedule uploads before batch release; off =
+    #: promotion only on COLD_MISS
+    prefetch: bool = True
+
+    def __post_init__(self):
+        if (self.hbm_budget_bytes is None) == (self.hot_capacity is None):
+            raise ValueError(
+                "exactly one of hbm_budget_bytes / hot_capacity required")
+        if self.hbm_budget_bytes is not None and self.hbm_budget_bytes < 4:
+            raise ValueError("hbm_budget_bytes must cover at least one row")
+        if self.hot_capacity is not None and self.hot_capacity < 1:
+            raise ValueError("hot_capacity must be >= 1")
+        if self.transfer_batch < 1:
+            raise ValueError("transfer_batch must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class ServingConfig:
     """Engine knobs. Every shape-bearing value here is part of the
     compiled-program key: changing it after warmup would recompile, so
@@ -257,6 +303,11 @@ class ServingConfig:
     deadline: DeadlineConfig = dataclasses.field(default_factory=DeadlineConfig)
     breaker: BreakerConfig = dataclasses.field(default_factory=BreakerConfig)
     swap: SwapConfig = dataclasses.field(default_factory=SwapConfig)
+    #: two-tier coefficient store; None = every random-effect table fully
+    #: device-resident (the pre-cold-tier behavior). When set, any
+    #: coordinate loaded with a cold-store file serves from a hot-set
+    #: gather cache under this budget.
+    coeff_store: Optional[CoeffStoreConfig] = None
     #: graceful drain: after ``begin_drain`` the engine keeps flushing
     #: in-flight micro-batches for at most this long; whatever is still
     #: queued past the budget gets a typed SHUTTING_DOWN refusal

@@ -42,6 +42,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCAN_DIRS = (
     os.path.join(REPO, "photon_tpu", "optim"),
     os.path.join(REPO, "photon_tpu", "game"),
+    # serving hot path: the scorer dispatch and the two-tier store's
+    # transfer thread — one blocking transfer in either serializes
+    # every in-flight micro-batch behind it
+    os.path.join(REPO, "photon_tpu", "serving", "scorer.py"),
+    os.path.join(REPO, "photon_tpu", "serving", "coeff_store.py"),
 )
 MARKER = "host-sync-ok"
 
@@ -104,24 +109,30 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _check_file(path: str, violations: List[str]) -> None:
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        violations.append(f"{path}: unparseable: {e}")
+        return
+    v = _Visitor(path, src.splitlines())
+    v.visit(tree)
+    violations.extend(v.violations)
+
+
 def check(paths=SCAN_DIRS) -> List[str]:
     violations: List[str] = []
     for root in paths:
+        if os.path.isfile(root):
+            _check_file(root, violations)
+            continue
         for dirpath, _dirs, files in os.walk(root):
             for name in sorted(files):
                 if not name.endswith(".py"):
                     continue
-                path = os.path.join(dirpath, name)
-                with open(path) as f:
-                    src = f.read()
-                try:
-                    tree = ast.parse(src, filename=path)
-                except SyntaxError as e:
-                    violations.append(f"{path}: unparseable: {e}")
-                    continue
-                v = _Visitor(path, src.splitlines())
-                v.visit(tree)
-                violations.extend(v.violations)
+                _check_file(os.path.join(dirpath, name), violations)
     return violations
 
 
@@ -133,8 +144,8 @@ def main() -> int:
         for v in violations:
             print(f"  {v}")
         return 1
-    print("ok: no host-sync primitives in photon_tpu/optim or "
-          "photon_tpu/game")
+    print("ok: no host-sync primitives in photon_tpu/optim, "
+          "photon_tpu/game, or the serving hot path")
     return 0
 
 

@@ -140,6 +140,164 @@ def _jitted_programs(model, ladder):
     return [f for f in jitted if hasattr(f, "_cache_size")]
 
 
+def build_model_dir(seed: int, out_dir: str):
+    """Synthetic GAME model SAVED to disk with per-coordinate cold stores
+    and feature-index sidecars — the two-tier arm's loading unit. Returns
+    the feature names for request building."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from photon_tpu.game.dataset import EntityVocabulary
+    from photon_tpu.game.model import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.io.index_map import IndexMap, feature_key
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    names = [f"f{j}" for j in range(17)]
+    imap = IndexMap({feature_key(n, ""): i for i, n in enumerate(names)})
+    D = imap.feature_dimension
+    E, K = 5, 3
+    coef = rng.normal(size=(E, K)).astype(np.float32)
+    proj = np.zeros((E, K), np.int32)
+    for e in range(E):
+        proj[e] = np.sort(rng.choice(D, size=K, replace=False))
+    fixed = FixedEffectModel(
+        GeneralizedLinearModel(
+            Coefficients(jnp.asarray(rng.normal(size=D).astype(np.float32))),
+            TaskType.LINEAR_REGRESSION), "shardA")
+    rem = RandomEffectModel(
+        coefficients=jnp.asarray(coef), random_effect_type="userId",
+        feature_shard_id="shardA", task=TaskType.LINEAR_REGRESSION)
+    vocab = EntityVocabulary()
+    vocab.build("userId", [f"u{e}" for e in range(E)])
+    save_game_model(out_dir, GameModel({"global": fixed, "per-user": rem}),
+                    {"shardA": imap}, vocab=vocab,
+                    projections={"per-user": proj}, sparsity_threshold=0.0)
+    return names
+
+
+def two_tier_arm(baseline, registry, compile_cache) -> list:
+    """Drive the same contract with the two-tier coefficient store active:
+    cold misses, promotes, LRU churn, shed mode, and a live swap to a
+    second two-tier model — the steady-state compile counter must stay
+    frozen through all of it (the async transfer thread's scatter and the
+    re-dispatches on fresh table objects included)."""
+    import tempfile
+
+    from photon_tpu.io.model_io import load_for_serving
+    from photon_tpu.serving import (
+        CoeffStoreConfig,
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+        SLOConfig,
+    )
+    from photon_tpu.serving.swap import swap_staged
+    import numpy as np
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="twotier_ck_") as td:
+        import os as _os
+        d1, d2 = _os.path.join(td, "v1"), _os.path.join(td, "v2")
+        names = build_model_dir(7, d1)
+        build_model_dir(23, d2)
+        engine = ServingEngine.from_model_dir(d1, config=ServingConfig(
+            max_batch=8, max_wait_s=0.0,
+            slo=SLOConfig(shed_queue_depth=6, reject_queue_depth=100),
+            coeff_store=CoeffStoreConfig(hot_capacity=4, transfer_batch=2)))
+        if not engine.model.has_stores:
+            return ["two-tier arm: engine loaded without stores"]
+        engine.warmup()
+
+        misses0 = registry.counter("jitcache.misses").value
+        jitted = _jitted_programs(engine.model, engine.ladder)
+        traces0 = [f._cache_size() for f in jitted]
+
+        rng = np.random.default_rng(3)
+
+        def req(uid, n_feats, user):
+            feats = [(str(names[j]), "", float(rng.normal()))
+                     for j in rng.choice(len(names), size=n_feats,
+                                         replace=False)]
+            return ScoreRequest(uid, {"shardA": feats},
+                                {"userId": user} if user else {})
+
+        served = 0
+        # two passes: first one cold-misses and prefetches, second one
+        # hits hot rows; capacity 4 < 5 users keeps LRU churning
+        for round_ in range(2):
+            for n in range(1, engine.ladder.max_batch + 1):
+                reqs = [req(f"t{round_}-{n}-{i}",
+                            int(rng.integers(0, len(names))),
+                            f"u{i % 5}" if i % 3 else "cold-entity")
+                        for i in range(n)]
+                served += len(engine.serve(reqs))
+            engine.model.drain_prefetch()
+        for i in range(engine.config.slo.shed_queue_depth + 3):
+            engine.submit(req(f"ts{i}", 4, f"u{i % 5}"))
+        served += len(engine.drain())
+        engine.model.drain_prefetch()
+
+        after = compile_cache.compile_counts()
+        misses1 = registry.counter("jitcache.misses").value
+        traces1 = [f._cache_size() for f in jitted]
+        if after["steady_state"] != baseline["steady_state"]:
+            failures.append(
+                f"two-tier steady-state compiles moved: "
+                f"{baseline['steady_state']} -> {after['steady_state']}")
+        if misses1 != misses0:
+            failures.append(f"two-tier jitcache.misses moved: "
+                            f"{misses0} -> {misses1}")
+        for i, (t0, t1) in enumerate(zip(traces0, traces1)):
+            if t1 > t0:
+                failures.append(f"two-tier program {i} re-traced: "
+                                f"_cache_size {t0} -> {t1}")
+
+        # live swap to a second two-tier model (staged store, shadow
+        # prefetch, validated publish) — still zero steady-state compiles
+        result = swap_staged(engine, load_for_serving(d2), "v2")
+        if not result.accepted:
+            failures.append(f"two-tier swap rejected: {result.reason} "
+                            f"(gates {result.gates})")
+        else:
+            misses2 = registry.counter("jitcache.misses").value
+            jitted += _jitted_programs(engine.model, engine.ladder)
+            traces2 = [f._cache_size() for f in jitted]
+            for n in range(1, engine.ladder.max_batch + 1):
+                reqs = [req(f"p{n}-{i}", int(rng.integers(0, len(names))),
+                            f"u{i % 5}" if i % 3 else "cold-entity")
+                        for i in range(n)]
+                served += len(engine.serve(reqs))
+            engine.model.drain_prefetch()
+            final = compile_cache.compile_counts()
+            if final["steady_state"] != baseline["steady_state"]:
+                failures.append(
+                    f"two-tier post-swap steady-state compiles moved: "
+                    f"{baseline['steady_state']} -> {final['steady_state']}")
+            if registry.counter("jitcache.misses").value != misses2:
+                failures.append("two-tier post-swap jitcache.misses moved")
+            for i, (t0, t1) in enumerate(
+                    zip(traces2, [f._cache_size() for f in jitted])):
+                if t1 > t0:
+                    failures.append(f"two-tier post-swap program {i} "
+                                    f"re-traced: {t0} -> {t1}")
+        cs = engine.model.coeff_store_stats() or {}
+        engine.shutdown()
+        if not failures:
+            st = next(iter(cs.values()), {})
+            print(f"ok: two-tier arm served {served} "
+                  f"(hits={st.get('hits')}, cold_misses={st.get('cold_misses')}, "
+                  f"promotes={st.get('promotes')}, evictions={st.get('evictions')}), "
+                  f"swap to v{result.version}, steady-state compiles=0")
+    return failures
+
+
 def main() -> int:
     from photon_tpu.obs.metrics import registry
     from photon_tpu.serving.scorer import MODES
@@ -220,6 +378,14 @@ def main() -> int:
     if failures:
         print("FAIL: serving compiled across the live swap:")
         for f in failures:
+            print("  " + f)
+        return 1
+
+    # -- two-tier coefficient store arm: same contract, cold tier active
+    tt_failures = two_tier_arm(baseline, registry, compile_cache)
+    if tt_failures:
+        print("FAIL: two-tier serving compiled:")
+        for f in tt_failures:
             print("  " + f)
         return 1
     print(f"ok: {served} responses over buckets {list(engine.ladder.buckets)}"

@@ -500,12 +500,15 @@ class TestCheckpointSchemaV3:
         assert state.next_coordinate == 3
 
     def test_schema_version_and_default(self, rng, tmp_path):
-        assert ckpt.SCHEMA_VERSION == 3
+        # v3 added group_boundary; v4 added re_block_cursor — both
+        # default-off, so v3-era saves load unchanged
+        assert ckpt.SCHEMA_VERSION == 4
         d = str(tmp_path / "ck")
         path = ckpt.save_checkpoint(d, 0, self._model(rng), {"fixed": 1})
         meta = json.load(open(os.path.join(path, "meta.json")))
-        assert meta["schema"] == 3
+        assert meta["schema"] == 4
         assert ckpt.load_latest(d).group_boundary is False
+        assert ckpt.load_latest(d).re_block_cursor == {}
 
 
 # ---------------------------------------------------------------------------

@@ -471,3 +471,161 @@ def test_no_recompile_script():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert r.returncode == 0, r.stdout
     assert "ok:" in r.stdout
+
+
+# -- two-tier coefficient store: tier boundaries -----------------------------
+
+
+def _two_tier_engine(model_dir, prefetch=True):
+    from photon_tpu.serving import CoeffStoreConfig
+
+    cfg = ServingConfig(
+        max_batch=8, max_wait_s=0.0,
+        coeff_store=CoeffStoreConfig(hot_capacity=4, transfer_batch=2,
+                                     prefetch=prefetch))
+    engine = ServingEngine.from_model_dir(model_dir, config=cfg)
+    engine.warmup()
+    return engine
+
+
+def test_two_tier_hot_scores_bitwise_equal_full_resident(served):
+    """Once an entity's rows are resident, the two-tier engine and the
+    fully-resident engine score it from the SAME f32 values through the
+    same gather+dot shape — equality is exact, not approximate. With
+    hot_capacity == N_USERS every known user stays resident after one
+    promotion pass, so the whole second sweep crosses no tier boundary."""
+    engine_full, samples, _offline, _, model_dir = served
+    engine = _two_tier_engine(model_dir)
+    try:
+        reqs = _requests(samples)
+        engine.serve(reqs)                    # promote the working set
+        assert engine.model.drain_prefetch()
+        got = engine.serve(reqs)
+        want = engine_full.serve(reqs)
+        for s, g, w in zip(samples, got, want):
+            assert g.score == w.score, s["user"]
+            if not s["user"].startswith("cold"):
+                assert not g.degraded and not g.fallbacks
+    finally:
+        engine.shutdown()
+
+
+def test_two_tier_cold_then_promoted(served):
+    """The tier transition itself: first touch of a known entity with
+    admission prefetch off degrades typed (COLD_MISS, fixed-effect-only
+    score) AND queues the promotion; after the transfer drains, the same
+    request scores clean and matches the offline reference."""
+    _engine_full, samples, offline, _, model_dir = served
+    engine = _two_tier_engine(model_dir, prefetch=False)
+    try:
+        i = next(i for i, s in enumerate(samples)
+                 if not s["user"].startswith("cold"))
+        req = _requests([samples[i]])
+        r1 = engine.serve(req)[0]
+        assert r1.degraded
+        assert FallbackReason.COLD_MISS in {f.reason for f in r1.fallbacks}
+        assert r1.score is not None           # fixed-effect-only, not a drop
+        assert engine.model.drain_prefetch()
+        r2 = engine.serve(req)[0]
+        assert not r2.degraded and not r2.fallbacks
+        assert r2.score == pytest.approx(float(offline[i]), abs=1e-6)
+        st = engine.model.coeff_store_stats()
+        assert st and list(st.values())[0]["cold_misses"] >= 1
+    finally:
+        engine.shutdown()
+
+
+def test_two_tier_unknown_entity_typed(served):
+    """An entity absent from the cold store is UNKNOWN (not COLD_MISS):
+    no promotion is queued and the degradation reason distinguishes
+    'never seen' from 'not resident yet'."""
+    _engine_full, samples, offline, _, model_dir = served
+    engine = _two_tier_engine(model_dir)
+    try:
+        i = next(i for i, s in enumerate(samples)
+                 if s["user"].startswith("cold"))
+        r = engine.serve(_requests([samples[i]]))[0]
+        assert r.degraded
+        reasons = {f.reason for f in r.fallbacks}
+        assert FallbackReason.UNKNOWN_ENTITY in reasons
+        assert FallbackReason.COLD_MISS not in reasons
+        assert r.score == pytest.approx(float(offline[i]), abs=1e-6)
+    finally:
+        engine.shutdown()
+
+
+# -- admission lookahead (MicroBatcher.on_admit) -----------------------------
+
+
+def _req(uid, user="user0"):
+    return ScoreRequest(uid, {"g": [], "u": []}, {"userId": user})
+
+
+def test_on_admit_fires_once_before_queueing():
+    t = {"now": 0.0}
+    seen = []
+    mb = MicroBatcher(BucketLadder(max_batch=4), max_wait_s=1.0,
+                      clock=lambda: t["now"],
+                      on_admit=lambda r: seen.append((r.uid, mb.depth())))
+    mb.submit(_req("a"))
+    mb.submit(_req("b"))
+    # called exactly once per request, BEFORE it lands in the queue —
+    # the depth the hook observes excludes the request being admitted
+    assert seen == [("a", 0), ("b", 1)]
+
+
+def test_on_admit_deadline_override_still_sees_request():
+    """A request released early by its own deadline (tighter than the
+    oldest-waiter wait) was still prefetched at admission: the hook ran
+    under submit(), before any release policy could pop the batch."""
+    t = {"now": 0.0}
+    seen = []
+    mb = MicroBatcher(BucketLadder(max_batch=8), max_wait_s=1.0,
+                      clock=lambda: t["now"], deadline_headroom_s=0.1,
+                      on_admit=lambda r: seen.append(r.uid))
+    mb.submit(_req("slow"))
+    mb.submit(_req("urgent"), deadline=0.5)
+    assert not mb.ready()                     # 0 < 0.5 - 0.1, wait 0 < 1.0
+    t["now"] = 0.41                           # inside deadline headroom
+    assert mb.ready()
+    batch, bucket = mb.next_batch()
+    assert {p.request.uid for p in batch} == {"slow", "urgent"}
+    assert seen == ["slow", "urgent"]         # both prefetched pre-pop
+    assert bucket >= len(batch)
+
+
+def test_on_admit_errors_never_refuse_admission():
+    def boom(_r):
+        raise RuntimeError("lookahead broke")
+
+    mb = MicroBatcher(BucketLadder(max_batch=4), max_wait_s=0.0,
+                      on_admit=boom)
+    mb.submit(_req("a"))                      # must not raise
+    assert mb.depth() == 1
+    batch, _ = mb.next_batch(flush=True)
+    assert batch[0].request.uid == "a"
+
+
+# -- coldtier bench smoke (tier-1 wiring for bench.py --mode coldtier) -------
+
+
+def test_bench_coldtier_quick_smoke():
+    """The quick coldtier bench is the end-to-end smoke: synthetic cold
+    store -> two-tier engine -> warm/steady phases -> parity + compile
+    checks, all CPU-sized. Asserts the record's pass/fail fields rather
+    than the performance numbers (those are hardware-dependent)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "coldtier", "--quick"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads([l for l in proc.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert rec["metric"] == "coldtier_steady_hit_rate"
+    assert "error" not in rec, rec
+    assert rec["quick"] is True
+    assert rec["hot_parity_ok"] is True
+    assert rec["zero_steady_state_compiles"] is True
+    assert rec["value"] > 0.5                 # quick Zipf still mostly hits
+    assert rec["store"]["promotes"] > 0
