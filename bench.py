@@ -2342,6 +2342,306 @@ def run_game_cd_bench(scale: float, quick: bool = False):
     return rec
 
 
+# --------------------------------------------------------------------------
+# nearline mode: --mode nearline -> BENCH_NEARLINE_r01.json
+# --------------------------------------------------------------------------
+
+def run_nearline_bench(scale: float, quick: bool = False):
+    """Nearline delta-training pipeline benchmark (ISSUE 9): a two-tier
+    serving engine scores closed-loop traffic from one thread while the
+    nearline loop (event log -> delta train -> row-level live publish)
+    runs rounds against the SAME engine from another.  Measures
+
+      * freshness: median/p99 event-timestamp -> row-scoreable lag (the
+        pipeline's north-star; commit time stamps the scoreable moment),
+      * publish cost: p50/p99 accepted publish round seconds,
+      * serving interference: concurrent qps vs a no-publish baseline
+        on the same engine (target ratio >= 0.9),
+      * safety: every publish accepted with verify=pass, hot/cold row
+        coherence bitwise on a touched entity, and zero steady-state
+        compiles across the entire publish phase (compile counter,
+        jitcache entries, per-program re-traces).
+
+    ``quick`` is the tier-1 smoke shape: a few hundred entities, three
+    measured rounds, no artifact write (the committed
+    BENCH_NEARLINE_r01.json only ever comes from a full run)."""
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.game.dataset import EntityVocabulary
+    from photon_tpu.game.model import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.io.index_map import IndexMap, feature_key
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.nearline import (
+        DeltaTrainConfig,
+        EventLogWriter,
+        NearlineConfig,
+        NearlinePipeline,
+        NearlinePublishConfig,
+    )
+    from photon_tpu.nearline.delta_trainer import current_entity_row
+    from photon_tpu.obs.metrics import registry as _registry
+    from photon_tpu.serving import (
+        CoeffStoreConfig,
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+        SLOConfig,
+    )
+    from photon_tpu.types import TaskType
+    from photon_tpu.utils import compile_cache
+
+    if quick:
+        E, K, d_global = 200, 2, 32
+        hot_capacity, transfer_batch = 64, 16
+        n_rounds, ents_per_round, baseline_s = 3, 16, 1.0
+        max_batch, round_interval_s = 8, 0.25
+    else:
+        E, K, d_global = int(20_000 * scale) or 500, 2, 64
+        hot_capacity, transfer_batch = 2048, 128
+        n_rounds, ents_per_round, baseline_s = 8, 96, 8.0
+        # 2s cadence is aggressive vs the CLI's 5s default poll interval
+        # but keeps the interference measurement a duty cycle, not a
+        # saturated publish loop
+        max_batch, round_interval_s = 16, 2.0
+    rng = np.random.default_rng(29)
+
+    # -- saved GAME model dir (cold store + index sidecars) ---------------
+    t0 = time.perf_counter()
+    names = [f"g{j}" for j in range(d_global)]
+    imap = IndexMap({feature_key(n, ""): i for i, n in enumerate(names)})
+    ids = [f"e{e:09d}" for e in range(E)]
+    coef = rng.normal(size=(E, K)).astype(np.float32)
+    lo = rng.integers(0, d_global - 1, size=E)
+    hi = rng.integers(lo + 1, d_global)
+    proj = np.stack([lo, hi], axis=1).astype(np.int32)
+    fixed = FixedEffectModel(
+        GeneralizedLinearModel(
+            Coefficients(jnp.asarray(
+                rng.normal(size=d_global).astype(np.float32))),
+            TaskType.LINEAR_REGRESSION), "g")
+    rem = RandomEffectModel(
+        coefficients=jnp.asarray(coef), random_effect_type="userId",
+        feature_shard_id="g", task=TaskType.LINEAR_REGRESSION)
+    vocab = EntityVocabulary()
+    vocab.build("userId", ids)
+    tdir = tempfile.mkdtemp(prefix="nearline_bench_")
+    mdir = os.path.join(tdir, "model")
+    save_game_model(mdir, GameModel({"global": fixed, "per_user": rem}),
+                    {"g": imap}, vocab=vocab,
+                    projections={"per_user": proj}, sparsity_threshold=0.0)
+    gen_s = time.perf_counter() - t0
+
+    engine = ServingEngine.from_model_dir(mdir, config=ServingConfig(
+        max_batch=max_batch, max_wait_s=0.0,
+        slo=SLOConfig(shed_queue_depth=200, reject_queue_depth=400),
+        coeff_store=CoeffStoreConfig(hot_capacity=hot_capacity,
+                                     transfer_batch=transfer_batch)))
+    winfo = engine.warmup()
+    log(f"nearline: {E} entities, model dir in {gen_s:.1f}s, "
+        f"warmed {winfo['programs']} programs")
+
+    nnz = 8
+    zipf_rows = (rng.zipf(1.4, size=1 << 20) - 1) % E
+    zi = [0]
+
+    def make_request(i):
+        row = int(zipf_rows[zi[0] % len(zipf_rows)])
+        zi[0] += 1
+        cols = rng.choice(d_global, size=nnz, replace=False)
+        return ScoreRequest(
+            f"q{i}", {"g": [(names[c], "", float(rng.normal()))
+                            for c in cols]},
+            {"userId": ids[row]})
+
+    def make_event(user, ts):
+        cols = rng.choice(d_global, size=nnz, replace=False)
+        return {"ts": ts, "response": float(rng.normal()),
+                "features": {"g": [[names[c], "", float(rng.normal())]
+                                   for c in cols]},
+                "entities": {"userId": user}}
+
+    log_dir = os.path.join(tdir, "events")
+    writer = EventLogWriter(log_dir)
+    pipe = NearlinePipeline(
+        engine, log_dir, model_dir=mdir,
+        config=NearlineConfig(
+            train=DeltaTrainConfig(),
+            publish=NearlinePublishConfig(parity_tol=1e-3)))
+
+    # -- warm rounds: compile the trainer's solve programs (entity count
+    # is a solve shape, so warm with the measured rounds' exact count)
+    # and the publisher path end to end, appends included
+    for i in range(min(256, 4 * hot_capacity)):
+        engine.submit(make_request(i))
+        if i % 64 == 63:
+            engine.pump()
+    engine.drain()
+    engine.model.drain_prefetch()
+    uniq = sorted({ids[int(r)] for r in zipf_rows[:8 * hot_capacity]})
+    warm_users = uniq[:ents_per_round]
+    writer.append([make_event(u, time.time()) for u in warm_users])
+    warm = pipe.run_round()
+    if not warm.get("publish", {}).get("accepted"):
+        raise RuntimeError(f"warm publish rejected: {warm.get('publish')}")
+    writer.append([make_event(u, time.time())
+                   for u in ("nb_new0", "nb_new1")])
+    warm2 = pipe.run_round()
+    if not warm2.get("publish", {}).get("accepted"):
+        raise RuntimeError(f"warm append rejected: {warm2.get('publish')}")
+
+    # -- serving thread: closed-loop scoring against the live engine ------
+    stop = threading.Event()
+    counts = {"served": 0}
+
+    def serve_loop():
+        i = 1 << 20
+        while not stop.is_set():
+            n = min(max_batch, 8)
+            engine.serve([make_request(i + j) for j in range(n)])
+            counts["served"] += n
+            i += n
+            if counts["served"] % 512 == 0:
+                engine.model.drain_prefetch()
+
+    # baseline: no publishes in flight
+    th = threading.Thread(target=serve_loop, daemon=True)
+    t0 = time.perf_counter()
+    th.start()
+    time.sleep(baseline_s)
+    stop.set()
+    th.join()
+    base_qps = counts["served"] / (time.perf_counter() - t0)
+
+    # -- measured publish phase: rounds concurrent with serving -----------
+    from photon_tpu.serving.scorer import MODES, get_scorer
+    programs = [get_scorer(engine.model, mode, b)
+                for mode in MODES for b in engine.ladder.buckets]
+    jitted = [p if hasattr(p, "_cache_size")
+              else getattr(p, "__wrapped__", p) for p in programs]
+    jitted = [f for f in jitted if hasattr(f, "_cache_size")]
+    compiles0 = compile_cache.compile_counts()
+    misses0 = _registry.counter("jitcache.misses").value
+    traces0 = [f._cache_size() for f in jitted]
+
+    stop.clear()
+    counts["served"] = 0
+    th = threading.Thread(target=serve_loop, daemon=True)
+    t0 = time.perf_counter()
+    th.start()
+    lags, pub_secs, accepted, rows_pub = [], [], 0, 0
+    verify_ok = True
+    for rnd in range(n_rounds):
+        users = sorted({uniq[(rnd * ents_per_round + j) % len(uniq)]
+                        for j in range(ents_per_round)})
+        while len(users) < ents_per_round:     # wrap collision: pad out
+            users.append(uniq[(len(users) * 7 + rnd) % len(uniq)])
+            users = sorted(set(users))
+        ts = time.time()
+        writer.append([make_event(u, ts) for u in users])
+        round_t0 = time.perf_counter()
+        s = pipe.run_round()
+        pub = s.get("publish")
+        if pub and pub.get("accepted"):
+            now = time.time()
+            accepted += 1
+            rows_pub += pub["rows_updated"] + pub["rows_appended"]
+            lags.extend([now - ts] * len(set(users)))
+            pub_secs.append(s["seconds"])
+            if pub["gates"].get("verify") != "pass":
+                verify_ok = False
+        else:
+            verify_ok = False
+            log(f"nearline: round {rnd} not accepted: {pub}")
+        # pace rounds at the pipeline's poll cadence: the interference
+        # measurement is publish-at-interval vs serving, not a saturated
+        # back-to-back publish loop no deployment would run
+        idle = round_interval_s - (time.perf_counter() - round_t0)
+        if idle > 0 and rnd < n_rounds - 1:
+            time.sleep(idle)
+    publish_phase_s = time.perf_counter() - t0
+    stop.set()
+    th.join()
+    pub_qps = counts["served"] / publish_phase_s
+    qps_ratio = pub_qps / max(base_qps, 1e-9)
+
+    compiles1 = compile_cache.compile_counts()
+    misses1 = _registry.counter("jitcache.misses").value
+    traces1 = [f._cache_size() for f in jitted]
+    zero_compiles = (
+        compiles1["steady_state"] == compiles0["steady_state"]
+        and misses1 == misses0
+        and all(t1 <= t0_ for t0_, t1 in zip(traces0, traces1)))
+
+    # -- parity: a touched entity's served row == its cold-tier row ------
+    rs = engine.model.random[0]
+    D = engine.model.shard_dims["g"]
+    probe = uniq[0]
+    served_row = current_entity_row(rs, probe, D)
+    r = rs.store.cold.entity_row(probe)
+    cold_row = (np.array(rs.store.cold.coef[r], np.float32),
+                np.array(rs.store.cold.proj[r], np.int32))
+    parity_ok = (served_row is not None
+                 and served_row[0].tobytes() == cold_row[0].tobytes()
+                 and served_row[1].tobytes() == cold_row[1].tobytes())
+
+    lags_a = np.asarray(lags) if lags else np.asarray([float("nan")])
+    pub_a = np.asarray(pub_secs) if pub_secs else np.asarray([float("nan")])
+    rec = {
+        "metric": "nearline_freshness_lag_p50",
+        "value": round(float(np.percentile(lags_a, 50)), 4),
+        "unit": "s",
+        "freshness_lag_p99_s": round(float(np.percentile(lags_a, 99)), 4),
+        "entities": E,
+        "slot_width": K,
+        "hot_capacity": hot_capacity,
+        "rounds": n_rounds,
+        "publishes": accepted,
+        "rows_published": rows_pub,
+        "publish_round_p50_s": round(float(np.percentile(pub_a, 50)), 4),
+        "publish_round_p99_s": round(float(np.percentile(pub_a, 99)), 4),
+        "baseline_qps": round(base_qps, 1),
+        "concurrent_qps": round(pub_qps, 1),
+        "qps_ratio": round(qps_ratio, 3),
+        "qps_ratio_target": 0.9,
+        "publish_parity_ok": bool(parity_ok and verify_ok),
+        "zero_steady_state_compiles": bool(zero_compiles),
+        "compile_counts": compile_cache.compile_counts(),
+        "pipeline": {k: pipe.totals[k] for k in ("events", "publishes",
+                                                 "rows_updated",
+                                                 "rows_appended")},
+        "generation_seconds": round(gen_s, 3),
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "tpu_unavailable": _STATE["tpu_unavailable"],
+        "quick": quick,
+    }
+    engine.shutdown()
+    try:
+        import shutil as _sh
+        _sh.rmtree(tdir, ignore_errors=True)
+    except Exception:
+        pass
+    if not quick:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_NEARLINE_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"nearline: freshness p50 {rec['value'] * 1e3:.1f}ms over "
+        f"{accepted}/{n_rounds} publishes ({rows_pub} rows), qps ratio "
+        f"{qps_ratio:.2f}, steady compiles frozen={zero_compiles}, "
+        f"parity ok={rec['publish_parity_ok']}")
+    return rec
+
+
 # Order = on-chip capture priority (each config emits its JSON line the
 # moment it completes, so when the flaky relay dies mid-run the most
 # decision-relevant numbers are already on disk): the NEWTON flagship,
@@ -2370,16 +2670,19 @@ def main():
     ap.add_argument("--configs", default=os.environ.get("BENCH_CONFIGS", ""),
                     help="comma-separated subset of config names")
     ap.add_argument("--mode", default=os.environ.get("BENCH_MODE", "train"),
-                    choices=("train", "serving", "game_cd", "coldtier"),
+                    choices=("train", "serving", "game_cd", "coldtier",
+                             "nearline"),
                     help="train = the solver configs (default); serving = "
                          "the online-serving bench -> BENCH_SERVING_r01.json; "
                          "game_cd = parallel-vs-sequential CD sweeps "
                          "-> BENCH_GAME_CD_r01.json; coldtier = two-tier "
                          "coefficient store under Zipf traffic "
-                         "-> BENCH_COLDTIER_r01.json")
+                         "-> BENCH_COLDTIER_r01.json; nearline = delta "
+                         "publish freshness under concurrent serving "
+                         "-> BENCH_NEARLINE_r01.json")
     ap.add_argument("--quick", action="store_true",
-                    help="game_cd/coldtier: tiny tier-1 smoke shape "
-                         "(no artifact write)")
+                    help="game_cd/coldtier/nearline: tiny tier-1 smoke "
+                         "shape (no artifact write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -2453,6 +2756,21 @@ def main():
             emit({"metric": "coldtier_steady_hit_rate", "value": 0.0,
                   "unit": "fraction", "error": repr(e)})
         _DONE.set()     # coldtier mode: the record above IS the summary
+        return
+
+    if args.mode == "nearline":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/nearline"):
+                emit(run_nearline_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"nearline bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "nearline_freshness_lag_p50", "value": 0.0,
+                  "unit": "s", "error": repr(e)})
+        _DONE.set()     # nearline mode: the record above IS the summary
         return
 
     if args.mode == "game_cd":

@@ -6,6 +6,7 @@ Entry points:
   python -m photon_tpu.cli.legacy         legacy single-GLM driver (Driver)
   python -m photon_tpu.cli.feature_index  feature index build (FeatureIndexingDriver)
   python -m photon_tpu.cli.serve          online serving (JSONL stdin -> stdout)
+  python -m photon_tpu.cli.nearline       nearline delta training (event log -> live tables)
 """
 
 from photon_tpu.cli.config import (

@@ -1,0 +1,166 @@
+"""Nearline delta-training driver: tail an event log into a live engine.
+
+One process = one serving engine (the same build path as ``cli/serve``)
+plus one :class:`~photon_tpu.nearline.pipeline.NearlinePipeline` looping
+poll -> delta-train -> row-publish -> checkpoint against it.  The engine
+here serves no external traffic — this driver exists to keep a model
+directory's coefficient tables (hot AND cold tier) fresh while a
+separate serving process reads them, or to run the whole closed loop in
+one process for tests and benchmarks.
+
+Event line schema (JSONL shards in ``--event-log``, one JSON object per
+line; Avro shards with the same payload also work)::
+
+    {"seq": 17,                   # assigned by the writer, monotone
+     "ts": 1754400000.0,          # unix seconds; drives freshness lag
+     "response": 1.0,
+     "offset": 0.0,
+     "weight": 1.0,
+     "features": {"shardA": [["name", "term", 1.5], ...]},
+     "entities": {"userId": "u17"}}
+
+Lifecycle: SIGTERM/SIGINT (the shared resilience shutdown flag) finishes
+the in-flight round, lands the final watermark checkpoint, writes the
+stats / RunReport artifacts, and exits 0.  Restart resumes from the
+durable watermark; a crash between publish and checkpoint is reconciled
+from the versioned delta manifest (exactly-once per publish).
+
+Usage::
+
+    python -m photon_tpu.cli.nearline \
+        --model-input-directory /path/to/model --event-log /path/to/log \
+        [--poll-interval-s 1.0] [--max-rounds 0] [--stats-output s.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import Optional
+
+logger = logging.getLogger("photon_tpu.nearline")
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="photon_tpu.nearline",
+        description="Tail an event log into live serving tables via "
+                    "delta training and row-level publish")
+    p.add_argument("--model-input-directory", required=True)
+    p.add_argument("--event-log", required=True,
+                   help="directory of append-only JSONL/Avro event shards")
+    p.add_argument("--coordinates", nargs="*", default=None,
+                   help="subset of coordinate ids to load (default: all)")
+    p.add_argument("--poll-interval-s", type=float, default=1.0,
+                   help="idle sleep between empty polls")
+    p.add_argument("--max-rounds", type=int, default=0,
+                   help="stop after N non-empty rounds (0 = until SIGTERM)")
+    p.add_argument("--max-events-per-round", type=int, default=None)
+    p.add_argument("--state-dir", default=None,
+                   help="checkpoint/manifest directory "
+                        "(default: <model_dir>/nearline)")
+    p.add_argument("--max-entity-buckets", type=int, default=4,
+                   help="size-bucketed solve programs per delta round")
+    p.add_argument("--fixed-refresh-every", type=int, default=0,
+                   help="full fixed-effect refresh cadence in rounds "
+                        "(0 = never; runs through the validated swap)")
+    p.add_argument("--max-row-deviation", type=float, default=None,
+                   help="reject delta rows deviating more than this from "
+                        "the live row (default: finite-only)")
+    p.add_argument("--parity-tol", type=float, default=1e-4,
+                   help="shadow-score parity tolerance on touched entities")
+    p.add_argument("--publish-probation-s", type=float, default=0.0,
+                   help="auto-rollback window watching the serving breaker")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="top of the engine's bucket ladder")
+    p.add_argument("--feature-pad", type=int, default=None)
+    p.add_argument("--append-reserve", type=int, default=None,
+                   help="zero rows reserved per full-resident coordinate "
+                        "for new-entity appends (default: engine default)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip ladder pre-compilation (debugging only)")
+    p.add_argument("--stats-output", default=None,
+                   help="write the pipeline summary JSON here at exit")
+    p.add_argument("--runreport-output", default=None,
+                   help="write a RunReport (with nearline section) here")
+    p.add_argument("--log-level", default="INFO")
+    return p
+
+
+def build_pipeline(args: argparse.Namespace):
+    from photon_tpu.nearline import (
+        DeltaTrainConfig,
+        NearlineConfig,
+        NearlinePipeline,
+        NearlinePublishConfig,
+    )
+    from photon_tpu.serving import ServingConfig, ServingEngine
+    from photon_tpu.utils import compile_cache
+
+    compile_cache.maybe_enable()
+    serving_kwargs = dict(max_batch=args.max_batch,
+                          feature_pad=args.feature_pad)
+    if args.append_reserve is not None:
+        serving_kwargs["append_reserve"] = args.append_reserve
+    engine = ServingEngine.from_model_dir(
+        args.model_input_directory, config=ServingConfig(**serving_kwargs),
+        coordinates_to_load=args.coordinates)
+    if not args.no_warmup:
+        info = engine.warmup()
+        logger.info("warmed %d programs over buckets %s in %.2fs",
+                    info["programs"], info["buckets"], info["seconds"])
+    config = NearlineConfig(
+        poll_interval_s=args.poll_interval_s,
+        max_rounds=args.max_rounds,
+        max_events_per_round=args.max_events_per_round,
+        state_dir=args.state_dir,
+        train=DeltaTrainConfig(
+            max_entity_buckets=args.max_entity_buckets,
+            fixed_refresh_every=args.fixed_refresh_every),
+        publish=NearlinePublishConfig(
+            max_row_deviation=(args.max_row_deviation
+                               if args.max_row_deviation is not None
+                               else float("inf")),
+            parity_tol=args.parity_tol,
+            probation_s=args.publish_probation_s))
+    return NearlinePipeline(engine, args.event_log,
+                            model_dir=args.model_input_directory,
+                            config=config)
+
+
+def run(args: argparse.Namespace) -> int:
+    logging.basicConfig(
+        level=args.log_level, stream=sys.stderr,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    import photon_tpu.serving as serving_pkg
+    from photon_tpu.resilience import shutdown
+
+    pipeline = build_pipeline(args)
+    serving_pkg.set_active_engine(pipeline.engine)
+    shutdown.install()
+    try:
+        summary = pipeline.run()
+    finally:
+        shutdown.uninstall()
+        pipeline.engine.shutdown(0.0, reason="nearline loop exit")
+    logger.info("nearline loop done: %d rounds, %d rows published",
+                summary["rounds"], summary["totals"]["rows_updated"]
+                + summary["totals"]["rows_appended"])
+    if args.stats_output:
+        with open(args.stats_output, "w") as f:
+            json.dump(summary, f, indent=1)
+            f.write("\n")
+    if args.runreport_output:
+        from photon_tpu.obs.report import write_run_report
+        write_run_report(args.runreport_output, driver="nearline")
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    return run(build_arg_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,25 @@ search over the mmapped id table — no host dict of N entries is ever
 built. The crc footer makes torn or bit-flipped files refusable at swap
 validation (``verify()``); the chaos harness's ``corrupt_cold_store``
 drives that gate.
+
+The updatable layout (``photon_tpu.coldstore.v2``) is the nearline
+delta-publish substrate: sections are sized to a reserved ``capacity``
+(rows) and ``id_blob_len`` (id bytes) so row updates and entity appends
+rewrite only the touched bytes in place, and the single whole-file crc
+footer becomes a crc *table* — one entry per ``rows_per_chunk`` rows of
+the coef and proj sections plus one each for the id region, the sort
+region, and the header — so a delta publish recomputes only the crcs of
+the chunks it touched. Storage rows are append-stable (an entity's row
+index never changes once assigned — the serving hot tier caches cold row
+numbers), and id-ordered lookup goes through a sort-indirection section
+instead of physically sorted rows. Every byte of a v2 file is either
+covered by a crc entry or is itself part of the crc table, so a torn
+in-place update (killed between the data write and the crc/header
+rewrite) is refusable by ``verify()`` exactly like a torn v1 write.
+``apply_cold_store_delta`` / ``rollback_cold_store_delta`` are the
+nearline publisher's commit and bitwise-undo primitives;
+``upgrade_cold_store`` rewrites a v1 (or full v2) file with fresh
+reserve space.
 """
 
 from __future__ import annotations
@@ -42,9 +61,11 @@ from photon_tpu.resilience import chaos as _chaos
 
 MAGIC = b"PHOTCOLD"
 SCHEMA = "photon_tpu.coldstore.v1"
+SCHEMA_V2 = "photon_tpu.coldstore.v2"
 COLD_STORE_DIR = "cold-store"
 COLD_STORE_SUFFIX = ".coldstore"
 _ALIGN = 64
+_SENTINEL = 10 ** 14  # 15-digit placeholder reserving header field width
 
 
 class ColdStoreCorruptError(RuntimeError):
@@ -53,6 +74,27 @@ class ColdStoreCorruptError(RuntimeError):
     def __init__(self, path: str, detail: str):
         self.path = path
         super().__init__(f"corrupt cold store at {path}: {detail}")
+
+
+class ColdStoreNotUpdatable(RuntimeError):
+    """In-place delta applied to a file without reserved sections (v1).
+    Callers upgrade first via ``upgrade_cold_store``."""
+
+    def __init__(self, path: str, schema):
+        self.path = path
+        super().__init__(
+            f"cold store at {path} (schema {schema!r}) is not updatable; "
+            f"run upgrade_cold_store() first")
+
+
+class ColdStoreCapacityError(RuntimeError):
+    """A delta would overflow the file's reserved row or id-blob space.
+    Typed so the publisher can turn it into a gate failure (or an
+    automatic ``upgrade_cold_store``) instead of a torn write."""
+
+    def __init__(self, path: str, detail: str):
+        self.path = path
+        super().__init__(f"cold store at {path} out of capacity: {detail}")
 
 
 def cold_store_path(model_dir: str, coordinate_id: str) -> str:
@@ -87,6 +129,29 @@ def _pad(f, crc: int, pos: int) -> Tuple[int, int]:
     return crc, pos + gap
 
 
+def _aligned(pos: int) -> int:
+    return pos + ((-pos) % _ALIGN)
+
+
+def normalize_slot_rows(coefficients: np.ndarray, projection: np.ndarray
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Normalize coefficient/projection rows to the canonical on-disk and
+    serving form: valid slots sorted ascending by global column, -1 pads
+    last. The serving hot-tier slot replay (searchsorted over the valid
+    prefix) and the bitwise delta-parity gates both depend on every row —
+    whether written at model save or row-published nearline — being in
+    exactly this layout. Rows already normalized pass through unchanged
+    (stable sort)."""
+    coefficients = np.asarray(coefficients, dtype=np.float32)
+    projection = np.asarray(projection, dtype=np.int32)
+    if coefficients.size and coefficients.shape[-1] > 1:
+        key = np.where(projection < 0, np.iinfo(np.int32).max, projection)
+        slot_order = np.argsort(key, axis=-1, kind="stable")
+        projection = np.take_along_axis(projection, slot_order, axis=-1)
+        coefficients = np.take_along_axis(coefficients, slot_order, axis=-1)
+    return coefficients, projection
+
+
 def write_cold_store(
     path: str,
     coordinate_id: str,
@@ -96,6 +161,11 @@ def write_cold_store(
     projection: np.ndarray,
     entity_ids: Union[Sequence[str], np.ndarray],
     chunk_rows: int = 262144,
+    *,
+    updatable: bool = False,
+    capacity: Optional[int] = None,
+    id_blob_cap: Optional[int] = None,
+    rows_per_chunk: int = 4096,
 ) -> str:
     """Write one coordinate's cold-tier file; returns its path.
 
@@ -103,6 +173,11 @@ def write_cold_store(
     any order. Streams in ``chunk_rows`` chunks (a 10M-entity table never
     needs a second full copy in RAM beyond the sort permutation) and
     publishes atomically (tmp + fsync + rename).
+
+    ``updatable=True`` writes the v2 layout with ``capacity`` reserved
+    rows and ``id_blob_cap`` reserved id bytes (defaults: ~25% headroom)
+    so the nearline publisher can row-update and entity-append in place;
+    the crc footer becomes a per-``rows_per_chunk`` chunk table.
     """
     coefficients = np.asarray(coefficients, dtype=np.float32)
     projection = np.asarray(projection, dtype=np.int32)
@@ -119,13 +194,15 @@ def write_cold_store(
     # column, -1 pads last) — the invariant the serving hot-tier slot
     # replay (searchsorted over the valid prefix) depends on; rows
     # already in that form pass through unchanged (stable sort)
-    if num_entities and slot_width > 1:
-        key = np.where(projection < 0, np.iinfo(np.int32).max, projection)
-        slot_order = np.argsort(key, axis=1, kind="stable")
-        projection = np.take_along_axis(projection, slot_order, axis=1)
-        coefficients = np.take_along_axis(coefficients, slot_order, axis=1)
+    coefficients, projection = normalize_slot_rows(coefficients, projection)
 
     order = np.argsort(ids, kind="stable")
+    if updatable:
+        return _write_cold_store_v2(
+            path, coordinate_id, random_effect_type, feature_shard_id,
+            coefficients, projection, ids, order,
+            capacity=capacity, id_blob_cap=id_blob_cap,
+            rows_per_chunk=rows_per_chunk, chunk_rows=chunk_rows)
     ids = ids[order]
 
     header = {
@@ -211,6 +288,201 @@ def write_cold_store(
     return path
 
 
+# -- v2 updatable layout ------------------------------------------------------
+
+
+def _read_header(path: str) -> Tuple[dict, int]:
+    """(header dict, header byte length) — shared by the reader and the
+    in-place delta functions."""
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ColdStoreCorruptError(path, f"bad magic {magic!r}")
+        hlen = int.from_bytes(f.read(4), "little")
+        if hlen <= 0 or hlen > 1 << 20:
+            raise ColdStoreCorruptError(path, f"bad header length {hlen}")
+        try:
+            h = json.loads(f.read(hlen))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise ColdStoreCorruptError(path, f"unparseable header: {e}")
+    return h, hlen
+
+
+def _rewrite_header(f, h: dict, hlen: int) -> None:
+    """Re-serialize the header in place, padded to its reserved length.
+    Safe because every mutable numeric field was measured at creation
+    with a sentinel wider than any legal value."""
+    hb = json.dumps(h).encode()
+    if len(hb) > hlen:
+        raise ColdStoreCorruptError(
+            f.name, f"header grew past reserved length ({len(hb)} > {hlen})")
+    hb += b" " * (hlen - len(hb))
+    f.seek(len(MAGIC) + 4)
+    f.write(hb)
+
+
+def _region_crc(f, lo: int, hi: int, buf: int = 4 << 20) -> int:
+    f.seek(lo)
+    crc, remaining = 0, hi - lo
+    while remaining > 0:
+        data = f.read(min(buf, remaining))
+        if not data:
+            raise ColdStoreCorruptError(f.name, "short read during crc scan")
+        crc = zlib.crc32(data, crc)
+        remaining -= len(data)
+    return crc
+
+
+def _v2_chunk_bounds(h: dict, section: str) -> List[Tuple[int, int]]:
+    """Byte ranges of each crc chunk of the coef/proj section. The last
+    chunk extends to the next section offset so alignment padding is
+    always covered by exactly one crc entry."""
+    off = h["coef_off"] if section == "coef" else h["proj_off"]
+    end = h["proj_off"] if section == "coef" else h["id_offsets_off"]
+    csz = h["rows_per_chunk"] * h["slot_width"] * 4
+    n = h["n_chunks"]
+    return [(off + ci * csz, end if ci == n - 1 else min(off + (ci + 1) * csz, end))
+            for ci in range(n)]
+
+
+def _v2_recompute_crcs(f, h: dict, *, coef_chunks=None, proj_chunks=None,
+                       ids: bool = True, sort: bool = True,
+                       header: bool = True) -> None:
+    """Recompute and write the selected crc-table entries by reading the
+    current file bytes back. ``coef_chunks``/``proj_chunks`` are chunk
+    indices (None = all). Table layout: [coef chunks..., proj chunks...,
+    ids region, sort region, header region]."""
+    n = h["n_chunks"]
+    coef_bounds = _v2_chunk_bounds(h, "coef")
+    proj_bounds = _v2_chunk_bounds(h, "proj")
+    entries: List[Tuple[int, int, int]] = []  # (table idx, lo, hi)
+    for ci in sorted(set(range(n) if coef_chunks is None else coef_chunks)):
+        entries.append((ci,) + coef_bounds[ci])
+    for ci in sorted(set(range(n) if proj_chunks is None else proj_chunks)):
+        entries.append((n + ci,) + proj_bounds[ci])
+    if ids:
+        entries.append((2 * n, h["id_offsets_off"], h["sort_off"]))
+    if sort:
+        entries.append((2 * n + 1, h["sort_off"], h["crc_off"]))
+    if header:
+        entries.append((2 * n + 2, 0, h["coef_off"]))
+    for idx, lo, hi in entries:
+        crc = _region_crc(f, lo, hi)
+        f.seek(h["crc_off"] + 4 * idx)
+        f.write(crc.to_bytes(4, "little"))
+
+
+def _write_cold_store_v2(
+    path: str,
+    coordinate_id: str,
+    random_effect_type: str,
+    feature_shard_id: str,
+    coefficients: np.ndarray,
+    projection: np.ndarray,
+    ids: np.ndarray,
+    order: np.ndarray,
+    *,
+    capacity: Optional[int],
+    id_blob_cap: Optional[int],
+    rows_per_chunk: int,
+    chunk_rows: int = 262144,
+) -> str:
+    """Write the updatable layout. ``order`` maps storage row -> input
+    index; ``write_cold_store`` passes an id-sort (fresh files start
+    physically sorted, making the sort indirection the identity) while
+    ``upgrade_cold_store`` passes arange to keep every existing storage
+    row number stable — the serving hot tier caches cold row indices, so
+    an upgrade must never renumber rows."""
+    num_entities, slot_width = coefficients.shape
+    lengths = np.char.str_len(ids).astype(np.int64) if num_entities else \
+        np.zeros(0, dtype=np.int64)
+    blob_used = int(lengths[order].sum()) if num_entities else 0
+    if capacity is None:
+        capacity = num_entities + max(16, num_entities // 4)
+    capacity = max(int(capacity), num_entities, 1)
+    if id_blob_cap is None:
+        id_blob_cap = blob_used + max(256, blob_used // 4)
+    id_blob_cap = max(int(id_blob_cap), blob_used, 1)
+    rows_per_chunk = max(1, int(rows_per_chunk))
+    n_chunks = -(-capacity // rows_per_chunk)
+
+    header = {
+        "schema": SCHEMA_V2,
+        "coordinate_id": coordinate_id,
+        "random_effect_type": random_effect_type,
+        "feature_shard_id": feature_shard_id,
+        "slot_width": int(slot_width),
+        "coef_dtype": "<f4",
+        "proj_dtype": "<i4",
+        "id_width": 0,
+        "capacity": int(capacity),
+        "rows_per_chunk": rows_per_chunk,
+        "n_chunks": int(n_chunks),
+    }
+    # same one-pass trick as v1, extended to the fields a delta mutates
+    # (num_entities, id_blob_used): measure with sentinels, fill real
+    # values, pad — so an in-place header rewrite can never overflow
+    for key in ("num_entities", "id_blob_used", "coef_off", "proj_off",
+                "id_offsets_off", "id_blob_off", "id_blob_len", "sort_off",
+                "crc_off"):
+        header[key] = _SENTINEL
+    reserved = len(json.dumps(header).encode())
+    base = len(MAGIC) + 4 + reserved
+    coef_off = _aligned(base)
+    proj_off = _aligned(coef_off + capacity * slot_width * 4)
+    id_offsets_off = _aligned(proj_off + capacity * slot_width * 4)
+    id_blob_off = _aligned(id_offsets_off + (capacity + 1) * 8)
+    sort_off = _aligned(id_blob_off + id_blob_cap)
+    crc_off = _aligned(sort_off + capacity * 8)
+    file_end = crc_off + 4 * (2 * n_chunks + 3)
+    header.update(num_entities=int(num_entities), id_blob_used=blob_used,
+                  coef_off=coef_off, proj_off=proj_off,
+                  id_offsets_off=id_offsets_off, id_blob_off=id_blob_off,
+                  id_blob_len=int(id_blob_cap), sort_off=sort_off,
+                  crc_off=crc_off)
+    header_bytes = json.dumps(header).encode()
+    header_bytes += b" " * (reserved - len(header_bytes))
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w+b") as f:
+        # reserve the full extent up front; untouched reserve bytes read
+        # back as zeros (sparse where the filesystem supports it)
+        f.truncate(file_end)
+        f.seek(0)
+        f.write(MAGIC)
+        f.write(len(header_bytes).to_bytes(4, "little"))
+        f.write(header_bytes)
+        f.seek(coef_off)
+        for lo in range(0, num_entities, chunk_rows):
+            sel = order[lo:lo + chunk_rows]
+            f.write(np.ascontiguousarray(coefficients[sel]).tobytes())
+        f.seek(proj_off)
+        for lo in range(0, num_entities, chunk_rows):
+            sel = order[lo:lo + chunk_rows]
+            f.write(np.ascontiguousarray(projection[sel]).tobytes())
+        offsets = np.full(capacity + 1, blob_used, dtype=np.uint64)
+        offsets[0] = 0
+        if num_entities:
+            np.cumsum(lengths[order].astype(np.uint64),
+                      out=offsets[1:num_entities + 1])
+        f.seek(id_offsets_off)
+        f.write(offsets.tobytes())
+        f.seek(id_blob_off)
+        for lo in range(0, num_entities, chunk_rows):
+            f.write(b"".join(bytes(s) for s in ids[order[lo:lo + chunk_rows]]))
+        sort = np.full(capacity, -1, dtype=np.int64)
+        if num_entities:
+            sort[:num_entities] = np.argsort(ids[order], kind="stable")
+        f.seek(sort_off)
+        f.write(sort.tobytes())
+        _v2_recompute_crcs(f, header)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
 class ColdStore:
     """Zero-copy reader over one coordinate's cold-tier file.
 
@@ -235,15 +507,22 @@ class ColdStore:
                 h = json.loads(f.read(hlen))
             except (ValueError, UnicodeDecodeError) as e:
                 raise ColdStoreCorruptError(path, f"unparseable header: {e}")
-        if h.get("schema") != SCHEMA:
+        if h.get("schema") not in (SCHEMA, SCHEMA_V2):
             raise ColdStoreCorruptError(path, f"schema {h.get('schema')!r}")
+        self.updatable: bool = h["schema"] == SCHEMA_V2
+        self._h = dict(h)
         self.coordinate_id: str = h["coordinate_id"]
         self.random_effect_type: str = h["random_effect_type"]
         self.feature_shard_id: str = h["feature_shard_id"]
         self.num_entities: int = h["num_entities"]
         self.slot_width: int = h["slot_width"]
+        self.capacity: int = h.get("capacity", self.num_entities)
         self._id_width: int = h["id_width"]
         self.file_bytes = os.path.getsize(path)
+        if self.updatable and not (0 <= self.num_entities <= self.capacity):
+            raise ColdStoreCorruptError(
+                path, f"num_entities {self.num_entities} exceeds "
+                      f"capacity {self.capacity}")
         shape = (self.num_entities, self.slot_width)
         self.coef = np.memmap(path, dtype=np.dtype(h["coef_dtype"]),
                               mode="r", offset=h["coef_off"], shape=shape)
@@ -261,10 +540,22 @@ class ColdStore:
             self._id_blob = np.memmap(
                 path, dtype=np.uint8, mode="r", offset=h["id_blob_off"],
                 shape=(h["id_blob_len"],))
+        if self.updatable and self.num_entities:
+            # id-order -> storage-row indirection; v2 rows are
+            # append-stable, not physically sorted
+            self._sort = np.memmap(path, dtype=np.int64, mode="r",
+                                   offset=h["sort_off"],
+                                   shape=(self.num_entities,))
+        else:
+            self._sort = None
         if verify:
             self.verify()
 
     # -- id table -----------------------------------------------------------
+
+    def _row_at(self, pos: int) -> int:
+        """Storage row of the ``pos``-th entity in ascending-id order."""
+        return int(self._sort[pos]) if self._sort is not None else pos
 
     def _id_bytes(self, row: int) -> bytes:
         if self._id_width:
@@ -287,12 +578,14 @@ class ColdStore:
         lo, hi = 0, self.num_entities
         while lo < hi:
             mid = (lo + hi) // 2
-            if self._id_bytes(mid) < key:
+            if self._id_bytes(self._row_at(mid)) < key:
                 lo = mid + 1
             else:
                 hi = mid
-        if lo < self.num_entities and self._id_bytes(lo) == key:
-            return lo
+        if lo < self.num_entities:
+            row = self._row_at(lo)
+            if self._id_bytes(row) == key:
+                return row
         return None
 
     # -- row access ---------------------------------------------------------
@@ -317,7 +610,8 @@ class ColdStore:
                     ) -> Iterator[Tuple[int, List[str], np.ndarray,
                                         np.ndarray]]:
         """Stream ``(start_row, entity_ids, coef_block, proj_block)`` in
-        sorted-id order — training's blocked iteration unit."""
+        storage-row order (sorted-id for v1 files; append order for v2)
+        — training's blocked iteration unit."""
         if block_rows <= 0:
             raise ValueError(f"block_rows must be positive, got {block_rows}")
         for lo in range(start_row, self.num_entities, block_rows):
@@ -329,8 +623,13 @@ class ColdStore:
     # -- integrity ----------------------------------------------------------
 
     def verify(self, chunk_bytes: int = 4 << 20) -> None:
-        """Stream the file against its crc32 footer; raises
-        ``ColdStoreCorruptError`` on mismatch or truncation."""
+        """Stream the file against its crc32 footer (v1) or per-section
+        crc table (v2); raises ``ColdStoreCorruptError`` on mismatch or
+        truncation. A v2 file torn mid-delta (data rewritten, crcs not
+        yet) fails here — the publisher's torn-update refusal gate."""
+        if self.updatable:
+            self._verify_v2()
+            return
         size = os.path.getsize(self.path)
         if size < len(MAGIC) + 4 + 4:
             raise ColdStoreCorruptError(self.path, f"truncated ({size}B)")
@@ -350,6 +649,45 @@ class ColdStore:
                 self.path,
                 f"crc mismatch: computed {crc:#010x}, footer {footer:#010x}")
 
+    def _verify_v2(self) -> None:
+        h = self._h
+        n = h["n_chunks"]
+        expected_size = h["crc_off"] + 4 * (2 * n + 3)
+        size = os.path.getsize(self.path)
+        if size != expected_size:
+            raise ColdStoreCorruptError(
+                self.path, f"size {size} != expected {expected_size}")
+        if h["id_blob_used"] > h["id_blob_len"]:
+            raise ColdStoreCorruptError(
+                self.path, f"id_blob_used {h['id_blob_used']} exceeds "
+                           f"reserve {h['id_blob_len']}")
+        regions: List[Tuple[str, int, int, int]] = []
+        for ci, (lo, hi) in enumerate(_v2_chunk_bounds(h, "coef")):
+            regions.append((f"coef chunk {ci}", ci, lo, hi))
+        for ci, (lo, hi) in enumerate(_v2_chunk_bounds(h, "proj")):
+            regions.append((f"proj chunk {ci}", n + ci, lo, hi))
+        regions.append(("id table", 2 * n, h["id_offsets_off"],
+                        h["sort_off"]))
+        regions.append(("sort table", 2 * n + 1, h["sort_off"],
+                        h["crc_off"]))
+        regions.append(("header", 2 * n + 2, 0, h["coef_off"]))
+        with open(self.path, "rb") as f:
+            f.seek(h["crc_off"])
+            table = np.frombuffer(f.read(4 * (2 * n + 3)), dtype="<u4")
+            for name, idx, lo, hi in regions:
+                crc = _region_crc(f, lo, hi)
+                if crc != int(table[idx]):
+                    raise ColdStoreCorruptError(
+                        self.path,
+                        f"{name} crc mismatch: computed {crc:#010x}, "
+                        f"stored {int(table[idx]):#010x}")
+        if self._sort is not None:
+            rows = np.asarray(self._sort)
+            if rows.size and ((rows < 0).any()
+                              or (rows >= self.num_entities).any()):
+                raise ColdStoreCorruptError(
+                    self.path, "sort table references out-of-range rows")
+
     def describe(self) -> dict:
         return {
             "path": self.path,
@@ -359,4 +697,240 @@ class ColdStore:
             "num_entities": self.num_entities,
             "slot_width": self.slot_width,
             "file_bytes": self.file_bytes,
+            "updatable": self.updatable,
+            "capacity": self.capacity,
         }
+
+
+# -- in-place deltas (v2) -----------------------------------------------------
+
+
+def apply_cold_store_delta(
+    path: str,
+    *,
+    update_rows: Optional[np.ndarray] = None,
+    update_coef: Optional[np.ndarray] = None,
+    update_proj: Optional[np.ndarray] = None,
+    append_ids: Sequence[str] = (),
+    append_coef: Optional[np.ndarray] = None,
+    append_proj: Optional[np.ndarray] = None,
+    normalize: bool = True,
+    chaos_op: Optional[str] = "cold_delta",
+) -> dict:
+    """Apply a row-level delta to a v2 file in place; returns the undo
+    record ``rollback_cold_store_delta`` needs for a bitwise restore.
+
+    Write order is data rows -> (chaos kill point) -> id tail -> sort
+    rebuild -> header -> touched-chunk crcs -> fsync, so a crash at any
+    point leaves a file that either verifies as the prior state (nothing
+    written yet) or fails ``verify()`` and is refused — never a silently
+    half-applied delta. Appends take storage rows ``num_entities..`` so
+    existing row numbers never move (the hot tier caches them); the sort
+    indirection is rebuilt in O(E) per batch, which at nearline delta
+    cadence is noise next to the solves.
+
+    The returned undo dict carries the prior bytes of every touched row
+    plus the prior id/sort sections, and ``append_rows`` telling the
+    caller which storage rows the new entities landed on.
+    """
+    h, hlen = _read_header(path)
+    if h.get("schema") != SCHEMA_V2:
+        raise ColdStoreNotUpdatable(path, h.get("schema"))
+    slot_width = h["slot_width"]
+    num_entities = h["num_entities"]
+    capacity = h["capacity"]
+    blob_used = h["id_blob_used"]
+    rowb = slot_width * 4
+
+    update_rows = (np.zeros(0, dtype=np.int64) if update_rows is None
+                   else np.asarray(update_rows, dtype=np.int64))
+    n_upd = int(update_rows.shape[0])
+    update_coef = (np.zeros((0, slot_width), np.float32) if update_coef is None
+                   else np.asarray(update_coef, np.float32))
+    update_proj = (np.full((0, slot_width), -1, np.int32) if update_proj is None
+                   else np.asarray(update_proj, np.int32))
+    append_ids = [str(e) for e in append_ids]
+    n_app = len(append_ids)
+    append_coef = (np.zeros((0, slot_width), np.float32) if append_coef is None
+                   else np.asarray(append_coef, np.float32))
+    append_proj = (np.full((0, slot_width), -1, np.int32) if append_proj is None
+                   else np.asarray(append_proj, np.int32))
+    if update_coef.shape != (n_upd, slot_width) or \
+            update_proj.shape != (n_upd, slot_width):
+        raise ValueError(f"update arrays must be [{n_upd}, {slot_width}], "
+                         f"got {update_coef.shape} / {update_proj.shape}")
+    if append_coef.shape != (n_app, slot_width) or \
+            append_proj.shape != (n_app, slot_width):
+        raise ValueError(f"append arrays must be [{n_app}, {slot_width}], "
+                         f"got {append_coef.shape} / {append_proj.shape}")
+    if n_upd and (np.unique(update_rows).size != n_upd
+                  or update_rows.min() < 0
+                  or update_rows.max() >= num_entities):
+        raise ValueError(f"update_rows must be unique and in "
+                         f"[0, {num_entities})")
+    if len(set(append_ids)) != n_app:
+        raise ValueError("duplicate ids in append_ids")
+    if normalize:
+        update_coef, update_proj = normalize_slot_rows(update_coef,
+                                                       update_proj)
+        append_coef, append_proj = normalize_slot_rows(append_coef,
+                                                       append_proj)
+
+    new_id_bytes = [e.encode("utf-8") for e in append_ids]
+    blob_add = sum(len(b) for b in new_id_bytes)
+    if num_entities + n_app > capacity:
+        raise ColdStoreCapacityError(
+            path, f"{num_entities} + {n_app} rows > capacity {capacity}")
+    if blob_used + blob_add > h["id_blob_len"]:
+        raise ColdStoreCapacityError(
+            path, f"id blob {blob_used} + {blob_add}B > reserve "
+                  f"{h['id_blob_len']}B")
+    if n_app:
+        reader = ColdStore(path)
+        dup = [e for e in append_ids if reader.entity_row(e) is not None]
+        del reader
+        if dup:
+            raise ValueError(f"append_ids already present: {dup[:5]}")
+
+    undo = {
+        "schema": SCHEMA_V2,
+        "update_rows": update_rows.copy(),
+        "prior_update_coef": np.zeros((n_upd, slot_width), np.float32),
+        "prior_update_proj": np.zeros((n_upd, slot_width), np.int32),
+        "prior_num_entities": num_entities,
+        "prior_id_blob_used": blob_used,
+        "append_rows": np.arange(num_entities, num_entities + n_app,
+                                 dtype=np.int64),
+        "appended_ids": list(append_ids),
+        "prior_id_offsets_bytes": None,
+        "prior_sort_bytes": None,
+    }
+    with open(path, "r+b") as f:
+        # capture prior bytes for bitwise rollback
+        for i, r in enumerate(update_rows):
+            f.seek(h["coef_off"] + int(r) * rowb)
+            undo["prior_update_coef"][i] = np.frombuffer(f.read(rowb),
+                                                         np.float32)
+            f.seek(h["proj_off"] + int(r) * rowb)
+            undo["prior_update_proj"][i] = np.frombuffer(f.read(rowb),
+                                                         np.int32)
+        existing_ids: List[bytes] = []
+        if n_app:
+            f.seek(h["id_offsets_off"])
+            undo["prior_id_offsets_bytes"] = f.read((capacity + 1) * 8)
+            f.seek(h["sort_off"])
+            undo["prior_sort_bytes"] = f.read(capacity * 8)
+            offs = np.frombuffer(undo["prior_id_offsets_bytes"], np.uint64)
+            f.seek(h["id_blob_off"])
+            blob = f.read(blob_used)
+            existing_ids = [blob[int(offs[i]):int(offs[i + 1])]
+                            for i in range(num_entities)]
+        # data rows
+        for i, r in enumerate(update_rows):
+            f.seek(h["coef_off"] + int(r) * rowb)
+            f.write(np.ascontiguousarray(update_coef[i]).tobytes())
+            f.seek(h["proj_off"] + int(r) * rowb)
+            f.write(np.ascontiguousarray(update_proj[i]).tobytes())
+        for j in range(n_app):
+            r = num_entities + j
+            f.seek(h["coef_off"] + r * rowb)
+            f.write(np.ascontiguousarray(append_coef[j]).tobytes())
+            f.seek(h["proj_off"] + r * rowb)
+            f.write(np.ascontiguousarray(append_proj[j]).tobytes())
+        # torn-update kill point: data landed, ids/header/crcs stale —
+        # a kill here must leave a file verify() refuses
+        if chaos_op is not None:
+            _chaos.at_publish(chaos_op)
+        touched = set((update_rows // h["rows_per_chunk"]).tolist())
+        if n_app:
+            offs = np.frombuffer(undo["prior_id_offsets_bytes"],
+                                 np.uint64).copy()
+            pos = blob_used
+            for j, kb in enumerate(new_id_bytes):
+                pos += len(kb)
+                offs[num_entities + 1 + j] = pos
+            offs[num_entities + n_app + 1:] = pos
+            f.seek(h["id_offsets_off"])
+            f.write(offs.tobytes())
+            f.seek(h["id_blob_off"] + blob_used)
+            f.write(b"".join(new_id_bytes))
+            all_ids = np.asarray(existing_ids + new_id_bytes, dtype=bytes)
+            sort = np.full(capacity, -1, dtype=np.int64)
+            sort[:num_entities + n_app] = np.argsort(all_ids, kind="stable")
+            f.seek(h["sort_off"])
+            f.write(sort.tobytes())
+            h2 = dict(h)
+            h2["num_entities"] = num_entities + n_app
+            h2["id_blob_used"] = blob_used + blob_add
+            _rewrite_header(f, h2, hlen)
+            touched |= set((undo["append_rows"]
+                            // h["rows_per_chunk"]).tolist())
+        _v2_recompute_crcs(f, h, coef_chunks=touched, proj_chunks=touched,
+                           ids=bool(n_app), sort=bool(n_app),
+                           header=bool(n_app))
+        f.flush()
+        os.fsync(f.fileno())
+    return undo
+
+
+def rollback_cold_store_delta(path: str, undo: dict) -> None:
+    """Bitwise-restore the rows a previous ``apply_cold_store_delta``
+    touched. Updated rows get their exact prior bytes back; appended
+    entities disappear (num_entities and the id/sort sections revert, so
+    their reserve rows become unreachable garbage that the recomputed
+    chunk crcs still cover). The file verifies clean afterwards."""
+    h, hlen = _read_header(path)
+    if h.get("schema") != SCHEMA_V2:
+        raise ColdStoreNotUpdatable(path, h.get("schema"))
+    rowb = h["slot_width"] * 4
+    update_rows = np.asarray(undo["update_rows"], dtype=np.int64)
+    prior_coef = np.asarray(undo["prior_update_coef"], dtype=np.float32)
+    prior_proj = np.asarray(undo["prior_update_proj"], dtype=np.int32)
+    with open(path, "r+b") as f:
+        for i, r in enumerate(update_rows):
+            f.seek(h["coef_off"] + int(r) * rowb)
+            f.write(np.ascontiguousarray(prior_coef[i]).tobytes())
+            f.seek(h["proj_off"] + int(r) * rowb)
+            f.write(np.ascontiguousarray(prior_proj[i]).tobytes())
+        touched = set((update_rows // h["rows_per_chunk"]).tolist())
+        had_appends = undo.get("prior_sort_bytes") is not None
+        if had_appends:
+            f.seek(h["id_offsets_off"])
+            f.write(undo["prior_id_offsets_bytes"])
+            f.seek(h["sort_off"])
+            f.write(undo["prior_sort_bytes"])
+            append_rows = np.asarray(undo["append_rows"], dtype=np.int64)
+            touched |= set((append_rows // h["rows_per_chunk"]).tolist())
+            h2 = dict(h)
+            h2["num_entities"] = int(undo["prior_num_entities"])
+            h2["id_blob_used"] = int(undo["prior_id_blob_used"])
+            _rewrite_header(f, h2, hlen)
+        _v2_recompute_crcs(f, h, coef_chunks=touched, proj_chunks=touched,
+                           ids=had_appends, sort=had_appends,
+                           header=had_appends)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def upgrade_cold_store(path: str, *, capacity: Optional[int] = None,
+                       id_blob_cap: Optional[int] = None,
+                       rows_per_chunk: int = 4096) -> str:
+    """Rewrite a cold-store file (v1, or a full v2) as v2 with fresh
+    reserve space. A full atomic rewrite (tmp + fsync + rename), NOT an
+    in-place delta — but storage row numbers are preserved exactly, so
+    open readers can be refreshed by reopening the path without any
+    row-index remap. Callers holding a ``ColdStore`` must reopen it
+    afterwards (the old mmap still sees the replaced inode)."""
+    cs = ColdStore(path)
+    coef = np.asarray(cs.coef, dtype=np.float32)
+    proj = np.asarray(cs.proj, dtype=np.int32)
+    ids, _ = _encode_ids([cs.entity_id(r) for r in range(cs.num_entities)])
+    if ids.shape[0] == 0:
+        ids = np.asarray([], dtype="S1")
+    meta = (cs.coordinate_id, cs.random_effect_type, cs.feature_shard_id)
+    del cs
+    return _write_cold_store_v2(
+        path, *meta, coef, proj, ids,
+        np.arange(ids.shape[0], dtype=np.int64),
+        capacity=capacity, id_blob_cap=id_blob_cap,
+        rows_per_chunk=rows_per_chunk)

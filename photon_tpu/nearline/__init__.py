@@ -1,0 +1,46 @@
+"""Nearline delta training: the continuous train -> publish -> serve loop.
+
+The GLMix production story is per-entity random-effect models that
+refresh as new member events arrive. Offline training (game/) produces
+whole models; online serving (serving/) scores them; this package closes
+the loop:
+
+- ``events``   — append-only event-log reader with a crc32-checked
+  watermark checkpoint (exactly-once per publish, preemption-safe).
+- ``delta_trainer`` — warm-started per-entity RE solves for only the
+  entities with new data, plus an optional low-cadence fixed refresh.
+- ``publisher`` — row-level delta publish into the LIVE serving tables
+  behind a gate ladder, with versioned manifests and bitwise rollback.
+- ``pipeline`` — the poll -> train -> publish -> checkpoint loop with
+  freshness-lag instrumentation and graceful drain (``cli/nearline``).
+"""
+
+from photon_tpu.nearline.delta_trainer import DeltaTrainConfig, DeltaTrainer
+from photon_tpu.nearline.events import (
+    EventLogReader,
+    EventLogWriter,
+    NearlineCheckpointError,
+    load_checkpoint,
+    save_checkpoint,
+)
+from photon_tpu.nearline.pipeline import NearlineConfig, NearlinePipeline
+from photon_tpu.nearline.publisher import (
+    DeltaPublisher,
+    DeltaPublishResult,
+    NearlinePublishConfig,
+)
+
+__all__ = [
+    "DeltaPublisher",
+    "DeltaPublishResult",
+    "DeltaTrainConfig",
+    "DeltaTrainer",
+    "EventLogReader",
+    "EventLogWriter",
+    "NearlineCheckpointError",
+    "NearlineConfig",
+    "NearlinePipeline",
+    "NearlinePublishConfig",
+    "load_checkpoint",
+    "save_checkpoint",
+]

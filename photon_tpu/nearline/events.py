@@ -1,0 +1,384 @@
+"""Append-only event-log reader/writer with a durable watermark checkpoint.
+
+The nearline pipeline consumes *training events* from an append-only log
+directory of shard files.  Two shard formats are supported:
+
+- ``.jsonl`` — one JSON object per line, appendable in place.  The reader
+  tracks a byte offset per shard and never consumes a final line that is
+  missing its trailing newline (a torn tail from a crashed writer); the
+  torn bytes are re-read on the next poll once the writer completes them.
+- ``.avro`` — immutable container shards written whole.  Pragmatically the
+  avro records are a thin envelope (``seq`` + a JSON ``payload`` string)
+  so both formats share one event schema; the point of the avro arm is
+  exercising offset bookkeeping for whole-file shards, not avro fidelity.
+
+An *event* is a dict with keys:
+
+- ``seq``       — global monotone int assigned by the writer (required).
+- ``ts``        — unix timestamp (float) of the interaction, for the
+  event->scoreable freshness-lag histogram.  Optional.
+- ``response``  — label (float).  ``weight`` and ``offset`` optional.
+- ``features``  — ``{shard_id: [[name, term, value], ...]}``.
+- ``entities``  — ``{random_effect_type: entity_id}``.
+
+Delivery hazards are handled in the reader, not pushed to callers:
+duplicate shards replay events with ``seq <= max_seq`` and are dropped
+(``duplicates`` counter); out-of-order records inside a poll batch are
+re-sorted by ``seq`` (``out_of_order`` counter); undecodable interior
+lines are skipped (``bad_records``) while an undecodable *final* line is
+treated as a torn tail and retried.
+
+The reader's position (``max_seq`` + per-shard offsets) snapshots into a
+*watermark* dict.  ``save_checkpoint`` persists it with a crc32 guard via
+the resilience atomic-write path (op ``"nearline_checkpoint"`` so chaos
+can kill between publish and checkpoint); a corrupt or torn checkpoint
+raises :class:`NearlineCheckpointError` rather than silently replaying
+from zero.  Exactly-once per publish is the manifest/checkpoint handshake
+documented in :mod:`photon_tpu.nearline.publisher`: the publisher durably
+records the watermark in a versioned manifest *before* the checkpoint is
+advanced, so a crash between the two is recovered by adopting the
+manifest watermark instead of re-publishing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from photon_tpu.obs.metrics import registry as _metrics
+from photon_tpu.resilience import io as rio
+
+CKPT_SCHEMA = "photon_tpu.nearline.ckpt.v1"
+
+EVENT_AVRO_SCHEMA: Dict[str, Any] = {
+    "type": "record",
+    "name": "NearlineEvent",
+    "namespace": "photon_tpu.nearline",
+    "fields": [
+        {"name": "seq", "type": "long"},
+        {"name": "payload", "type": "string"},
+    ],
+}
+
+
+class NearlineCheckpointError(RuntimeError):
+    """A nearline watermark checkpoint failed its integrity check."""
+
+    def __init__(self, path: str, detail: str):
+        self.path = path
+        self.detail = detail
+        super().__init__(f"nearline checkpoint {path}: {detail}")
+
+
+def _shard_names(log_dir: str) -> List[str]:
+    try:
+        names = os.listdir(log_dir)
+    except FileNotFoundError:
+        return []
+    return sorted(n for n in names if n.endswith(".jsonl") or n.endswith(".avro"))
+
+
+class EventLogWriter:
+    """Appends events to shard files, assigning monotone ``seq`` numbers.
+
+    JSONL shards are appended line-at-a-time (flush + fsync per ``append``
+    call) and rotate after ``shard_records`` records; avro shards are
+    immutable, so each ``append`` call writes one whole container shard.
+    """
+
+    def __init__(
+        self,
+        log_dir: str,
+        shard_records: int = 4096,
+        fmt: str = "jsonl",
+        start_seq: int = 0,
+    ):
+        if fmt not in ("jsonl", "avro"):
+            raise ValueError(f"unsupported event shard format: {fmt!r}")
+        self.log_dir = log_dir
+        self.fmt = fmt
+        self.shard_records = int(shard_records)
+        self._next_seq = int(start_seq)
+        os.makedirs(log_dir, exist_ok=True)
+        existing = _shard_names(log_dir)
+        self._shard_idx = len(existing)
+        self._records_in_shard = 0
+
+    @property
+    def next_seq(self) -> int:
+        return self._next_seq
+
+    def _shard_path(self) -> str:
+        return os.path.join(
+            self.log_dir, f"events-{self._shard_idx:06d}.{self.fmt}"
+        )
+
+    def rotate(self) -> None:
+        if self._records_in_shard:
+            self._shard_idx += 1
+            self._records_in_shard = 0
+
+    def append(self, events: Sequence[Dict[str, Any]]) -> List[int]:
+        """Assign seqs and durably append ``events``; returns the seqs."""
+        seqs: List[int] = []
+        stamped: List[Dict[str, Any]] = []
+        for ev in events:
+            ev = dict(ev)
+            if "seq" not in ev:
+                ev["seq"] = self._next_seq
+            self._next_seq = max(self._next_seq, int(ev["seq"]) + 1)
+            seqs.append(int(ev["seq"]))
+            stamped.append(ev)
+        if not stamped:
+            return seqs
+        if self.fmt == "avro":
+            from photon_tpu.io.avro import write_avro
+
+            path = self._shard_path()
+            self._shard_idx += 1
+            write_avro(
+                path,
+                EVENT_AVRO_SCHEMA,
+                [
+                    {"seq": int(ev["seq"]), "payload": json.dumps(ev)}
+                    for ev in stamped
+                ],
+            )
+            return seqs
+        path = self._shard_path()
+        with open(path, "ab") as f:
+            for ev in stamped:
+                f.write(json.dumps(ev).encode("utf-8") + b"\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self._records_in_shard += len(stamped)
+        if self._records_in_shard >= self.shard_records:
+            self.rotate()
+        return seqs
+
+
+class EventLogReader:
+    """Polls an event-log directory, tracking a resumable watermark."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self.max_seq = -1
+        # Per-shard progress: {"bytes": int, "records": int}.  ``bytes``
+        # is meaningful only for jsonl shards; avro shards use ``records``.
+        self._shards: Dict[str, Dict[str, int]] = {}
+        self.stats: Dict[str, int] = {
+            "polled": 0,
+            "duplicates": 0,
+            "out_of_order": 0,
+            "bad_records": 0,
+            "torn_records": 0,
+        }
+        # (shard, offset) of the last torn tail we counted, so one torn
+        # write is not re-counted on every poll while the writer is down.
+        self._last_torn: Optional[Tuple[str, int]] = None
+
+    # ----------------------------------------------------------- polling
+
+    def _count(self, key: str, n: int = 1) -> None:
+        self.stats[key] += n
+        _metrics.counter(f"nearline.events.{key}").inc(n)
+
+    def _poll_jsonl(
+        self, name: str, st: Dict[str, int], budget: int
+    ) -> List[Dict[str, Any]]:
+        path = os.path.join(self.log_dir, name)
+        try:
+            with open(path, "rb") as f:
+                f.seek(st["bytes"])
+                data = f.read()
+        except FileNotFoundError:
+            return []
+        if not data:
+            return []
+        end = data.rfind(b"\n")
+        if end < 0:
+            # No complete line beyond our offset: torn tail, retry later.
+            if self._last_torn != (name, st["bytes"]):
+                self._last_torn = (name, st["bytes"])
+                self._count("torn_records")
+            return []
+        lines = data[: end + 1].split(b"\n")[:-1]
+        if len(data) > end + 1 and self._last_torn != (name, end + 1 + st["bytes"]):
+            self._last_torn = (name, end + 1 + st["bytes"])
+            self._count("torn_records")
+        out: List[Dict[str, Any]] = []
+        consumed = 0
+        for i, line in enumerate(lines):
+            if len(out) >= budget:
+                break
+            consumed += len(line) + 1
+            if not line.strip():
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                # A garbled *final* complete line could be a torn write
+                # that happened to contain a newline; treat interior bad
+                # lines as poison (skip) but stop before a bad last line
+                # only if nothing follows it in the file.
+                if i == len(lines) - 1 and len(data) == end + 1:
+                    consumed -= len(line) + 1
+                    if self._last_torn != (name, st["bytes"] + consumed):
+                        self._last_torn = (name, st["bytes"] + consumed)
+                        self._count("torn_records")
+                    break
+                self._count("bad_records")
+                continue
+            if isinstance(ev, dict) and "seq" in ev:
+                out.append(ev)
+                st["records"] += 1
+            else:
+                self._count("bad_records")
+        st["bytes"] += consumed
+        return out
+
+    def _poll_avro(
+        self, name: str, st: Dict[str, int], budget: int
+    ) -> List[Dict[str, Any]]:
+        path = os.path.join(self.log_dir, name)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return []
+        if st.get("bytes") == size and st["bytes"] > 0:
+            return []  # fully consumed, container files never grow
+        from photon_tpu.io.avro import read_avro
+
+        try:
+            _, records = read_avro(path)
+        except Exception:
+            # Truncated/torn container: retry whole-file next poll.
+            if self._last_torn != (name, size):
+                self._last_torn = (name, size)
+                self._count("torn_records")
+            return []
+        out: List[Dict[str, Any]] = []
+        start = st["records"]
+        for rec in records[start:]:
+            if len(out) >= budget:
+                break
+            try:
+                ev = json.loads(rec["payload"])
+            except (KeyError, TypeError, ValueError):
+                self._count("bad_records")
+                st["records"] += 1
+                continue
+            if isinstance(ev, dict) and "seq" in ev:
+                out.append(ev)
+            else:
+                self._count("bad_records")
+            st["records"] += 1
+        if st["records"] >= len(records):
+            st["bytes"] = size  # mark consumed
+        return out
+
+    def poll(self, max_events: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Read newly arrived events, deduped and sorted by ``seq``."""
+        budget = int(max_events) if max_events is not None else (1 << 62)
+        raw: List[Dict[str, Any]] = []
+        for name in _shard_names(self.log_dir):
+            if budget - len(raw) <= 0:
+                break
+            st = self._shards.setdefault(name, {"bytes": 0, "records": 0})
+            if name.endswith(".jsonl"):
+                raw.extend(self._poll_jsonl(name, st, budget - len(raw)))
+            else:
+                raw.extend(self._poll_avro(name, st, budget - len(raw)))
+        fresh: List[Dict[str, Any]] = []
+        seen: set = set()
+        for ev in raw:
+            try:
+                seq = int(ev["seq"])
+            except (TypeError, ValueError):
+                self._count("bad_records")
+                continue
+            if seq <= self.max_seq or seq in seen:
+                self._count("duplicates")
+                continue
+            seen.add(seq)
+            fresh.append(ev)
+        seqs = [int(ev["seq"]) for ev in fresh]
+        if any(b < a for a, b in zip(seqs, seqs[1:])):
+            self._count(
+                "out_of_order",
+                sum(1 for a, b in zip(seqs, seqs[1:]) if b < a),
+            )
+            fresh.sort(key=lambda ev: int(ev["seq"]))
+        if fresh:
+            self.max_seq = int(fresh[-1]["seq"])
+        self._count("polled", len(fresh))
+        _metrics.gauge("nearline.events.max_seq").set(float(self.max_seq))
+        return fresh
+
+    # -------------------------------------------------------- watermarks
+
+    def state(self) -> Dict[str, Any]:
+        """Snapshot of the reader position (the publish watermark)."""
+        return {
+            "max_seq": self.max_seq,
+            "shards": {k: dict(v) for k, v in self._shards.items()},
+        }
+
+    def restore(self, state: Dict[str, Any]) -> None:
+        self.max_seq = int(state.get("max_seq", -1))
+        self._shards = {
+            str(k): {"bytes": int(v.get("bytes", 0)), "records": int(v.get("records", 0))}
+            for k, v in dict(state.get("shards", {})).items()
+        }
+
+
+# ------------------------------------------------------------ checkpoint
+
+
+def _ckpt_payload(state: Dict[str, Any], published_version: int) -> Dict[str, Any]:
+    return {
+        "schema": CKPT_SCHEMA,
+        "state": state,
+        "published_version": int(published_version),
+    }
+
+
+def save_checkpoint(
+    path: str, state: Dict[str, Any], published_version: int = 0
+) -> None:
+    """Durably persist a watermark checkpoint with a crc32 guard."""
+    payload = _ckpt_payload(state, published_version)
+    blob = json.dumps(payload, sort_keys=True).encode("utf-8")
+    doc = dict(payload)
+    doc["crc"] = zlib.crc32(blob) & 0xFFFFFFFF
+    rio.atomic_write_bytes(
+        path,
+        json.dumps(doc, sort_keys=True).encode("utf-8"),
+        op="nearline_checkpoint",
+    )
+
+
+def load_checkpoint(path: str) -> Optional[Dict[str, Any]]:
+    """Load a checkpoint; ``None`` if absent, typed error if corrupt."""
+    # absence is the normal first-boot case — don't spin the retry path
+    if not os.path.exists(path):
+        return None
+    try:
+        data = rio.read_bytes(path, op="nearline_checkpoint")
+    except FileNotFoundError:
+        return None
+    try:
+        doc = json.loads(data)
+    except ValueError as e:
+        raise NearlineCheckpointError(path, f"unparseable: {e}") from e
+    if not isinstance(doc, dict) or doc.get("schema") != CKPT_SCHEMA:
+        raise NearlineCheckpointError(
+            path, f"unexpected schema {doc.get('schema')!r}"
+        )
+    crc = doc.pop("crc", None)
+    blob = json.dumps(doc, sort_keys=True).encode("utf-8")
+    if crc != zlib.crc32(blob) & 0xFFFFFFFF:
+        raise NearlineCheckpointError(path, "crc mismatch")
+    return doc

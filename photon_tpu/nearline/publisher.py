@@ -1,0 +1,1023 @@
+"""Row-level delta publish into the LIVE serving tables.
+
+A full model swap (serving/swap.py) re-stages every table to change one
+row; the nearline publisher instead pushes only the changed coefficient
+rows into the tables the engine is scoring from RIGHT NOW — without a
+model re-stage, without a steady-state compile, and without a scoring
+thread ever observing a half-published entity.
+
+Placement-aware apply:
+
+- **Two-tier coordinates** are updated at the source of truth first: a
+  row-level in-place delta to the v2 cold-store file
+  (``io/cold_store.apply_cold_store_delta`` — crc-repaired, torn-update
+  refused, undo record captured), then a non-donated fixed-shape scatter
+  builds a republished copy of the CURRENT hot table with the updated
+  rows rewritten at their hot slots, committed with the slot-projection
+  mirrors in one transfer-lock hold.  New entities append to the cold
+  tier's reserve rows and become scoreable via the normal promotion path
+  (their pre-publish status is a typed UNKNOWN_ENTITY, after: scored).
+- **Full-resident coordinates** scatter updated rows into a copy of the
+  device gather table, splice the (entity*D + col) -> slot projection
+  arrays, and hand new entities the zero reserve rows baked into the
+  table shape at load (``append_reserve``) — the table SHAPE (a compiled
+  program shape) never changes.
+
+Atomicity protocol (the order matters):
+
+1. ``engine.pending_publish_rows`` is set FIRST, so the admission
+   lookahead stops prefetching the touched entities.
+2. Every touched store's ``publish_lock`` is acquired (sorted by
+   coordinate id), pausing cold->hot transfer cycles; the scoring path
+   only takes the transfer lock and keeps serving the PRIOR rows.
+3. Gates run against a stable table: finite -> deviation -> capacity ->
+   staging+parity (device readback of the staged copy, bitwise) ->
+   shadow (expected-vs-actual score delta on touched entities; the RE
+   margin is linear in the row, so the expectation is host-computable)
+   -> compiles (steady-state compile counters frozen).
+4. Commit under the transfer lock: cold delta, table pointer swap, map
+   updates, cold remap.  A scorer sees the OLD world or the NEW world,
+   never a mix — the publish is atomic per micro-batch boundary.
+5. Post-commit readback re-gathers every published row from the device
+   and the cold file and compares BITWISE against the intended bytes; a
+   mismatch (e.g. chaos ``publish_poison_row``) triggers an immediate
+   row-level rollback.
+6. A versioned manifest (watermark included) lands durably BEFORE the
+   reader checkpoint advances — the exactly-once handshake
+   (:mod:`photon_tpu.nearline.events`).
+
+Rollback (immediate, or breaker-probation via ``check_probation``)
+restores the exact prior bytes: cold rows via the undo record, device
+rows via re-scatter of the prior values, appended entities evicted and
+forgotten.  Full-resident rollback is a pointer restore of the prior
+table + projection arrays (bitwise by construction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_tpu.io.cold_store import (
+    ColdStoreCapacityError,
+    ColdStoreNotUpdatable,
+    apply_cold_store_delta,
+    normalize_slot_rows,
+    rollback_cold_store_delta,
+    upgrade_cold_store,
+)
+from photon_tpu.nearline.delta_trainer import (
+    DeltaTrainResult,
+    _parse_features,
+    _row_margin,
+    current_entity_row,
+)
+from photon_tpu.obs.metrics import registry as _metrics
+from photon_tpu.resilience import chaos as _chaos
+from photon_tpu.resilience import io as rio
+from photon_tpu.resilience.failures import record_failure
+from photon_tpu.utils import compile_cache, jitcache
+
+MANIFEST_FILE = "nearline-manifest.json"
+MANIFEST_SCHEMA = "photon_tpu.nearline.manifest.v1"
+
+_PUBLISH_BUCKETS = tuple(100e-6 * 1.6 ** i for i in range(32))
+
+
+@dataclasses.dataclass(frozen=True)
+class NearlinePublishConfig:
+    """Gate thresholds and apply geometry for delta publishes."""
+
+    #: per-row max |new - prior| over the union feature space; inf = off.
+    #: Appends are exempt (there is no prior).
+    max_row_deviation: float = float("inf")
+    #: shadow gate: |actual score delta - host-expected delta| bound
+    parity_tol: float = 1e-4
+    #: shadow gate skipped below this many touched-entity requests
+    min_shadow_requests: int = 0
+    #: max touched-entity requests the shadow gate scores
+    max_shadow_requests: int = 64
+    #: fixed scatter/gather batch (a compiled-program shape)
+    publish_batch: int = 64
+    #: breaker watch window after an accepted publish; 0 = off
+    probation_s: float = 0.0
+    #: v1 / capacity-exhausted cold stores are upgraded in place
+    auto_upgrade: bool = True
+
+
+@dataclasses.dataclass
+class DeltaPublishResult:
+    """Outcome of one delta-publish round."""
+
+    accepted: bool
+    version: int
+    label: str
+    gates: Dict[str, str]
+    reason: str = ""
+    rows_updated: int = 0
+    rows_appended: int = 0
+    rows_truncated: int = 0
+    rolled_back: bool = False
+    shadow_requests: int = 0
+    shadow_max_deviation: Optional[float] = None
+    coordinates: Dict[str, Dict[str, int]] = dataclasses.field(
+        default_factory=dict)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# -- fixed-shape publish programs (warmed at publisher construction) ---------
+
+
+def _pub_scatter(shape: Tuple[int, int], batch: int, dtype) -> object:
+    """NON-donated row scatter: builds a republished COPY of a live
+    gather table, leaving the original valid for in-flight scorers."""
+    import jax
+
+    def build():
+        def scatter(table, idx, rows):
+            return table.at[idx].set(rows)
+
+        return jax.jit(scatter)
+
+    return jitcache.get_or_build(
+        ("nearline_pub_scatter", shape[0], shape[1], batch,
+         str(np.dtype(dtype))), build)
+
+
+def _pub_gather(shape: Tuple[int, int], batch: int, dtype) -> object:
+    """Row gather for parity / post-commit readback verification."""
+    import jax
+
+    def build():
+        def gather(table, idx):
+            return table[idx]
+
+        return jax.jit(gather)
+
+    return jitcache.get_or_build(
+        ("nearline_row_gather", shape[0], shape[1], batch,
+         str(np.dtype(dtype))), build)
+
+
+def _scatter_rows(scatter, table, idx: np.ndarray, rows: np.ndarray,
+                  batch: int, pad_row: int):
+    """Apply [N] row writes through the fixed-shape scatter in chunks;
+    padding writes zeros to ``pad_row`` (the zero/scratch row)."""
+    import jax
+
+    for lo in range(0, len(idx), batch):
+        i = np.full(batch, pad_row, np.int32)
+        r = np.zeros((batch, rows.shape[1]), rows.dtype)
+        n = min(batch, len(idx) - lo)
+        i[:n] = idx[lo:lo + n]
+        r[:n] = rows[lo:lo + n]
+        table = scatter(table, jax.device_put(i), jax.device_put(r))
+    return table
+
+
+def _gather_rows(gather, table, idx: np.ndarray, batch: int) -> np.ndarray:
+    import jax
+
+    out = []
+    for lo in range(0, len(idx), batch):
+        i = np.zeros(batch, np.int32)
+        n = min(batch, len(idx) - lo)
+        i[:n] = idx[lo:lo + n]
+        out.append(np.asarray(gather(table, jax.device_put(i)))[:n])
+    return (np.concatenate(out) if out
+            else np.zeros((0, 1), np.float32))
+
+
+def _fit_slot_width(coef: np.ndarray, proj: np.ndarray,
+                    width: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Normalize candidate rows into the serving slot width.  Rows whose
+    valid slots exceed ``width`` keep the largest-|coef| features (count
+    returned as truncated)."""
+    coef = np.asarray(coef, np.float32)
+    proj = np.asarray(proj, np.int32)
+    truncated = 0
+    nvalid = (proj >= 0).sum(axis=1)
+    over = nvalid > width
+    if over.any():
+        coef = coef.copy()
+        proj = proj.copy()
+        for r in np.nonzero(over)[0]:
+            valid = np.nonzero(proj[r] >= 0)[0]
+            drop = valid[np.argsort(np.abs(coef[r, valid]),
+                                    kind="stable")[:len(valid) - width]]
+            proj[r, drop] = -1
+            coef[r, drop] = 0.0
+            truncated += len(drop)
+    coef, proj = normalize_slot_rows(coef, proj)
+    k = coef.shape[1]
+    if k < width:
+        coef = np.pad(coef, [(0, 0), (0, width - k)])
+        proj = np.pad(proj, [(0, 0), (0, width - k)], constant_values=-1)
+    elif k > width:
+        coef = np.ascontiguousarray(coef[:, :width])
+        proj = np.ascontiguousarray(proj[:, :width])
+    return coef, proj, truncated
+
+
+def _union_deviation(coef_a, proj_a, coef_b, proj_b) -> float:
+    """max |a - b| over the union of the two rows' feature spaces."""
+    a = {int(c): float(v) for c, v in zip(proj_a, coef_a) if c >= 0}
+    b = {int(c): float(v) for c, v in zip(proj_b, coef_b) if c >= 0}
+    return max((abs(a.get(c, 0.0) - b.get(c, 0.0))
+                for c in set(a) | set(b)), default=0.0)
+
+
+@dataclasses.dataclass
+class _CoordPlan:
+    """One coordinate's normalized, partitioned publish plan."""
+
+    rs: object
+    cid: str
+    re_type: str
+    shard: str
+    upd_ids: List[str]
+    upd_coef: np.ndarray               # [U, K] serving layout
+    upd_proj: np.ndarray
+    upd_prior_coef: np.ndarray         # [U, K] live rows (rollback source)
+    upd_prior_proj: np.ndarray
+    app_ids: List[str]
+    app_coef: np.ndarray               # [A, K]
+    app_proj: np.ndarray
+    truncated: int = 0
+    cold_rows: Optional[np.ndarray] = None   # two-tier: storage rows
+
+
+class DeltaPublisher:
+    """Pushes delta-trained rows into the live tables behind gates."""
+
+    def __init__(self, engine, model_dir: Optional[str] = None,
+                 state_dir: Optional[str] = None,
+                 config: Optional[NearlinePublishConfig] = None):
+        self.engine = engine
+        self.model_dir = model_dir
+        self.config = config or NearlinePublishConfig()
+        if state_dir is None and model_dir is not None:
+            state_dir = os.path.join(model_dir, "nearline")
+        self.state_dir = state_dir
+        self.version = 0
+        self.last_manifest: Optional[dict] = None
+        m = self._read_manifest()
+        if m is not None:
+            self.version = int(m["version"])
+            self.last_manifest = m
+        self._lock = threading.Lock()     # one publish at a time
+        self._last_undo: Optional[dict] = None
+        self._probation_until: Optional[float] = None
+        self._warm_programs()
+
+    # ------------------------------------------------------------ warmup
+
+    def _warm_programs(self) -> None:
+        """Compile the publish scatter/gather for every coordinate
+        geometry up front — steady-state publishes dispatch only."""
+        batch = self.config.publish_batch
+        model = self.engine.model
+
+        def warm(b: int) -> None:
+            import jax
+
+            for rs in model.random:
+                table = rs.store.table if rs.store is not None else rs.coef
+                shape = tuple(table.shape)
+                dtype = np.dtype(str(table.dtype))
+                sc = _pub_scatter(shape, b, dtype)
+                ga = _pub_gather(shape, b, dtype)
+                pad = (rs.store._scratch_row if rs.store is not None
+                       else rs.unknown_row)
+                idx = jax.device_put(np.full(b, pad, np.int32))
+                rows = jax.device_put(np.zeros((b, shape[1]), dtype))
+                sc(table, idx, rows).block_until_ready()
+                ga(table, jax.device_put(
+                    np.zeros(b, np.int32))).block_until_ready()
+
+        compile_cache.warmup((batch,), warm)
+
+    # --------------------------------------------------------- manifests
+
+    def _manifest_path(self) -> Optional[str]:
+        if self.state_dir is None:
+            return None
+        return os.path.join(self.state_dir, MANIFEST_FILE)
+
+    def _read_manifest(self) -> Optional[dict]:
+        path = self._manifest_path()
+        if path is None or not os.path.exists(path):
+            return None
+        doc = json.loads(rio.read_bytes(path, op="nearline_manifest"))
+        if doc.get("schema") != MANIFEST_SCHEMA:
+            return None
+        crc = doc.pop("crc", None)
+        blob = json.dumps(doc, sort_keys=True).encode("utf-8")
+        if crc != zlib.crc32(blob) & 0xFFFFFFFF:
+            raise ValueError(f"nearline manifest {path}: crc mismatch")
+        return doc
+
+    def _write_manifest(self, label: str, watermark: Optional[dict],
+                        coords: Dict[str, Dict[str, Any]]) -> None:
+        path = self._manifest_path()
+        doc = {
+            "schema": MANIFEST_SCHEMA,
+            "version": self.version,
+            "label": label,
+            "watermark": watermark,
+            "coordinates": coords,
+        }
+        self.last_manifest = doc
+        if path is None:
+            return
+        os.makedirs(self.state_dir, exist_ok=True)
+        blob = json.dumps(doc, sort_keys=True).encode("utf-8")
+        out = dict(doc)
+        out["crc"] = zlib.crc32(blob) & 0xFFFFFFFF
+        rio.atomic_write_bytes(
+            path, json.dumps(out, sort_keys=True).encode("utf-8"),
+            op="nearline_manifest")
+
+    # ------------------------------------------------------------- gates
+
+    def _fail(self, gates: Dict[str, str], gate: str, reason: str,
+              label: str, **kw) -> DeltaPublishResult:
+        gates[gate] = "fail"
+        _metrics.counter("nearline.publish.rejected", gate=gate).inc()
+        record_failure("nearline_publish_rejected", label=label, gate=gate,
+                       reason=reason)
+        return DeltaPublishResult(False, self.version, label, dict(gates),
+                                  reason=reason, **kw)
+
+    def _plan(self, delta, stats: Dict[str, int]) -> List[_CoordPlan]:
+        """Normalize candidate rows into serving layout and partition
+        into updates vs appends per coordinate."""
+        model = self.engine.model
+        by_cid = {rs.coordinate_id: rs for rs in model.random}
+        coords = (delta.coordinates if isinstance(delta, DeltaTrainResult)
+                  else delta)
+        plans: List[_CoordPlan] = []
+        for cid, cd in sorted(coords.items()):
+            rs = by_cid.get(cid)
+            if rs is None or not cd.rows:
+                if rs is None:
+                    stats["unknown_coordinates"] = \
+                        stats.get("unknown_coordinates", 0) + 1
+                continue
+            ids = sorted(cd.rows)
+            coef = np.stack([cd.rows[e][0] for e in ids])
+            proj = np.stack([cd.rows[e][1] for e in ids])
+            coef, proj, trunc = _fit_slot_width(coef, proj, rs.slot_width)
+            D = model.shard_dims.get(rs.feature_shard_id, 1)
+            upd_i, app_i, priors, cold_rows = [], [], [], []
+            for i, e in enumerate(ids):
+                live = current_entity_row(rs, e, D)
+                if live is None:
+                    app_i.append(i)
+                    continue
+                upd_i.append(i)
+                if rs.store is not None:
+                    # the prior is the row the scorer SERVES: for a hot
+                    # entity that is the hot-tier row + proj mirror, which
+                    # can diverge from the cold tier after a torn publish
+                    # (replay-from-watermark recovery heals cold first)
+                    with rs.store.lock:
+                        s = rs.store.hot_slot_locked(e)
+                        if s is not None:
+                            live = (np.asarray(rs.store.table[s],
+                                               np.float32),
+                                    np.array(rs.store.proj_row_locked(s),
+                                             np.int32))
+                    cold_rows.append(rs.store.cold.entity_row(e))
+                priors.append(live)
+            K = rs.slot_width
+            plans.append(_CoordPlan(
+                rs=rs, cid=cid, re_type=cd.random_effect_type,
+                shard=rs.feature_shard_id,
+                upd_ids=[ids[i] for i in upd_i],
+                upd_coef=coef[upd_i], upd_proj=proj[upd_i],
+                upd_prior_coef=(np.stack([p[0] for p in priors])
+                                if priors else np.zeros((0, K), np.float32)),
+                upd_prior_proj=(np.stack([p[1] for p in priors])
+                                if priors else np.full((0, K), -1, np.int32)),
+                app_ids=[ids[i] for i in app_i],
+                app_coef=coef[app_i], app_proj=proj[app_i],
+                truncated=trunc,
+                cold_rows=(np.asarray(cold_rows, np.int64)
+                           if rs.store is not None else None)))
+        return plans
+
+    def _expected_delta(self, request, plans: List[_CoordPlan],
+                        hot_slots: Dict[str, Dict[str, int]]) -> float:
+        """Host-computed score delta the staged tables should produce
+        for one request.  Until the commit also lands the new slot
+        projection, the assemble path maps request features to slots
+        through the PRIOR projection — so the staged-table margin is the
+        new coefficient bytes read through the old slot mapping:
+        sum_j val(prior_proj[j]) * (new_row[j] - prior_row[j]) over the
+        touched entities this request can actually SEE pre-promotion
+        (hot slots for two-tier, resident rows for full-resident).  The
+        RE margin is linear in the row, so this is exact, not a bound."""
+        model = self.engine.model
+        stats: Dict[str, int] = {}
+        total = 0.0
+        for p in plans:
+            re_id = request.entity_ids.get(p.re_type)
+            if re_id is None or re_id not in p.upd_ids:
+                continue
+            if p.rs.store is not None and re_id not in hot_slots[p.cid]:
+                continue  # cold rows gather the zero row in both tables
+            i = p.upd_ids.index(re_id)
+            cols, vals = _parse_features(
+                {"features": request.features}, p.shard,
+                model.index_maps[p.shard], stats)
+            prior_proj = p.upd_prior_proj[i]
+            total += (_row_margin(cols, vals, p.upd_coef[i], prior_proj)
+                      - _row_margin(cols, vals, p.upd_prior_coef[i],
+                                    prior_proj))
+        return total
+
+    # ----------------------------------------------------------- publish
+
+    def publish(self, delta, label: str,
+                watermark: Optional[dict] = None) -> DeltaPublishResult:
+        """One gated delta-publish round.  ``delta`` is a
+        :class:`~photon_tpu.nearline.delta_trainer.DeltaTrainResult` (or
+        a ``{cid: CoordinateDelta}`` mapping)."""
+        with self._lock:
+            return self._publish_locked(delta, label, watermark)
+
+    def _publish_locked(self, delta, label: str,
+                        watermark: Optional[dict]) -> DeltaPublishResult:
+        import jax
+
+        t0 = time.perf_counter()
+        engine = self.engine
+        model = engine.model
+        cfg = self.config
+        gates: Dict[str, str] = {}
+        stats: Dict[str, int] = {}
+        _metrics.counter("nearline.publish.attempts").inc()
+
+        plans = self._plan(delta, stats)
+        n_upd = sum(len(p.upd_ids) for p in plans)
+        n_app = sum(len(p.app_ids) for p in plans)
+        n_trunc = sum(p.truncated for p in plans)
+        if n_trunc:
+            _metrics.counter("nearline.publish.rows_truncated").inc(n_trunc)
+        if not plans:
+            return DeltaPublishResult(True, self.version, label,
+                                      {"empty": "skip"})
+
+        # finite: every candidate row, before anything is locked
+        for p in plans:
+            for arr in (p.upd_coef, p.app_coef):
+                if arr.size and not np.isfinite(arr).all():
+                    return self._fail(gates, "finite",
+                                      f"non-finite candidate rows in "
+                                      f"{p.cid!r}", label,
+                                      rows_truncated=n_trunc)
+        gates["finite"] = "pass"
+
+        # deviation: |new - prior| over the union feature space
+        if np.isfinite(cfg.max_row_deviation):
+            for p in plans:
+                for i, e in enumerate(p.upd_ids):
+                    dev = _union_deviation(p.upd_coef[i], p.upd_proj[i],
+                                           p.upd_prior_coef[i],
+                                           p.upd_prior_proj[i])
+                    if dev > cfg.max_row_deviation:
+                        return self._fail(
+                            gates, "deviation",
+                            f"{p.cid!r}/{e!r} deviates {dev:.3e} > "
+                            f"{cfg.max_row_deviation:.3e}", label,
+                            rows_truncated=n_trunc)
+        gates["deviation"] = "pass" if np.isfinite(cfg.max_row_deviation) \
+            else "skip"
+
+        # capacity: cold reserve (two-tier, auto-upgradable) / append
+        # reserve rows (full-resident, a typed hard failure)
+        for p in plans:
+            if p.rs.store is not None:
+                err = self._ensure_cold_capacity(p)
+                if err:
+                    return self._fail(gates, "capacity", err, label,
+                                      rows_truncated=n_trunc)
+            elif len(p.app_ids) > p.rs.append_reserve - p.rs.append_used:
+                free = p.rs.append_reserve - p.rs.append_used
+                return self._fail(
+                    gates, "capacity",
+                    f"{p.cid!r}: {len(p.app_ids)} appends > {free} free "
+                    f"reserve rows (ServingConfig.append_reserve)", label,
+                    rows_truncated=n_trunc)
+        gates["capacity"] = "pass"
+
+        touched = frozenset((p.re_type, e) for p in plans
+                            for e in (p.upd_ids + p.app_ids))
+        # 1) stop admission lookahead from prefetching touched entities
+        engine.pending_publish_rows = touched
+        # 2) pause transfer cycles on every touched two-tier store
+        plocks = [p.rs.store.publish_lock for p in plans
+                  if p.rs.store is not None]
+        for lk in plocks:
+            lk.acquire()
+        committed: List[dict] = []
+        try:
+            steady0 = compile_cache.compile_counts().get("steady_state", 0)
+
+            # staging: republished table copies + hot-slot resolution.
+            # Transfers are paused, so store.table cannot change under us;
+            # scoring keeps gathering the ORIGINAL tables untouched.
+            staged: Dict[str, Any] = {}
+            hot_slots: Dict[str, Dict[str, int]] = {}
+            batch = cfg.publish_batch
+            for p in plans:
+                rs = p.rs
+                if rs.store is not None:
+                    with rs.store.lock:
+                        hs = {e: s for e in p.upd_ids
+                              if (s := rs.store.hot_slot_locked(e))
+                              is not None}
+                    hot_slots[p.cid] = hs
+                    table = rs.store.table
+                    idx = np.asarray([hs[e] for e in p.upd_ids
+                                      if e in hs], np.int32)
+                    rows = (p.upd_coef[[i for i, e in enumerate(p.upd_ids)
+                                        if e in hs]]
+                            if len(idx) else
+                            np.zeros((0, rs.slot_width), np.float32))
+                    pad = rs.store._scratch_row
+                else:
+                    hot_slots[p.cid] = {}
+                    table = rs.coef
+                    upd_rows = np.asarray(
+                        [rs.entity_rows[e] for e in p.upd_ids], np.int32)
+                    app_rows = np.arange(len(p.app_ids), dtype=np.int32) \
+                        + rs.unknown_row + 1 + rs.append_used
+                    idx = np.concatenate([upd_rows, app_rows])
+                    rows = np.concatenate([p.upd_coef, p.app_coef]) \
+                        if len(idx) else np.zeros((0, rs.slot_width),
+                                                  np.float32)
+                    pad = rs.unknown_row
+                dtype = np.dtype(str(table.dtype))
+                sc = _pub_scatter(tuple(table.shape), batch, dtype)
+                ga = _pub_gather(tuple(table.shape), batch, dtype)
+                new_table = (_scatter_rows(sc, table, idx,
+                                           rows.astype(dtype), batch, pad)
+                             if len(idx) else table)
+                staged[p.cid] = (new_table, idx, rows, sc, ga, pad)
+            gates["staging"] = "pass"
+
+            # parity: gather the staged rows back — bitwise vs intended
+            for p in plans:
+                new_table, idx, rows, _sc, ga, _pad = staged[p.cid]
+                if not len(idx):
+                    continue
+                got = _gather_rows(ga, new_table, idx, batch)
+                if got.astype(np.float32).tobytes() != \
+                        rows.astype(np.float32).tobytes():
+                    return self._fail(gates, "parity",
+                                      f"{p.cid!r}: staged rows differ from "
+                                      f"intended rows", label,
+                                      rows_truncated=n_trunc)
+            gates["parity"] = "pass"
+
+            # shadow: touched-entity requests through live vs staged
+            # tables; actual score delta must match the host expectation
+            sample = [r for r in engine.recent_requests()
+                      if any((t, i) in touched
+                             for t, i in r.entity_ids.items())]
+            sample = sample[-cfg.max_shadow_requests:]
+            shadow_n = len(sample)
+            max_dev: Optional[float] = None
+            if shadow_n >= max(cfg.min_shadow_requests, 1):
+                from photon_tpu.serving.scorer import get_scorer
+
+                cid_pos = {rs.coordinate_id: k
+                           for k, rs in enumerate(model.random)}
+                devs = []
+                top = engine.ladder.max_batch
+                for lo in range(0, shadow_n, top):
+                    chunk = sample[lo:lo + top]
+                    bucket = engine.ladder.bucket_for(len(chunk))
+                    with model.transfer_lock:
+                        args, _fb, _c = model.assemble(chunk, bucket)
+                        tables = list(model.current_tables())
+                        live = np.asarray(get_scorer(model, "full", bucket)(
+                            *args, tuple(tables)))[:len(chunk)]
+                        for p in plans:
+                            tables[cid_pos[p.cid]] = staged[p.cid][0]
+                        cand = np.asarray(get_scorer(model, "full", bucket)(
+                            *args, tuple(tables)))[:len(chunk)]
+                    for j, r in enumerate(chunk):
+                        want = self._expected_delta(r, plans, hot_slots)
+                        devs.append(abs(float(cand[j] - live[j]) - want))
+                max_dev = max(devs, default=0.0)
+                if max_dev > cfg.parity_tol:
+                    return self._fail(
+                        gates, "shadow",
+                        f"shadow delta off by {max_dev:.3e} > "
+                        f"{cfg.parity_tol:.3e} over {shadow_n} requests",
+                        label, shadow_requests=shadow_n,
+                        shadow_max_deviation=max_dev,
+                        rows_truncated=n_trunc)
+                gates["shadow"] = "pass"
+            else:
+                gates["shadow"] = "skip"
+
+            steady1 = compile_cache.compile_counts().get("steady_state", 0)
+            if steady1 != steady0:
+                return self._fail(gates, "compiles",
+                                  f"{steady1 - steady0} steady-state "
+                                  f"compiles during staging/shadow", label,
+                                  shadow_requests=shadow_n,
+                                  rows_truncated=n_trunc)
+            gates["compiles"] = "pass"
+
+            # chaos: poison the final written payload AFTER the gates —
+            # the post-commit readback must catch it and roll back
+            poisoned = _chaos.should_poison_publish_row()
+            written: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+            for p in plans:
+                wc = p.upd_coef.copy()
+                wa = p.app_coef.copy()
+                if poisoned:
+                    if len(wc):
+                        wc[0, 0] = np.nan
+                    elif len(wa):
+                        wa[0, 0] = np.nan
+                    poisoned = False  # one row, first coordinate
+                written[p.cid] = (wc, wa)
+
+            # commit: atomic per batch boundary under the transfer lock
+            with model.transfer_lock:
+                for p in plans:
+                    committed.append(self._commit_coord(
+                        p, staged[p.cid], written[p.cid], hot_slots[p.cid],
+                        batch))
+                verify_err = self._verify_readback(plans, batch)
+            if verify_err:
+                self._rollback(committed, touched, locked=True)
+                _metrics.counter("nearline.publish.rollbacks").inc()
+                record_failure("nearline_publish_verify_failed",
+                               label=label, detail=verify_err)
+                gates["verify"] = "fail"
+                return DeltaPublishResult(
+                    False, self.version, label, dict(gates),
+                    reason=f"post-commit readback mismatch: {verify_err}",
+                    rolled_back=True, shadow_requests=shadow_n,
+                    shadow_max_deviation=max_dev, rows_truncated=n_trunc)
+            gates["verify"] = "pass"
+        finally:
+            for lk in reversed(plocks):
+                lk.release()
+            engine.pending_publish_rows = frozenset()
+
+        # durable manifest BEFORE the caller advances its checkpoint —
+        # the exactly-once half the events module documents
+        self.version += 1
+        coords_doc = {
+            p.cid: {
+                "updated": list(p.upd_ids),
+                "appended": list(p.app_ids),
+                "row_crc": zlib.crc32(
+                    written[p.cid][0].tobytes()
+                    + written[p.cid][1].tobytes()) & 0xFFFFFFFF,
+            } for p in plans}
+        self._write_manifest(label, watermark, coords_doc)
+        self._last_undo = {"label": label, "version": self.version,
+                           "touched": touched, "coords": committed}
+        if cfg.probation_s > 0:
+            self._probation_until = engine.clock() + cfg.probation_s
+
+        _metrics.counter("nearline.publish.accepted").inc()
+        _metrics.counter("nearline.publish.rows_updated").inc(n_upd)
+        _metrics.counter("nearline.publish.rows_appended").inc(n_app)
+        _metrics.histogram("nearline.publish.seconds",
+                           buckets=_PUBLISH_BUCKETS).observe(
+            time.perf_counter() - t0)
+        return DeltaPublishResult(
+            True, self.version, label, dict(gates),
+            rows_updated=n_upd, rows_appended=n_app, rows_truncated=n_trunc,
+            shadow_requests=shadow_n, shadow_max_deviation=max_dev,
+            coordinates={p.cid: {"updated": len(p.upd_ids),
+                                 "appended": len(p.app_ids)}
+                         for p in plans})
+
+    # ---------------------------------------------------------- capacity
+
+    def _ensure_cold_capacity(self, p: _CoordPlan) -> str:
+        """Make the cold file updatable with room for the appends;
+        returns an error string when it cannot be."""
+        rs = p.rs
+        cold = rs.store.cold
+        need_rows = cold.num_entities + len(p.app_ids)
+        blob_need = sum(len(e.encode("utf-8")) for e in p.app_ids)
+        needs_upgrade = not cold.updatable
+        if cold.updatable:
+            h = cold._h
+            if (need_rows > cold.capacity
+                    or h["id_blob_used"] + blob_need > h["id_blob_len"]):
+                needs_upgrade = True
+        if not needs_upgrade:
+            return ""
+        if not self.config.auto_upgrade:
+            return (f"{p.cid!r}: cold store "
+                    f"{'not updatable (v1)' if not cold.updatable else 'full'}"
+                    f" and auto_upgrade is off")
+        try:
+            cap = max(need_rows * 2, 64)
+            # the capacity gate runs before lock acquisition, so the
+            # upgrade + refresh take the publish and store locks here
+            with rs.store.publish_lock:
+                upgrade_cold_store(
+                    cold.path, capacity=cap,
+                    id_blob_cap=2 * (cold._h["id_blob_used"] + blob_need)
+                    + 256 if cold.updatable else None)
+                with rs.store.lock:
+                    rs.store.refresh_cold_locked()
+            _metrics.counter("nearline.publish.cold_upgrades").inc()
+            # re-resolve the plan's storage rows against the new file
+            if p.cold_rows is not None and len(p.upd_ids):
+                cold2 = rs.store.cold
+                p.cold_rows = np.asarray(
+                    [cold2.entity_row(e) for e in p.upd_ids], np.int64)
+            return ""
+        except (OSError, ColdStoreNotUpdatable,
+                ColdStoreCapacityError) as e:
+            return f"{p.cid!r}: cold upgrade failed: {e!r}"
+
+    # ------------------------------------------------------------ commit
+
+    def _commit_coord(self, p: _CoordPlan, staged_entry, written,
+                      hs: Dict[str, int], batch: int) -> dict:
+        """Apply one coordinate's rows (caller holds transfer_lock and,
+        for two-tier, the store's publish_lock). Returns the undo
+        record."""
+        import jax
+
+        rs = p.rs
+        wc, wa = written
+        new_table, idx, rows, sc, ga, pad = staged_entry
+        if rs.store is not None:
+            cold = rs.store.cold
+            undo = apply_cold_store_delta(
+                cold.path,
+                update_rows=p.cold_rows if len(p.upd_ids) else None,
+                update_coef=wc if len(p.upd_ids) else None,
+                update_proj=p.upd_proj if len(p.upd_ids) else None,
+                append_ids=p.app_ids,
+                append_coef=wa if len(p.app_ids) else None,
+                append_proj=p.app_proj if len(p.app_ids) else None,
+                normalize=False)
+            # the staged table was built from the intended rows; if the
+            # written payload differs (chaos poison) re-scatter so table
+            # and cold agree — readback then catches both
+            if wc.tobytes() != p.upd_coef.tobytes() and len(idx):
+                rows2 = wc[[i for i, e in enumerate(p.upd_ids) if e in hs]]
+                new_table = _scatter_rows(
+                    sc, new_table, idx, rows2.astype(rows.dtype), batch, pad)
+            with rs.store.lock:
+                rs.store.commit_table_locked(new_table)
+                for i, e in enumerate(p.upd_ids):
+                    if e in hs:
+                        rs.store.set_hot_proj_locked(hs[e], p.upd_proj[i])
+                rs.store.refresh_cold_locked()
+            return {"kind": "two_tier", "plan": p, "undo": undo,
+                    "hot_slots": dict(hs)}
+        # full-resident
+        if wc.tobytes() != p.upd_coef.tobytes() and len(idx):
+            rows2 = np.concatenate([wc, wa]) if len(idx) else rows
+            new_table = _scatter_rows(
+                sc, rs.coef, idx, rows2.astype(rows.dtype), batch, pad)
+        prior = {"kind": "full", "plan": p, "prior_table": rs.coef,
+                 "prior_pkeys": rs.pkeys_sorted,
+                 "prior_pslots": rs.pslots_sorted,
+                 "prior_append_used": rs.append_used,
+                 "cold_undo": None, "cold_path": None}
+        model = self.engine.model
+        D = max(model.shard_dims.get(rs.feature_shard_id, 1), 1)
+        app_rows = np.arange(len(p.app_ids), dtype=np.int64) \
+            + rs.unknown_row + 1 + rs.append_used
+        # splice the projection lookup: drop the updated entities' keys,
+        # insert the new (entity * D + col) -> slot pairs, re-sort stable
+        keep = np.ones(len(rs.pkeys_sorted), bool)
+        ent_of = {e: rs.entity_rows[e] for e in p.upd_ids}
+        for e in p.upd_ids:
+            er = ent_of[e]
+            lo = np.searchsorted(rs.pkeys_sorted, er * D)
+            hi = np.searchsorted(rs.pkeys_sorted, (er + 1) * D)
+            keep[lo:hi] = False
+        add_keys, add_slots = [], []
+        for i, e in enumerate(p.upd_ids):
+            valid = np.nonzero(p.upd_proj[i] >= 0)[0]
+            add_keys.append(ent_of[e] * D
+                            + p.upd_proj[i][valid].astype(np.int64))
+            add_slots.append(valid.astype(np.int64))
+        for j, e in enumerate(p.app_ids):
+            valid = np.nonzero(p.app_proj[j] >= 0)[0]
+            add_keys.append(int(app_rows[j]) * D
+                            + p.app_proj[j][valid].astype(np.int64))
+            add_slots.append(valid.astype(np.int64))
+        pk = np.concatenate([rs.pkeys_sorted[keep]] + add_keys) \
+            if add_keys else rs.pkeys_sorted[keep]
+        psl = np.concatenate([rs.pslots_sorted[keep]] + add_slots) \
+            if add_slots else rs.pslots_sorted[keep]
+        order = np.argsort(pk, kind="stable")
+        rs.coef = new_table
+        rs.pkeys_sorted = pk[order]
+        rs.pslots_sorted = psl[order]
+        for j, e in enumerate(p.app_ids):
+            rs.entity_rows[e] = int(app_rows[j])
+        rs.append_used += len(p.app_ids)
+        # keep the on-disk cold store current so delta-trainer warm
+        # starts and a later fixed-refresh swap see the published rows
+        if self.model_dir is not None:
+            from photon_tpu.io.cold_store import ColdStore, cold_store_path
+
+            cp = cold_store_path(self.model_dir, rs.coordinate_id)
+            if os.path.exists(cp):
+                try:
+                    disk = ColdStore(cp)
+                    if not disk.updatable and self.config.auto_upgrade:
+                        upgrade_cold_store(
+                            cp, capacity=max(
+                                2 * (disk.num_entities
+                                     + len(p.app_ids)), 64))
+                        disk = ColdStore(cp)
+                    if disk.updatable:
+                        crs = np.asarray(
+                            [disk.entity_row(e) for e in p.upd_ids],
+                            np.int64) if p.upd_ids else None
+                        prior["cold_undo"] = apply_cold_store_delta(
+                            cp, update_rows=crs,
+                            update_coef=wc if len(p.upd_ids) else None,
+                            update_proj=(p.upd_proj if len(p.upd_ids)
+                                         else None),
+                            append_ids=p.app_ids,
+                            append_coef=wa if len(p.app_ids) else None,
+                            append_proj=(p.app_proj if len(p.app_ids)
+                                         else None),
+                            normalize=False)
+                        prior["cold_path"] = cp
+                except (ColdStoreCapacityError, ColdStoreNotUpdatable,
+                        OSError, ValueError) as e:
+                    _metrics.counter(
+                        "nearline.publish.cold_mirror_errors").inc()
+                    record_failure("nearline_cold_mirror_failed",
+                                   coordinate=rs.coordinate_id,
+                                   error=repr(e))
+        return prior
+
+    def _verify_readback(self, plans: List[_CoordPlan],
+                         batch: int) -> str:
+        """Re-gather every published row (device + cold) and compare
+        BITWISE against the INTENDED rows — not the written payload, or
+        a corruption between the gates and the commit (chaos
+        ``publish_poison_row``) would read back as consistent."""
+        for p in plans:
+            rs = p.rs
+            wc, wa = p.upd_coef, p.app_coef
+            if rs.store is not None:
+                cold = rs.store.cold
+                if len(p.upd_ids):
+                    got = cold.read_rows(p.cold_rows)
+                    if got.astype(np.float32).tobytes() != wc.tobytes():
+                        return f"{p.cid}: cold updated rows mismatch"
+                for j, e in enumerate(p.app_ids):
+                    r = cold.entity_row(e)
+                    if r is None:
+                        return f"{p.cid}: appended {e!r} missing from cold"
+                    if np.asarray(cold.coef[r], np.float32).tobytes() != \
+                            wa[j].tobytes():
+                        return f"{p.cid}: appended {e!r} bytes mismatch"
+                with rs.store.lock:
+                    hs = {e: s for e in p.upd_ids
+                          if (s := rs.store.hot_slot_locked(e)) is not None}
+                    table = rs.store.table
+                if hs:
+                    ga = _pub_gather(tuple(table.shape), batch,
+                                     np.dtype(str(table.dtype)))
+                    idx = np.asarray(list(hs.values()), np.int32)
+                    rows = wc[[i for i, e in enumerate(p.upd_ids)
+                               if e in hs]]
+                    got = _gather_rows(ga, table, idx, batch)
+                    if got.astype(np.float32).tobytes() != rows.tobytes():
+                        return f"{p.cid}: hot rows mismatch"
+            else:
+                ga = _pub_gather(tuple(rs.coef.shape), batch,
+                                 np.dtype(str(rs.coef.dtype)))
+                idx = np.asarray(
+                    [rs.entity_rows[e] for e in p.upd_ids + p.app_ids],
+                    np.int32)
+                if len(idx):
+                    want = np.concatenate([wc, wa])
+                    got = _gather_rows(ga, rs.coef, idx, batch)
+                    if got.astype(np.float32).tobytes() != \
+                            want.astype(np.float32).tobytes():
+                        return f"{p.cid}: resident rows mismatch"
+        return ""
+
+    # ---------------------------------------------------------- rollback
+
+    def rollback_last(self, why: str = "operator rollback") -> bool:
+        """Bitwise-restore the rows of the most recent accepted publish.
+        Returns False when there is nothing to roll back."""
+        with self._lock:
+            last = self._last_undo
+            if last is None:
+                return False
+            self._last_undo = None
+            self._probation_until = None
+            engine = self.engine
+            engine.pending_publish_rows = last["touched"]
+            stores = {c["plan"].cid: c["plan"].rs.store
+                      for c in last["coords"]
+                      if c["plan"].rs.store is not None}
+            locks = [stores[k].publish_lock for k in sorted(stores)]
+            for lk in locks:
+                lk.acquire()
+            try:
+                with engine.model.transfer_lock:
+                    self._rollback(last["coords"], last["touched"],
+                                   locked=True)
+            finally:
+                for lk in reversed(locks):
+                    lk.release()
+                engine.pending_publish_rows = frozenset()
+            _metrics.counter("nearline.publish.rollbacks").inc()
+            record_failure("nearline_publish_rollback", why=why,
+                           label=last["label"], version=last["version"])
+            return True
+
+    def _rollback(self, committed: List[dict], touched: frozenset,
+                  locked: bool) -> None:
+        """Row-level restore; caller holds transfer_lock (+ publish
+        locks).  Survives interim promotions: prior values re-scatter at
+        the entities' CURRENT hot slots, not remembered ones."""
+        batch = self.config.publish_batch
+        for c in reversed(committed):
+            p = c["plan"]
+            rs = p.rs
+            if c["kind"] == "two_tier":
+                rollback_cold_store_delta(rs.store.cold.path, c["undo"])
+                with rs.store.lock:
+                    # appends vanish from the refreshed cold -> evicted
+                    rs.store.refresh_cold_locked()
+                    hs = {e: s for e in p.upd_ids
+                          if (s := rs.store.hot_slot_locked(e))
+                          is not None}
+                    table = rs.store.table
+                    if hs:
+                        dtype = np.dtype(str(table.dtype))
+                        sc = _pub_scatter(tuple(table.shape), batch, dtype)
+                        sel = [i for i, e in enumerate(p.upd_ids)
+                               if e in hs]
+                        idx = np.asarray([hs[p.upd_ids[i]] for i in sel],
+                                         np.int32)
+                        rows = p.upd_prior_coef[sel].astype(dtype)
+                        table = _scatter_rows(sc, table, idx, rows, batch,
+                                              rs.store._scratch_row)
+                        rs.store.commit_table_locked(table)
+                        for i in sel:
+                            rs.store.set_hot_proj_locked(
+                                hs[p.upd_ids[i]], p.upd_prior_proj[i])
+            else:
+                rs.coef = c["prior_table"]
+                rs.pkeys_sorted = c["prior_pkeys"]
+                rs.pslots_sorted = c["prior_pslots"]
+                for e in p.app_ids:
+                    rs.entity_rows.pop(e, None)
+                rs.append_used = c["prior_append_used"]
+                if c.get("cold_undo") is not None:
+                    rollback_cold_store_delta(c["cold_path"],
+                                              c["cold_undo"])
+
+    # --------------------------------------------------------- probation
+
+    def check_probation(self) -> bool:
+        """Roll the last publish back if the breaker degraded inside the
+        probation window (mirrors the engine's post-swap probation).
+        Returns True when a rollback happened."""
+        until = self._probation_until
+        if until is None:
+            return False
+        engine = self.engine
+        if engine.clock() > until:
+            self._probation_until = None
+            return False
+        from photon_tpu.serving.breaker import OPEN, SHED
+
+        if engine.breaker.state() in (SHED, OPEN):
+            return self.rollback_last(
+                "breaker tripped in post-publish probation")
+        return False

@@ -88,6 +88,9 @@ def build_run_report(driver: str,
     cd = _cd_section()
     if cd is not None:
         report["cd"] = cd
+    nearline = _nearline_section()
+    if nearline is not None:
+        report["nearline"] = nearline
     if extra:
         report["extra"] = extra
     return report
@@ -113,6 +116,19 @@ def _cd_section() -> Optional[Dict[str, Any]]:
     ``sys.modules`` pattern as :func:`_serving_section` — sequential-only
     and non-training processes pay nothing."""
     mod = sys.modules.get("photon_tpu.game.parallel_cd")
+    if mod is None:
+        return None
+    try:
+        return mod.report_section()
+    except Exception:  # noqa: BLE001 — reporting must not kill a run
+        return None
+
+
+def _nearline_section() -> Optional[Dict[str, Any]]:
+    """The active nearline pipeline's summary (rounds, watermark,
+    publish/rollback totals, reader stats), when this process ran one.
+    Same ``sys.modules`` pattern as :func:`_serving_section`."""
+    mod = sys.modules.get("photon_tpu.nearline.pipeline")
     if mod is None:
         return None
     try:

@@ -38,6 +38,17 @@ hook points consult it:
 - ``corrupt_cold_store(path, seed)`` — deterministic cold-file
   corruption helper: flips one payload byte (chosen by seed) so the
   cold store's crc32 footer check must refuse the file.
+- ``should_poison_publish_row()`` — nearline/publisher.py asks while
+  building the final commit payload (AFTER the gate ladder has passed);
+  a hit NaN-poisons one published row so the post-apply readback verify
+  must detect the mismatch and drive the bitwise rollback path (fires
+  once).
+- event-log injectors (``torn_tail_write``, ``duplicate_shard_replay``,
+  ``shuffle_shard_records``) — deterministic helpers that mutate an
+  on-disk nearline event log into the three shapes a real log pipeline
+  produces under failure: a half-appended final record, a re-delivered
+  shard, and out-of-order delivery. The event reader must stop before
+  the torn tail, dedup replayed sequence numbers, and re-sort the rest.
 
 Everything is counter-based off the installed config — two runs with the
 same config and workload inject identically. ``seed`` feeds the optional
@@ -93,6 +104,10 @@ class ChaosConfig:
     # applied to the first cold_read_delay_reads transfer reads (then off)
     cold_read_delay_s: float = 0.0
     cold_read_delay_reads: int = 0
+    # nearline: NaN-poison one row of the next delta publish's commit
+    # payload AFTER the gate ladder passed — the post-apply readback
+    # verify must catch it and roll the published rows back (fires once)
+    publish_poison_row: bool = False
 
 
 class _State:
@@ -107,6 +122,7 @@ class _State:
         self.scorer_delays_done = 0
         self.straggler_fired = False
         self.cold_read_delays_done = 0
+        self.publish_poison_fired = False
 
 
 _active: Optional[_State] = None
@@ -269,6 +285,88 @@ def corrupt_model_dir(path: str, seed: int = 0) -> str:
     with open(victim, "r+b") as f:
         f.truncate(size // 2)
     return victim
+
+
+def should_poison_publish_row() -> bool:
+    """True exactly once when ``publish_poison_row`` is configured — the
+    nearline publisher poisons one committed row with NaN *after* its
+    gate ladder passed, so only the post-apply readback verify (and the
+    automatic rollback it triggers) stands between the poison and live
+    traffic."""
+    s = _active
+    if s is None or not s.config.publish_poison_row:
+        return False
+    with s.lock:
+        if s.publish_poison_fired:
+            return False
+        s.publish_poison_fired = True
+    return True
+
+
+def torn_tail_write(shard_path: str) -> int:
+    """Tear the final record of a JSONL event shard: cut the file
+    mid-way through its last line (no trailing newline), the exact shape
+    an appender killed mid-write leaves. Returns the number of bytes
+    removed. The event reader must consume every complete record before
+    the tear and stop — never parse, skip, or advance past the partial
+    tail."""
+    import os
+
+    size = os.path.getsize(shard_path)
+    with open(shard_path, "rb") as f:
+        data = f.read()
+    body = data.rstrip(b"\n")
+    last_nl = body.rfind(b"\n")
+    last_line = body[last_nl + 1:]
+    if not last_line:
+        raise ValueError(f"no records to tear in {shard_path!r}")
+    keep = last_nl + 1 + max(1, len(last_line) // 2)
+    with open(shard_path, "r+b") as f:
+        f.truncate(keep)
+    return size - keep
+
+
+def duplicate_shard_replay(log_dir: str, seed: int = 0) -> str:
+    """Re-deliver one existing shard under a fresh (later-sorting) shard
+    name — an at-least-once log pipeline retrying a delivery it already
+    made. The victim is chosen by crc32(seed) over the sorted shard
+    list. Every sequence number in the copy is a duplicate; the reader
+    must drop all of them. Returns the replayed shard's path."""
+    import os
+    import shutil
+
+    shards = sorted(n for n in os.listdir(log_dir)
+                    if n.endswith((".jsonl", ".avro")))
+    if not shards:
+        raise ValueError(f"no shards to replay under {log_dir!r}")
+    victim = shards[zlib.crc32(str(seed).encode()) % len(shards)]
+    stem, ext = os.path.splitext(victim)
+    replay = os.path.join(log_dir, f"{stem}.replay-{seed}{ext}")
+    shutil.copyfile(os.path.join(log_dir, victim), replay)
+    return replay
+
+
+def shuffle_shard_records(shard_path: str, seed: int = 0) -> int:
+    """Deterministically reorder a JSONL shard's complete records (keyed
+    by crc32(seed, index)) so sequence numbers arrive out of order —
+    cross-partition interleaving at delivery. Returns the number of
+    records that changed position. The reader must re-sort its poll
+    batch by sequence number and count the disorder."""
+    with open(shard_path, "rb") as f:
+        data = f.read()
+    nl_terminated = data.endswith(b"\n")
+    lines = data.rstrip(b"\n").split(b"\n") if data.strip() else []
+    if len(lines) < 2:
+        return 0
+    order = sorted(range(len(lines)),
+                   key=lambda i: zlib.crc32(f"{seed}:{i}".encode()))
+    moved = sum(1 for i, j in enumerate(order) if i != j)
+    shuffled = b"\n".join(lines[j] for j in order)
+    if nl_terminated:
+        shuffled += b"\n"
+    with open(shard_path, "wb") as f:
+        f.write(shuffled)
+    return moved
 
 
 def at_publish(op: str) -> None:

@@ -142,6 +142,13 @@ class TwoTierCoeffStore:
         self._scatter = None
         compile_cache.warmup((self.transfer_batch,), self._warm_scatter)
 
+        # held across one whole transfer cycle (all three phases) and by
+        # the nearline publisher across an entire delta publish — pausing
+        # the transfer thread at a cycle boundary without ever blocking
+        # the scoring path, which only needs ``lock``. Acquire order is
+        # always publish_lock -> lock, never the reverse.
+        self._publish_lock = threading.Lock()
+
         self._stats_lock = threading.Lock()
         self._counts = {"hits": 0, "misses": 0, "cold_misses": 0,
                         "unknown": 0, "promotes": 0, "evictions": 0,
@@ -254,7 +261,16 @@ class TwoTierCoeffStore:
         Phase 2 (unlocked): cold mmap read + ONE ``jax.device_put`` of
         the padded row block. Phase 3 (locked): one donated fixed-shape
         scatter + atomic map/table commit.
+
+        The whole cycle runs under ``publish_lock`` so a nearline delta
+        publish holding it sees a quiescent store: no cold-file read and
+        no donated scatter can interleave with its staged-table build,
+        cold rewrite, or commit.
         """
+        with self._publish_lock:
+            return self._drain_cycle()
+
+    def _drain_cycle(self) -> int:
         import jax
 
         t0 = time.perf_counter()
@@ -320,6 +336,75 @@ class TwoTierCoeffStore:
                 return True
             if time.monotonic() > deadline:
                 return False
+
+    # -- nearline delta publish --------------------------------------------
+
+    @property
+    def publish_lock(self) -> threading.Lock:
+        """Cycle-granular transfer pause for the nearline publisher.
+        Hold it (before ``lock``) across staging + commit so the staged
+        table copy can never race a donated transfer scatter. The
+        scoring path is untouched — it only takes ``lock``."""
+        return self._publish_lock
+
+    def hot_slot_locked(self, entity_id: str) -> Optional[int]:
+        """Hot slot of ``entity_id`` without an LRU touch (publisher
+        bookkeeping is not traffic), or None when not resident."""
+        return self._hot.get(entity_id)
+
+    def set_hot_proj_locked(self, slot: int, proj_row: np.ndarray) -> None:
+        """Update the host projection mirror of a hot slot after its
+        device row was republished."""
+        self._hot_proj[slot] = np.asarray(proj_row, dtype=np.int32)
+
+    def commit_table_locked(self, table) -> None:
+        """Swap in a republished gather table (same shape; built by the
+        publisher's non-donated scatter-copy)."""
+        self._table = table
+
+    def evict_locked(self, entity_id: str) -> bool:
+        """Drop one entity from the hot tier (rollback of a published
+        append). Its stale device rows become unreachable, exactly like
+        an LRU eviction."""
+        slot = self._hot.pop(entity_id, None)
+        self._pending.pop(entity_id, None)
+        if slot is None:
+            return False
+        self._slot_info[slot] = None
+        self._hot_proj[slot] = -1
+        self._free.append(slot)
+        return True
+
+    def refresh_cold_locked(self) -> int:
+        """Reopen the cold file and remap every cached cold-row index by
+        entity id — required after ``apply_cold_store_delta`` /
+        ``upgrade_cold_store`` / rollback replaced or mutated the file
+        (the old mmap may see a replaced inode). v2 storage rows are
+        append-stable so remaps are usually identity; entities absent
+        from the refreshed file (a rolled-back append) are evicted.
+        Returns the number of entities dropped. Caller holds both
+        ``publish_lock`` and ``lock``."""
+        new_cold = ColdStore(self.cold.path)
+        dropped = 0
+        for slot, info in enumerate(self._slot_info):
+            if info is None:
+                continue
+            entity_id, _old_row = info
+            row = new_cold.entity_row(entity_id)
+            if row is None:
+                if self.evict_locked(entity_id):
+                    dropped += 1
+            else:
+                self._slot_info[slot] = (entity_id, row)
+        for entity_id in list(self._pending):
+            row = new_cold.entity_row(entity_id)
+            if row is None:
+                del self._pending[entity_id]
+                dropped += 1
+            else:
+                self._pending[entity_id] = row
+        self.cold = new_cold
+        return dropped
 
     # -- accounting ---------------------------------------------------------
 

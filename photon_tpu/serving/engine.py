@@ -100,6 +100,12 @@ class ServingEngine:
         # shadow capture: the most recent admitted requests, the sample a
         # candidate model is validated against before publish
         self._capture: deque = deque(maxlen=self.config.swap.capture_size)
+        # rows currently mid-delta-publish, as {(re_type, entity_id)}.
+        # Swapped atomically (one attribute store of an immutable set) by
+        # the nearline publisher; the admission lookahead consults it so
+        # a request racing a publish never prefetches a half-published
+        # entity — the publish stays atomic per batch boundary.
+        self.pending_publish_rows: frozenset = frozenset()
         # drain state
         self._draining = False
         self._drain_reason: Optional[str] = None
@@ -118,16 +124,33 @@ class ServingEngine:
                                     feature_pad=(config.feature_pad
                                                  if config else None),
                                     coeff_store=(config.coeff_store
-                                                 if config else None))
+                                                 if config else None),
+                                    append_reserve=(config.append_reserve
+                                                    if config else 0))
         return cls(model, config=config, clock=clock)
 
     def _prefetch_lookahead(self, request: ScoreRequest) -> None:
         """MicroBatcher ``on_admit`` hook: resolve the request's entities
         against the two-tier stores at admission so their cold->hot
-        uploads are usually done by batch-pop time."""
+        uploads are usually done by batch-pop time.
+
+        Consults the pending-publish row set first: an entity whose cold
+        row is mid-delta-publish must NOT be prefetched — the promotion
+        could read a half-written cold row, or hoist a pre-publish row
+        into the hot tier an instant before the commit remaps it. Those
+        entities skip the lookahead (counted) and promote on their next
+        natural miss after the publish commits."""
         model = self.model
-        if model.has_stores:
-            model.prefetch_request(request)
+        if not model.has_stores:
+            return
+        pending = self.pending_publish_rows
+        if pending and any(
+                (re_type, re_id) in pending
+                for re_type, re_id in request.entity_ids.items()):
+            _metrics.counter("serving.prefetch_publish_deferred").inc()
+            model.prefetch_request(request, skip=pending)
+            return
+        model.prefetch_request(request)
 
     # -- warmup --------------------------------------------------------------
 

@@ -67,6 +67,12 @@ class _RandomState:
     # two-tier mode: the hot-set gather cache; coef/entity_rows/pkeys
     # are unused and the gather table is read via store.table instead
     store: Optional[object] = None    # TwoTierCoeffStore
+    # full-resident nearline appends: reserve rows AFTER the zero row
+    # (rows unknown_row+1 .. unknown_row+append_reserve). Appending an
+    # entity takes the next reserve row, so existing rows, the zero row,
+    # and the table shape (a compiled-program shape!) never change.
+    append_reserve: int = 0
+    append_used: int = 0
 
 
 class AssembledBatch(Tuple):
@@ -87,7 +93,8 @@ class DeviceResidentModel:
 
     def __init__(self, model: ServingGameModel, mesh=None,
                  feature_pad: Optional[int] = None, dtype=None,
-                 coeff_store: Optional[CoeffStoreConfig] = None):
+                 coeff_store: Optional[CoeffStoreConfig] = None,
+                 append_reserve: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -154,14 +161,19 @@ class DeviceResidentModel:
             pkeys = pe.astype(np.int64) * D + proj[pe, ps].astype(np.int64)
             order = np.argsort(pkeys, kind="stable")
             # one explicit zero row after the real entities: unknown
-            # entities gather it and contribute exactly nothing
-            coef = np.concatenate([coef, np.zeros((1, K), coef.dtype)])
+            # entities gather it and contribute exactly nothing. The
+            # optional append reserve follows it — zero rows the nearline
+            # publisher can hand to new entities without a table reshape.
+            reserve = max(int(append_reserve), 0)
+            coef = np.concatenate(
+                [coef, np.zeros((1 + reserve, K), coef.dtype)])
             self.random.append(_RandomState(
                 re.coordinate_id, re.random_effect_type, re.feature_shard_id,
                 put_ent(coef.astype(np.float32) if self.dtype == jnp.float32
                         else coef),
                 E, E, K, dict(re.entity_rows),
-                pkeys[order], ps[order].astype(np.int64)))
+                pkeys[order], ps[order].astype(np.int64),
+                append_reserve=reserve))
 
     # -- two-tier store plumbing --------------------------------------------
 
@@ -179,14 +191,20 @@ class DeviceResidentModel:
         return tuple(rs.store.table if rs.store is not None else rs.coef
                      for rs in self.random)
 
-    def prefetch_request(self, request: ScoreRequest) -> None:
+    def prefetch_request(self, request: ScoreRequest,
+                         skip: frozenset = frozenset()) -> None:
         """Admission lookahead: queue cold->hot promotion for every
-        two-tier entity this request names. Non-blocking."""
+        two-tier entity this request names. Non-blocking. ``skip`` holds
+        ``(random_effect_type, entity_id)`` pairs currently mid-publish —
+        prefetching one of those could promote a half-published cold row
+        into the hot tier, so they are deferred to the next natural miss
+        after the publish commits (see engine._prefetch_lookahead)."""
         for rs in self.random:
             if rs.store is None:
                 continue
             re_id = request.entity_ids.get(rs.random_effect_type)
-            if re_id is not None:
+            if re_id is not None and \
+                    (rs.random_effect_type, re_id) not in skip:
                 rs.store.prefetch(re_id)
 
     def coeff_store_stats(self) -> Optional[dict]:

@@ -249,7 +249,8 @@ def swap_staged(engine: ServingEngine, serving_model, label: str,
         staged = DeviceResidentModel(
             serving_model, mesh=mesh if mesh is not None else engine.model.mesh,
             feature_pad=engine.config.feature_pad,
-            coeff_store=engine.config.coeff_store)
+            coeff_store=engine.config.coeff_store,
+            append_reserve=engine.config.append_reserve)
         warmup_scorers(staged, engine.ladder.buckets)
     except Exception as e:  # any staging fault refuses, live keeps serving
         return _reject(engine, label, gates, "staging",

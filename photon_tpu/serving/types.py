@@ -312,3 +312,10 @@ class ServingConfig:
     #: in-flight micro-batches for at most this long; whatever is still
     #: queued past the budget gets a typed SHUTTING_DOWN refusal
     drain_budget_s: float = 5.0
+    #: nearline appends for FULL-RESIDENT coordinates: zero rows reserved
+    #: after the unknown row at load time (part of the compiled table
+    #: shape). Each row-level publish of a brand-new entity consumes one;
+    #: when exhausted, appends to that coordinate fail the publisher's
+    #: typed capacity gate until the next full swap. Two-tier coordinates
+    #: ignore this — their cold file carries its own reserve.
+    append_reserve: int = 0

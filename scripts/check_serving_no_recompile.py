@@ -298,6 +298,135 @@ def two_tier_arm(baseline, registry, compile_cache) -> list:
     return failures
 
 
+def delta_publish_arm(baseline, registry, compile_cache) -> list:
+    """Nearline delta publishes into the LIVE tables: row-level updates
+    and appends land through the publisher's gate ladder while scoring
+    traffic keeps flowing on the same engine — the steady-state compile
+    counter, jitcache entries, and per-program trace counts must stay
+    frozen across every round (scatter staging, hot-table commit,
+    projection rewrites, and scoring freshly appended entities included).
+    The monitors are re-baselined after one warm round because the delta
+    trainer's solve programs compile on first use by design; what this
+    arm guards is the SERVING path staying compile-free while the
+    nearline loop mutates the tables underneath it."""
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from photon_tpu.nearline import (
+        EventLogWriter,
+        NearlineConfig,
+        NearlinePipeline,
+        NearlinePublishConfig,
+    )
+    from photon_tpu.serving import (
+        CoeffStoreConfig,
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+        SLOConfig,
+    )
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="delta_ck_") as td:
+        import os as _os
+        mdir, ldir = _os.path.join(td, "model"), _os.path.join(td, "events")
+        names = build_model_dir(7, mdir)
+        engine = ServingEngine.from_model_dir(mdir, config=ServingConfig(
+            max_batch=8, max_wait_s=0.0, append_reserve=4,
+            slo=SLOConfig(shed_queue_depth=6, reject_queue_depth=100),
+            coeff_store=CoeffStoreConfig(hot_capacity=4, transfer_batch=2)))
+        engine.warmup()
+
+        rng = np.random.default_rng(5)
+
+        def req(uid, user):
+            feats = [(str(names[j]), "", float(rng.normal()))
+                     for j in rng.choice(len(names), size=5, replace=False)]
+            return ScoreRequest(uid, {"shardA": feats}, {"userId": user})
+
+        def event(user):
+            feats = [[str(names[j]), "", float(rng.normal())]
+                     for j in rng.choice(len(names), size=5, replace=False)]
+            return {"ts": time.time(), "response": float(rng.normal()),
+                    "features": {"shardA": feats},
+                    "entities": {"userId": user}}
+
+        # traffic first: promotes the hot set and gives the publisher's
+        # shadow gate a recent-request sample
+        served = 0
+        for lo in range(3):
+            served += len(engine.serve([req(f"w{lo}-{i}", f"u{i % 4}")
+                                        for i in range(8)]))
+        engine.model.drain_prefetch()
+
+        pipe = NearlinePipeline(
+            engine, ldir, model_dir=mdir,
+            config=NearlineConfig(publish=NearlinePublishConfig(
+                parity_tol=1e-3)))
+        writer = EventLogWriter(ldir)
+
+        # warm round: compiles trainer solves + the publisher path once
+        writer.append([event(f"u{i % 4}") for i in range(8)])
+        warm = pipe.run_round()
+        if not warm.get("publish", {}).get("accepted"):
+            engine.shutdown()
+            return [f"delta-publish warm round rejected: "
+                    f"{warm.get('publish')}"]
+
+        base = compile_cache.compile_counts()
+        misses0 = registry.counter("jitcache.misses").value
+        jitted = _jitted_programs(engine.model, engine.ladder)
+        traces0 = [f._cache_size() for f in jitted]
+
+        rounds = 0
+        for rnd in range(3):
+            users = [f"u{(rnd + i) % 5}" for i in range(4)]
+            if rnd == 1:
+                users.append("nb-new0")      # append mid-traffic
+            writer.append([event(u) for u in users for _ in range(2)])
+            s = pipe.run_round()
+            pub = s.get("publish")
+            if not (pub and pub.get("accepted")):
+                failures.append(f"delta-publish round {rnd} rejected: {pub}")
+                continue
+            if pub["gates"].get("verify") != "pass":
+                failures.append(
+                    f"delta-publish round {rnd} readback gate: "
+                    f"{pub['gates']}")
+            rounds += 1
+            # score straight through the freshly published rows, the
+            # appended entity included
+            served += len(engine.serve(
+                [req(f"r{rnd}-{i}", users[i % len(users)])
+                 for i in range(8)]))
+            engine.model.drain_prefetch()
+
+        after = compile_cache.compile_counts()
+        misses1 = registry.counter("jitcache.misses").value
+        traces1 = [f._cache_size() for f in jitted]
+        if after["steady_state"] != base["steady_state"]:
+            failures.append(
+                f"delta-publish steady-state compiles moved: "
+                f"{base['steady_state']} -> {after['steady_state']}")
+        if misses1 != misses0:
+            failures.append(f"delta-publish jitcache.misses moved: "
+                            f"{misses0} -> {misses1}")
+        for i, (t0, t1) in enumerate(zip(traces0, traces1)):
+            if t1 > t0:
+                failures.append(f"delta-publish program {i} re-traced: "
+                                f"_cache_size {t0} -> {t1}")
+        t = dict(pipe.totals)
+        engine.shutdown()
+        if not failures:
+            print(f"ok: delta-publish arm {rounds} live rounds "
+                  f"(rows_updated={t['rows_updated']}, "
+                  f"rows_appended={t['rows_appended']}), served {served}, "
+                  f"steady-state compiles=0")
+    return failures
+
+
 def main() -> int:
     from photon_tpu.obs.metrics import registry
     from photon_tpu.serving.scorer import MODES
@@ -386,6 +515,15 @@ def main() -> int:
     if tt_failures:
         print("FAIL: two-tier serving compiled:")
         for f in tt_failures:
+            print("  " + f)
+        return 1
+
+    # -- nearline delta-publish arm: row-level live publishes + appends
+    # while traffic flows — serving must stay compile-free throughout
+    dp_failures = delta_publish_arm(baseline, registry, compile_cache)
+    if dp_failures:
+        print("FAIL: serving compiled across delta publishes:")
+        for f in dp_failures:
             print("  " + f)
         return 1
     print(f"ok: {served} responses over buckets {list(engine.ladder.buckets)}"

@@ -1,0 +1,664 @@
+"""Nearline delta-training pipeline tests (photon_tpu/nearline).
+
+Covers the whole loop against live engines on CPU:
+
+  * event log: watermark resume, checkpoint crc refusal, torn tails,
+    duplicate shard replay, out-of-order delivery (chaos injectors),
+  * delta trainer: only the entities the events touch are re-solved,
+  * delta publisher: bitwise parity vs a full retrain-and-swap of the
+    same solve results, untouched rows bitwise-unchanged, bitwise
+    rollback on both placements, UNKNOWN_ENTITY -> scored appends,
+    poison-row readback rollback,
+  * crash seams: kill between manifest and checkpoint (exactly-once
+    recovery), kill mid cold-store delta (torn-update refusal + heal by
+    replay from the unadvanced watermark),
+  * admission lookahead: pending-publish rows are never prefetched,
+  * obs (RunReport section), the CLI driver, and the quick bench smoke.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from photon_tpu.io.cold_store import (
+    ColdStore,
+    ColdStoreCorruptError,
+    cold_store_path,
+)
+from photon_tpu.nearline import (
+    DeltaPublisher,
+    DeltaTrainer,
+    EventLogReader,
+    EventLogWriter,
+    NearlineCheckpointError,
+    NearlineConfig,
+    NearlinePipeline,
+    NearlinePublishConfig,
+    load_checkpoint,
+    save_checkpoint,
+)
+from photon_tpu.nearline.delta_trainer import current_entity_row
+from photon_tpu.obs.metrics import registry as _metrics
+from photon_tpu.resilience import chaos
+from photon_tpu.resilience.chaos import SimulatedKill
+from photon_tpu.serving import (
+    CoeffStoreConfig,
+    ScoreRequest,
+    ServingConfig,
+    ServingEngine,
+    SLOConfig,
+)
+
+
+# -- fixtures: a saved GAME model dir + engines on both placements -----------
+
+
+def _build_model_dir(seed: int, out_dir: str):
+    """Synthetic GAME model saved to disk with a per-coordinate cold
+    store and feature-index sidecars; the seed only varies coefficient
+    values. Returns the feature names for request/event building."""
+    import jax.numpy as jnp
+
+    from photon_tpu.game.dataset import EntityVocabulary
+    from photon_tpu.game.model import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.io.index_map import IndexMap, feature_key
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    names = [f"f{j}" for j in range(17)]
+    imap = IndexMap({feature_key(n, ""): i for i, n in enumerate(names)})
+    D = imap.feature_dimension
+    E, K = 5, 3
+    coef = rng.normal(size=(E, K)).astype(np.float32)
+    proj = np.zeros((E, K), np.int32)
+    for e in range(E):
+        proj[e] = np.sort(rng.choice(D, size=K, replace=False))
+    fixed = FixedEffectModel(
+        GeneralizedLinearModel(
+            Coefficients(jnp.asarray(rng.normal(size=D).astype(np.float32))),
+            TaskType.LINEAR_REGRESSION), "shardA")
+    rem = RandomEffectModel(
+        coefficients=jnp.asarray(coef), random_effect_type="userId",
+        feature_shard_id="shardA", task=TaskType.LINEAR_REGRESSION)
+    vocab = EntityVocabulary()
+    vocab.build("userId", [f"u{e}" for e in range(E)])
+    save_game_model(out_dir, GameModel({"global": fixed, "per-user": rem}),
+                    {"shardA": imap}, vocab=vocab,
+                    projections={"per-user": proj}, sparsity_threshold=0.0)
+    return names
+
+
+def _mk_engine(model_dir: str, two_tier: bool, clock=None) -> ServingEngine:
+    cfg = dict(max_batch=4, max_wait_s=0.0,
+               slo=SLOConfig(shed_queue_depth=60, reject_queue_depth=100),
+               append_reserve=4)
+    if two_tier:
+        cfg["coeff_store"] = CoeffStoreConfig(hot_capacity=4,
+                                              transfer_batch=2)
+    engine = ServingEngine.from_model_dir(
+        model_dir, config=ServingConfig(**cfg), clock=clock)
+    assert engine.model.has_stores == two_tier
+    engine.warmup()
+    return engine
+
+
+def _mkreq(rng, uid, names, user):
+    feats = [(names[j], "", float(rng.normal()))
+             for j in rng.choice(len(names), size=5, replace=False)]
+    return ScoreRequest(uid, {"shardA": feats}, {"userId": user})
+
+
+def _mkevent(rng, names, user, ts):
+    feats = [[names[j], "", float(rng.normal())]
+             for j in rng.choice(len(names), size=5, replace=False)]
+    return {"ts": ts, "response": float(rng.normal()),
+            "features": {"shardA": feats}, "entities": {"userId": user}}
+
+
+def _drive(engine, rng, names, users, n=12):
+    """Serve a little traffic so recent_requests has a shadow sample."""
+    for lo in range(0, n, 4):
+        engine.serve([_mkreq(rng, f"d{lo}-{i}", names, users[i % len(users)])
+                      for i in range(min(4, n - lo))])
+    engine.model.drain_prefetch()
+
+
+def _write_events(log_dir, rng, names, users, per_user=4, ts=None):
+    w = EventLogWriter(log_dir)
+    ts = time.time() if ts is None else ts
+    w.append([_mkevent(rng, names, u, ts) for u in users
+              for _ in range(per_user)])
+    return w
+
+
+def _pipeline(engine, log_dir, model_dir, **pub_kw):
+    pub_kw.setdefault("parity_tol", 1e-3)
+    return NearlinePipeline(
+        engine, log_dir, model_dir=model_dir,
+        config=NearlineConfig(publish=NearlinePublishConfig(**pub_kw)))
+
+
+def _rows(engine, entities):
+    """{entity: (coef, proj)} snapshot of the live serving rows."""
+    rs = engine.model.random[0]
+    D = engine.model.shard_dims["shardA"]
+    return {e: current_entity_row(rs, e, D) for e in entities}
+
+
+# -- event log: watermarks, checkpoints, chaos delivery ----------------------
+
+
+def test_event_log_watermark_resume_across_shards():
+    with tempfile.TemporaryDirectory(prefix="nl_ev_") as td:
+        rng = np.random.default_rng(0)
+        names = [f"f{j}" for j in range(17)]
+        w = EventLogWriter(td, shard_records=3)
+        w.append([_mkevent(rng, names, f"u{i}", 1.0) for i in range(4)])
+        r1 = EventLogReader(td)
+        got = r1.poll()
+        assert [ev["seq"] for ev in got] == [0, 1, 2, 3]
+        assert r1.max_seq == 3
+
+        # checkpoint, write more (new shard after rotation), resume
+        ckpt = os.path.join(td, "ck", "checkpoint.json")
+        os.makedirs(os.path.dirname(ckpt))
+        save_checkpoint(ckpt, r1.state(), published_version=7)
+        w.append([_mkevent(rng, names, "u9", 2.0) for _ in range(3)])
+        r2 = EventLogReader(td)
+        doc = load_checkpoint(ckpt)
+        assert doc is not None and doc["published_version"] == 7
+        r2.restore(doc["state"])
+        got2 = r2.poll()
+        assert [ev["seq"] for ev in got2] == [4, 5, 6]
+        assert r2.poll() == []
+        assert load_checkpoint(os.path.join(td, "absent.json")) is None
+
+
+def test_checkpoint_crc_refusal():
+    with tempfile.TemporaryDirectory(prefix="nl_ck_") as td:
+        path = os.path.join(td, "checkpoint.json")
+        save_checkpoint(path, {"max_seq": 5, "shards": {}},
+                        published_version=1)
+        doc = json.loads(open(path).read())
+        doc["state"]["max_seq"] = 99          # tamper without fixing crc
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        with pytest.raises(NearlineCheckpointError):
+            load_checkpoint(path)
+
+
+def test_torn_tail_held_back_then_new_shard_polls():
+    with tempfile.TemporaryDirectory(prefix="nl_torn_") as td:
+        rng = np.random.default_rng(1)
+        names = [f"f{j}" for j in range(17)]
+        w = EventLogWriter(td)
+        w.append([_mkevent(rng, names, f"u{i}", 1.0) for i in range(4)])
+        shard = os.path.join(td, sorted(os.listdir(td))[0])
+        removed = chaos.torn_tail_write(shard)
+        assert removed > 0
+
+        r = EventLogReader(td)
+        got = r.poll()
+        # complete records before the tear are consumed; the torn final
+        # record is neither parsed nor advanced past
+        assert [ev["seq"] for ev in got] == [0, 1, 2]
+        assert r.stats["torn_records"] == 1
+        assert r.poll() == []                  # tail still torn: no spin
+        assert r.stats["torn_records"] == 1    # ...and counted only once
+
+        # the dead writer's replacement starts a new shard; it polls fine
+        w2 = EventLogWriter(td, start_seq=4)
+        w2.append([_mkevent(rng, names, "u7", 2.0) for _ in range(2)])
+        got2 = r.poll()
+        assert [ev["seq"] for ev in got2] == [4, 5]
+
+
+def test_duplicate_shard_replay_fully_deduped():
+    with tempfile.TemporaryDirectory(prefix="nl_dup_") as td:
+        rng = np.random.default_rng(2)
+        names = [f"f{j}" for j in range(17)]
+        w = EventLogWriter(td)
+        w.append([_mkevent(rng, names, f"u{i}", 1.0) for i in range(5)])
+        r = EventLogReader(td)
+        assert len(r.poll()) == 5
+        chaos.duplicate_shard_replay(td, seed=3)
+        assert r.poll() == []
+        assert r.stats["duplicates"] == 5
+
+
+def test_out_of_order_delivery_resorted_and_counted():
+    with tempfile.TemporaryDirectory(prefix="nl_ooo_") as td:
+        rng = np.random.default_rng(3)
+        names = [f"f{j}" for j in range(17)]
+        w = EventLogWriter(td)
+        w.append([_mkevent(rng, names, f"u{i}", 1.0) for i in range(8)])
+        shard = os.path.join(td, sorted(os.listdir(td))[0])
+        moved = chaos.shuffle_shard_records(shard, seed=5)
+        assert moved > 0
+        r = EventLogReader(td)
+        got = r.poll()
+        assert [ev["seq"] for ev in got] == list(range(8))  # re-sorted
+        assert r.stats["out_of_order"] > 0
+
+
+# -- delta trainer: dirty entities only --------------------------------------
+
+
+def test_trainer_resolves_only_touched_entities():
+    with tempfile.TemporaryDirectory(prefix="nl_tr_") as td:
+        d = os.path.join(td, "m")
+        names = _build_model_dir(7, d)
+        engine = _mk_engine(d, two_tier=False)
+        try:
+            rng = np.random.default_rng(11)
+            trainer = DeltaTrainer(engine, model_dir=d)
+            events = [_mkevent(rng, names, "u1", 1.0) for _ in range(6)]
+            for i, ev in enumerate(events):
+                ev["seq"] = i
+            delta = trainer.train(events)
+            assert delta.num_rows == 1
+            cd = delta.coordinates["per-user"]
+            assert set(cd.rows) == {"u1"}
+            coef, proj = cd.rows["u1"]
+            assert np.isfinite(coef).all()
+            # warm-started from the live row, but the events moved it
+            live = current_entity_row(engine.model.random[0], "u1",
+                                      engine.model.shard_dims["shardA"])
+            assert coef.tobytes() != live[0].tobytes()
+        finally:
+            engine.shutdown()
+
+
+# -- delta publish: parity vs full retrain-and-swap, untouched rows ----------
+
+
+def test_delta_publish_bitwise_matches_full_swap():
+    """The tentpole acceptance: publishing delta rows into the live
+    tables must be bitwise-identical — same rows, same served scores —
+    to a full retrain-and-swap that bakes the SAME solve results into a
+    complete candidate model."""
+    from photon_tpu.io.model_io import (
+        ServingGameModel,
+        ServingRandomEffect,
+        load_for_serving,
+    )
+    from photon_tpu.serving.swap import swap_staged
+
+    with tempfile.TemporaryDirectory(prefix="nl_par_") as td:
+        d = os.path.join(td, "m")
+        names = _build_model_dir(7, d)
+        eng_a = _mk_engine(d, two_tier=False)
+        eng_b = _mk_engine(d, two_tier=False)
+        try:
+            rng = np.random.default_rng(21)
+            _drive(eng_a, rng, names, [f"u{i}" for i in range(5)])
+            log_dir = os.path.join(td, "log")
+            _write_events(log_dir, rng, names,
+                          ["u0", "u1", "u2", "newuser"])
+            pipe = _pipeline(eng_a, log_dir, d)
+            s = pipe.run_round()
+            pub = s["publish"]
+            assert pub["accepted"], pub
+            assert pub["rows_updated"] == 3 and pub["rows_appended"] == 1
+
+            # rebuild the SAME rows as a full candidate model for B
+            touched = ["u0", "u1", "u2", "newuser"]
+            published = _rows(eng_a, touched)
+            base = load_for_serving(d)
+            (re,) = base.random
+            coef = np.asarray(re.coefficients, np.float32).copy()
+            proj = np.asarray(re.projection, np.int32).copy()
+            entity_rows = dict(re.entity_rows)
+            app_coef, app_proj = [], []
+            for e in touched:
+                c, p = published[e]
+                if e in entity_rows:
+                    coef[entity_rows[e]] = c
+                    proj[entity_rows[e]] = p
+                else:
+                    entity_rows[e] = len(coef) + len(app_coef)
+                    app_coef.append(c)
+                    app_proj.append(p)
+            coef = np.vstack([coef] + app_coef)
+            proj = np.vstack([proj] + app_proj)
+            candidate = ServingGameModel(
+                base.task, base.fixed,
+                [ServingRandomEffect(re.coordinate_id,
+                                     re.random_effect_type,
+                                     re.feature_shard_id, coef, proj,
+                                     entity_rows)],
+                base.index_maps, base.metadata)
+            _drive(eng_b, np.random.default_rng(21), names,
+                   [f"u{i}" for i in range(5)])
+            swap = swap_staged(eng_b, candidate, "full-retrain")
+            assert swap.accepted, (swap.reason, swap.gates)
+
+            # rows bitwise-equal between the two publish mechanisms
+            rows_b = _rows(eng_b, touched)
+            for e in touched:
+                assert published[e][0].tobytes() == rows_b[e][0].tobytes(), e
+                assert published[e][1].tobytes() == rows_b[e][1].tobytes(), e
+
+            # and the scores the two engines serve are identical
+            rq = np.random.default_rng(33)
+            reqs = [_mkreq(rq, f"q{i}", names, touched[i % len(touched)])
+                    for i in range(8)]
+            sa = [r.score for r in eng_a.serve(reqs)]
+            sb = [r.score for r in eng_b.serve(reqs)]
+            assert sa == sb
+        finally:
+            eng_a.shutdown()
+            eng_b.shutdown()
+
+
+def test_untouched_rows_bitwise_unchanged():
+    with tempfile.TemporaryDirectory(prefix="nl_unt_") as td:
+        d = os.path.join(td, "m")
+        names = _build_model_dir(7, d)
+        engine = _mk_engine(d, two_tier=False)
+        try:
+            rng = np.random.default_rng(31)
+            _drive(engine, rng, names, [f"u{i}" for i in range(5)])
+            before = _rows(engine, ["u3", "u4"])
+            log_dir = os.path.join(td, "log")
+            _write_events(log_dir, rng, names, ["u0", "u1"])
+            pipe = _pipeline(engine, log_dir, d)
+            s = pipe.run_round()
+            assert s["publish"]["accepted"], s["publish"]
+            after = _rows(engine, ["u3", "u4"])
+            for e in ("u3", "u4"):
+                assert before[e][0].tobytes() == after[e][0].tobytes()
+                assert before[e][1].tobytes() == after[e][1].tobytes()
+        finally:
+            engine.shutdown()
+
+
+# -- append path, rollback, poison -------------------------------------------
+
+
+@pytest.mark.parametrize("two_tier", [False, True],
+                         ids=["full_resident", "two_tier"])
+def test_unknown_entity_append_then_bitwise_rollback(two_tier):
+    with tempfile.TemporaryDirectory(prefix="nl_app_") as td:
+        d = os.path.join(td, "m")
+        names = _build_model_dir(7, d)
+        engine = _mk_engine(d, two_tier=two_tier)
+        try:
+            rng = np.random.default_rng(41)
+            users = [f"u{i}" for i in range(5)]
+            _drive(engine, rng, names, users)
+
+            # pre-publish: the new entity is a typed UNKNOWN_ENTITY
+            pre = engine.serve([_mkreq(rng, "pre", names, "newuser")])[0]
+            assert "UNKNOWN_ENTITY" in {f.reason.name for f in pre.fallbacks}
+            before = _rows(engine, ["u0", "u1", "u2"])
+
+            log_dir = os.path.join(td, "log")
+            _write_events(log_dir, rng, names, ["u0", "u1", "u2", "newuser"])
+            pipe = _pipeline(engine, log_dir, d)
+            s = pipe.run_round()
+            pub = s["publish"]
+            assert pub["accepted"], pub
+            assert pub["rows_appended"] == 1
+
+            if two_tier:
+                r = _mkreq(rng, "warm", names, "newuser")
+                engine.model.prefetch_request(r)
+                engine.model.drain_prefetch()
+            post = engine.serve([_mkreq(rng, "post", names, "newuser")])[0]
+            assert "UNKNOWN_ENTITY" not in \
+                {f.reason.name for f in post.fallbacks}
+
+            # rollback restores the prior rows bitwise; appends vanish
+            assert pipe.publisher.rollback_last("test")
+            after = _rows(engine, ["u0", "u1", "u2", "newuser"])
+            assert after["newuser"] is None
+            for e in ("u0", "u1", "u2"):
+                assert before[e][0].tobytes() == after[e][0].tobytes(), e
+                assert before[e][1].tobytes() == after[e][1].tobytes(), e
+            # the watermark stands: rolled-back events are not replayed
+            assert pipe.run_round()["events"] == 0
+        finally:
+            engine.shutdown()
+
+
+def test_publish_poison_row_caught_by_readback_and_rolled_back():
+    with tempfile.TemporaryDirectory(prefix="nl_poi_") as td:
+        d = os.path.join(td, "m")
+        names = _build_model_dir(7, d)
+        engine = _mk_engine(d, two_tier=False)
+        try:
+            rng = np.random.default_rng(51)
+            _drive(engine, rng, names, [f"u{i}" for i in range(5)])
+            before = _rows(engine, ["u0", "u1"])
+            log_dir = os.path.join(td, "log")
+            _write_events(log_dir, rng, names, ["u0", "u1"])
+            pipe = _pipeline(engine, log_dir, d)
+            rollbacks0 = _metrics.counter("nearline.publish.rollbacks").value
+            with chaos.active(chaos.ChaosConfig(publish_poison_row=True)):
+                s = pipe.run_round()
+            pub = s["publish"]
+            assert not pub["accepted"]
+            assert pub["gates"]["verify"] == "fail"
+            assert pub["rolled_back"]
+            assert _metrics.counter("nearline.publish.rollbacks").value \
+                == rollbacks0 + 1
+            after = _rows(engine, ["u0", "u1"])
+            for e in ("u0", "u1"):
+                assert before[e][0].tobytes() == after[e][0].tobytes(), e
+            # no NaN ever reached the live scores
+            resp = engine.serve([_mkreq(rng, "q", names, "u0")])[0]
+            assert np.isfinite(resp.score)
+        finally:
+            engine.shutdown()
+
+
+# -- crash seams: exactly-once + torn cold update ----------------------------
+
+
+def test_kill_between_manifest_and_checkpoint_recovers_exactly_once():
+    """The exactly-once handshake: a crash after the manifest landed but
+    before the reader checkpoint advanced must NOT replay the events —
+    recovery adopts the manifest's watermark."""
+    with tempfile.TemporaryDirectory(prefix="nl_k1_") as td:
+        d = os.path.join(td, "m")
+        names = _build_model_dir(7, d)
+        engine = _mk_engine(d, two_tier=False)
+        try:
+            rng = np.random.default_rng(61)
+            _drive(engine, rng, names, [f"u{i}" for i in range(5)])
+            log_dir = os.path.join(td, "log")
+            _write_events(log_dir, rng, names, ["u0", "u1"])
+            pipe = _pipeline(engine, log_dir, d)
+            with chaos.active(chaos.ChaosConfig(
+                    kill_publish_ops=("nearline_checkpoint",))):
+                with pytest.raises(SimulatedKill):
+                    pipe.run_round()
+            # rows are live, manifest durable, checkpoint missing
+            assert pipe.publisher.version == 1
+            assert load_checkpoint(pipe.checkpoint_path) is None
+
+            published = _rows(engine, ["u0", "u1"])
+            pipe2 = _pipeline(engine, log_dir, d)
+            assert pipe2.recovered
+            assert pipe2.publisher.version == 1
+            # no replay: the recovered watermark already covers the log
+            assert pipe2.run_round()["events"] == 0
+            # and the live rows were untouched by recovery
+            now = _rows(engine, ["u0", "u1"])
+            for e in ("u0", "u1"):
+                assert published[e][0].tobytes() == now[e][0].tobytes()
+            ck = load_checkpoint(pipe2.checkpoint_path)
+            assert ck is not None and ck["published_version"] == 1
+        finally:
+            engine.shutdown()
+
+
+def test_kill_mid_cold_delta_refused_then_healed_by_replay():
+    """A kill inside the cold-store row update leaves a torn file (new
+    data rows, stale crcs): verify() must refuse it, and replaying the
+    round from the unadvanced watermark must republish and heal it."""
+    with tempfile.TemporaryDirectory(prefix="nl_k2_") as td:
+        d = os.path.join(td, "m")
+        names = _build_model_dir(7, d)
+        engine = _mk_engine(d, two_tier=True)
+        try:
+            rng = np.random.default_rng(71)
+            _drive(engine, rng, names, [f"u{i}" for i in range(5)])
+            log_dir = os.path.join(td, "log")
+            _write_events(log_dir, rng, names, ["u0", "u1", "newuser"])
+            pipe = _pipeline(engine, log_dir, d)
+            with chaos.active(chaos.ChaosConfig(
+                    kill_publish_ops=("cold_delta",))):
+                with pytest.raises(SimulatedKill):
+                    pipe.run_round()
+
+            cold_path = engine.model.random[0].store.cold.path
+            with pytest.raises(ColdStoreCorruptError):
+                ColdStore(cold_path).verify()      # torn-update refusal
+            assert pipe.publisher.version == 0     # no manifest landed
+            # publish locks were released and the pending set cleared
+            assert engine.pending_publish_rows == frozenset()
+
+            # replay from the unadvanced watermark heals the file
+            pipe2 = _pipeline(engine, log_dir, d)
+            s = pipe2.run_round()
+            assert s["events"] > 0
+            assert s["publish"]["accepted"], s["publish"]
+            ColdStore(cold_path).verify()          # crcs repaired
+            assert pipe2.run_round()["events"] == 0
+        finally:
+            engine.shutdown()
+
+
+# -- admission lookahead: pending-publish rows are not prefetched ------------
+
+
+def test_on_admit_defers_prefetch_of_pending_publish_rows():
+    with tempfile.TemporaryDirectory(prefix="nl_adm_") as td:
+        d = os.path.join(td, "m")
+        names = _build_model_dir(7, d)
+        t = {"now": 0.0}
+        engine = _mk_engine(d, two_tier=True, clock=lambda: t["now"])
+        try:
+            rng = np.random.default_rng(81)
+            engine.pending_publish_rows = frozenset({("userId", "u1")})
+            deferred0 = _metrics.counter(
+                "serving.prefetch_publish_deferred").value
+            # admission (not batch pop) fires the lookahead: with the
+            # injectable clock frozen, nothing dispatches while we look
+            engine.submit(_mkreq(rng, "a", names, "u0"))
+            engine.submit(_mkreq(rng, "b", names, "u1"))
+            assert _metrics.counter(
+                "serving.prefetch_publish_deferred").value == deferred0 + 1
+            engine.model.drain_prefetch()
+            store = engine.model.random[0].store
+            with store.lock:
+                assert store.hot_slot_locked("u0") is not None
+                assert store.hot_slot_locked("u1") is None  # deferred
+            engine.pending_publish_rows = frozenset()
+            t["now"] = 10.0
+            engine.drain()
+            # after the publish window clears, the next natural request
+            # promotes the entity as usual
+            engine.serve([_mkreq(rng, "c", names, "u1")])
+            engine.model.drain_prefetch()
+            with store.lock:
+                assert store.hot_slot_locked("u1") is not None
+        finally:
+            engine.shutdown()
+
+
+# -- obs + cli + bench wiring ------------------------------------------------
+
+
+def test_run_report_has_nearline_section():
+    from photon_tpu.obs.report import build_run_report
+
+    with tempfile.TemporaryDirectory(prefix="nl_rep_") as td:
+        d = os.path.join(td, "m")
+        names = _build_model_dir(7, d)
+        engine = _mk_engine(d, two_tier=False)
+        try:
+            rng = np.random.default_rng(91)
+            _drive(engine, rng, names, [f"u{i}" for i in range(5)])
+            log_dir = os.path.join(td, "log")
+            _write_events(log_dir, rng, names, ["u0"])
+            pipe = _pipeline(engine, log_dir, d)
+            s = pipe.run_round()
+            assert s["publish"]["accepted"]
+            report = build_run_report(driver="test")
+            nl = report.get("nearline")
+            assert nl is not None
+            assert nl["rounds"] == 1
+            assert nl["published_version"] == pipe.publisher.version
+            assert nl["totals"]["rows_updated"] == 1
+        finally:
+            engine.shutdown()
+            from photon_tpu.nearline.pipeline import set_active
+            set_active(None)
+
+
+def test_cli_nearline_end_to_end(tmp_path):
+    from photon_tpu.cli.nearline import build_arg_parser, run
+
+    d = str(tmp_path / "m")
+    names = _build_model_dir(7, d)
+    log_dir = str(tmp_path / "log")
+    rng = np.random.default_rng(101)
+    _write_events(log_dir, rng, names, ["u0", "u1", "newuser"])
+    stats = str(tmp_path / "stats.json")
+    report = str(tmp_path / "report.json")
+    args = build_arg_parser().parse_args([
+        "--model-input-directory", d, "--event-log", log_dir,
+        "--max-rounds", "1", "--poll-interval-s", "0",
+        "--max-batch", "4", "--append-reserve", "4",
+        "--parity-tol", "1e-3",
+        "--stats-output", stats, "--runreport-output", report])
+    assert run(args) == 0
+    summary = json.loads(open(stats).read())
+    assert summary["rounds"] == 1
+    assert summary["published_version"] == 1
+    assert summary["totals"]["rows_updated"] == 2
+    assert summary["totals"]["rows_appended"] == 1
+    doc = json.loads(open(report).read())
+    assert doc["nearline"]["rounds"] == 1
+    from photon_tpu.nearline.pipeline import set_active
+    set_active(None)
+
+
+def test_bench_nearline_quick_smoke():
+    """The quick nearline bench is the closed-loop smoke: model dir ->
+    two-tier engine -> concurrent serving + delta rounds -> freshness /
+    compile / qps-ratio checks, all CPU-sized. Asserts the record's
+    pass/fail fields rather than the timing numbers."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "nearline", "--quick"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads([l for l in proc.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert rec["metric"] == "nearline_freshness_lag_p50"
+    assert rec["publishes"] >= 1
+    assert rec["rows_published"] > 0
+    assert rec["zero_steady_state_compiles"] is True
+    assert rec["publish_parity_ok"] is True
+    assert rec["quick"] is True
