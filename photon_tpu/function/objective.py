@@ -146,6 +146,17 @@ class GLMObjective:
         g = g + hyper.l2_weight * coef
         return v, g
 
+    def local_value_and_gradient(
+        self, coef: Array, batch: DataBatch, hyper: Hyper, num_shards: int
+    ) -> Tuple[Array, Array]:
+        """Local-subproblem view for the hierarchical solver (optim/hier):
+        the data term over THIS shard's rows plus ``1/num_shards`` of the
+        L2 quadratic, so summing F_k over all shards recovers the global
+        objective exactly — the invariant the round safeguard's global-
+        loss comparison rests on."""
+        scaled = Hyper(l2_weight=hyper.l2_weight / num_shards)
+        return self.value_and_gradient(coef, batch, scaled)
+
     def directional_problem(
         self, batch: DataBatch, hyper: Hyper
     ) -> DirectionalProblem:

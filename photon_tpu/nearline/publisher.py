@@ -803,6 +803,7 @@ class DeltaPublisher:
                  "prior_pkeys": rs.pkeys_sorted,
                  "prior_pslots": rs.pslots_sorted,
                  "prior_append_used": rs.append_used,
+                 "prior_coef_q": rs.coef_q, "prior_scales": rs.scales,
                  "cold_undo": None, "cold_path": None}
         model = self.engine.model
         D = max(model.shard_dims.get(rs.feature_shard_id, 1), 1)
@@ -834,6 +835,22 @@ class DeltaPublisher:
             if add_slots else rs.pslots_sorted[keep]
         order = np.argsort(pk, kind="stable")
         rs.coef = new_table
+        # int8 serving arm: the quantized mirror must track every row
+        # publish or the dequantizing "full_int8" programs would serve
+        # stale coefficients. Quantization is row-local and deterministic
+        # (model_state.quantize_rows), so requantizing ONLY the written
+        # rows reproduces a from-scratch staging of the new table; the
+        # prior (coef_q, scales) objects ride the undo record above.
+        if rs.coef_q is not None and len(idx):
+            from photon_tpu.serving.model_state import quantize_rows
+
+            wrows = np.concatenate([wc, wa]) \
+                if wc.tobytes() != p.upd_coef.tobytes() else rows
+            qrows, srows = quantize_rows(np.asarray(wrows, np.float32))
+            qsc = _pub_scatter(tuple(rs.coef_q.shape), batch, np.int8)
+            ssc = _pub_scatter(tuple(rs.scales.shape), batch, np.float32)
+            rs.coef_q = _scatter_rows(qsc, rs.coef_q, idx, qrows, batch, pad)
+            rs.scales = _scatter_rows(ssc, rs.scales, idx, srows, batch, pad)
         rs.pkeys_sorted = pk[order]
         rs.pslots_sorted = psl[order]
         for j, e in enumerate(p.app_ids):
@@ -993,6 +1010,8 @@ class DeltaPublisher:
                                 hs[p.upd_ids[i]], p.upd_prior_proj[i])
             else:
                 rs.coef = c["prior_table"]
+                rs.coef_q = c.get("prior_coef_q")
+                rs.scales = c.get("prior_scales")
                 rs.pkeys_sorted = c["prior_pkeys"]
                 rs.pslots_sorted = c["prior_pslots"]
                 for e in p.app_ids:

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from photon_tpu.ops.features import (
     FeatureMatrix,
+    SparseFeatures,
     matvec,
     rmatvec,
     sq_rmatvec,
@@ -38,6 +39,33 @@ from photon_tpu.ops.losses import PointwiseLoss
 from photon_tpu.ops.normalization import NormalizationContext
 
 Array = jax.Array
+
+_WARNED_REFUSED: set = set()
+
+
+def _kernel_counter(name: str, path: str) -> None:
+    """Tick a kernel-activation counter. Runs at TRACE time (the routing
+    decision is a Python branch), so the count is per compiled program,
+    not per execution — exactly what "did this solve use the fused
+    kernel" needs, with zero on-device cost."""
+    from photon_tpu.obs.metrics import registry
+    registry.counter(f"kernels.{name}", path=path).inc()
+
+
+def _warn_kernel_refused(path: str) -> None:
+    """Warn ONCE per path when PHOTON_TPU_PALLAS_GLM=1 asked for the
+    fused kernel but ``_supported`` refused the operands — a silent
+    performance downgrade the counters record and this makes audible."""
+    if path in _WARNED_REFUSED:
+        return
+    _WARNED_REFUSED.add(path)
+    import warnings
+    warnings.warn(
+        f"PHOTON_TPU_PALLAS_GLM=1 requested the fused Pallas kernel but "
+        f"the {path} operands were refused (dtype/normalization/vmap/"
+        f"mesh or dimension gate); falling back to the two-pass XLA "
+        f"path. kernels.xla_fallbacks{{path={path}}} counts these.",
+        RuntimeWarning, stacklevel=3)
 
 
 def effective_coefficients(coef: Array, norm: NormalizationContext) -> Tuple[Array, Array]:
@@ -93,16 +121,31 @@ def value_and_gradient(
 
     With ``PHOTON_TPU_PALLAS_GLM=1`` the dense / identity-normalization /
     f32 case runs the Pallas single-HBM-pass kernel
-    (ops/pallas_glm.py) instead of XLA's two contractions over X. The
-    flag is read at trace time: toggling it mid-process does not affect
-    already-compiled solves.
+    (ops/pallas_glm.py) instead of XLA's two contractions over X, and
+    the ELL-sparse case runs its one-nnz-pass analogue. The flag is
+    read at trace time: toggling it mid-process does not affect
+    already-compiled solves. Routing decisions are counted into
+    ``kernels.pallas_hits`` / ``kernels.xla_fallbacks`` (trace-time
+    counters with a ``path`` label — one tick per compiled program, so
+    a silent fallback to the unfused path shows up in every RunReport).
     """
     import os
     if os.environ.get("PHOTON_TPU_PALLAS_GLM") == "1":
         from photon_tpu.ops import pallas_glm
         if pallas_glm._supported(x, norm, coef):
+            _kernel_counter("pallas_hits", "dense")
             return pallas_glm.fused_dense_value_grad(
                 loss, x, labels, offsets, weights, coef)
+        if pallas_glm._supported_sparse(x, norm, coef):
+            _kernel_counter("pallas_hits", "sparse")
+            return pallas_glm.fused_sparse_value_grad(
+                loss, x, labels, offsets, weights, coef)
+        path = "sparse" if isinstance(x, SparseFeatures) else "dense"
+        _kernel_counter("xla_fallbacks", path)
+        if not pallas_glm._TRACE_DISABLED.get():
+            # a disabled() region is a deliberate routing decision (mesh
+            # solves); only an unexpected refusal warrants the warning
+            _warn_kernel_refused(path)
     dim = coef.shape[0]
     margins = compute_margins(x, coef, offsets, norm)
     l, dz = loss.loss_and_dz(margins, labels)

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel: fused dense GLM value+gradient in ONE pass over X.
+"""Pallas TPU kernels: fused GLM value+gradient in ONE pass over X.
 
 The XLA path (ops/aggregators.py value_and_gradient) lowers to two
 separate contractions over the feature matrix — ``margins = X @ coef``
@@ -9,18 +9,28 @@ second pass pure waste: dz depends only on each row's own margin, so
 the gradient contraction can consume the SAME VMEM-resident tile of X
 that just produced the margins.
 
-This kernel tiles X over rows; per grid step it computes
+``fused_dense_value_grad`` tiles X over rows; per grid step it computes
 ``m = X_tile @ coef`` (MXU), the pointwise loss/dz (VPU), and
 accumulates ``value += sum(w*l)`` and ``grad += X_tile^T (w*dz)``
 (MXU) into carried output blocks — X is read from HBM exactly once.
 Theoretical ceiling vs the XLA path on a bandwidth-bound solve: 2x.
 
-Scope: dense [N, D] features, identity normalization, f32. The sparse
-ELL path keeps the XLA gather/scatter kernels (its bottleneck is the
-scatter, not a second stream of X). Callers opt in via
-``PHOTON_TPU_PALLAS_GLM=1`` (see ops/aggregators.py); correctness is
-pinned by interpret-mode parity tests against the XLA path
-(tests/test_pallas_glm.py) which run on every backend.
+``fused_sparse_value_grad`` extends the same single-HBM-pass structure
+to padded-ELL sparse rows: each grid step reads one [T, K] tile of the
+nnz stream (indices + values) ONCE, expands it into a VMEM-resident
+dense [T, D] tile via a static-K unrolled one-hot accumulation
+(``broadcasted_iota`` compare — MXU/VPU-lowerable, never touches HBM),
+then runs the identical margins/loss/grad flow on that tile. The XLA
+sparse arm instead gathers theta for margins and scatter-adds the
+gradient — two passes over the nnz stream plus a serialized scatter.
+The VMEM tile bounds the supported coefficient dimension
+(``_MAX_SPARSE_DIM``); larger models stay on the CSC segment-sum path.
+
+Scope: identity normalization, f32 coefficients, dense f32/bf16 or
+ELL-sparse features. Callers opt in via ``PHOTON_TPU_PALLAS_GLM=1``
+(see ops/aggregators.py); correctness is pinned by interpret-mode
+parity tests against the XLA path (tests/test_pallas_glm.py) which run
+on every backend.
 
 Reference semantics: ValueAndGradientAggregator.scala:36-80 (the same
 fused margin/loss/grad algebra, minus the normalization prefactors).
@@ -39,6 +49,11 @@ import jax.numpy as jnp
 Array = jax.Array
 
 _TILE_N = 1024
+_TILE_N_SPARSE = 256
+_TILE_B_SERVING = 128
+# the sparse kernel's VMEM working set is the expanded [T, D] tile:
+# 256 x 4096 x 4B = 4 MiB — comfortably inside a v5e core's 16 MiB
+_MAX_SPARSE_DIM = 4096
 
 # trace-time kill switch: pallas_call carries no sharding annotations, so
 # a mesh-sharded SPMD solve must never pick the kernel up (it would force
@@ -185,3 +200,246 @@ def fused_dense_value_grad(
     return _fused(loss.loss_and_dz, x, y.reshape(npad, 1),
                   off.reshape(npad, 1), w.reshape(npad, 1), tile,
                   bool(interpret), jnp.asarray(coef, jnp.float32))
+
+
+def _supported_sparse(x, norm, coef) -> bool:
+    """ELL-sparse analogue of ``_supported``: padded-ELL features with
+    f32/bf16 values AND f32 coefficients, identity normalization, a
+    coefficient dimension the VMEM expansion tile can hold, NOT under
+    vmap, NOT inside a ``disabled()`` (mesh) region. Larger dimensions
+    stay on the CSC segment-sum XLA path — expanding a [T, D] tile that
+    overflows VMEM would spill to HBM and forfeit the single pass."""
+    from photon_tpu.ops.features import SparseFeatures
+    if _TRACE_DISABLED.get():
+        return False
+    if not isinstance(x, SparseFeatures):
+        return False
+    idx, val = x.indices, x.values
+    try:
+        from jax.interpreters.batching import BatchTracer
+        if (isinstance(idx, BatchTracer) or isinstance(val, BatchTracer)
+                or isinstance(coef, BatchTracer)):
+            return False
+    except ImportError:  # pragma: no cover — jax internals moved
+        if type(val).__name__ == "BatchTracer":
+            return False
+    return (isinstance(val, jax.Array) and val.ndim == 2
+            and val.dtype in (jnp.float32, jnp.bfloat16)
+            and coef.dtype == jnp.float32
+            and coef.shape[0] <= _MAX_SPARSE_DIM
+            and norm.is_identity)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 6, 7))
+def _fused_sparse(loss_and_dz, idx, val, labels, offsets, weights,
+                  tile_n: int, interpret: bool, coef):
+    from jax.experimental import pallas as pl
+
+    n, k = idx.shape
+    d = coef.shape[0]
+
+    def kernel(idx_ref, val_ref, y_ref, off_ref, w_ref, coef_ref,
+               val_out_ref, grad_ref):
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            val_out_ref[0, 0] = jnp.float32(0.0)
+            grad_ref[:] = jnp.zeros_like(grad_ref)
+
+        # expand this tile's nnz into a VMEM-resident dense [T, D] tile:
+        # static-K unrolled one-hot accumulation, iota-compare per slot.
+        # ELL pad slots (index 0, value 0) contribute exactly zero, and
+        # duplicate column ids within a row accumulate — both match the
+        # XLA gather/scatter semantics bit for bit in f32.
+        cols = jax.lax.broadcasted_iota(jnp.int32, (tile_n, d), 1)
+        dense = jnp.zeros((tile_n, d), jnp.float32)
+        for j in range(k):  # k is a static ELL width, loop unrolls
+            onehot = (cols == idx_ref[:, j:j + 1]).astype(jnp.float32)
+            dense = dense + onehot * val_ref[:, j:j + 1].astype(jnp.float32)
+
+        # from here the flow is the dense kernel's: the expanded tile
+        # feeds BOTH contractions, so the nnz stream was read from HBM
+        # exactly once
+        m = jnp.dot(dense, coef_ref[:],
+                    preferred_element_type=jnp.float32)       # [T, 1]
+        z = m + off_ref[:]
+        l, dz = loss_and_dz(z, y_ref[:])
+        w = w_ref[:]
+        val_out_ref[0, 0] += jnp.sum(l * w)
+        grad_ref[:] += jax.lax.dot_general(
+            dense, w * dz,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [D, 1]
+
+    grid = (n // tile_n,)
+    value, grad = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_n, k), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, k), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((d, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(idx, val, labels, offsets, weights, coef.reshape(d, 1))
+    return value[0, 0], grad[:, 0]
+
+
+def fused_sparse_value_grad(
+    loss,
+    x,
+    labels: Array,
+    offsets: Optional[Array],
+    weights: Optional[Array],
+    coef: Array,
+    *,
+    tile_n: int = _TILE_N_SPARSE,
+    interpret: Optional[bool] = None,
+) -> Tuple[Array, Array]:
+    """Weighted loss value and gradient over padded-ELL sparse rows,
+    the nnz stream read from HBM once.
+
+    Drop-in for the un-normalized ELL case of
+    ``aggregators.value_and_gradient`` (no L2 term — the objective adds
+    it, as with the XLA path). Rows are padded to the tile size with
+    zero-weight all-pad rows, which contribute nothing to either
+    output; rows whose slots are ALL pads (empty segments) likewise
+    contribute only their offset's loss, exactly like the XLA path.
+    """
+    if interpret is None:
+        # sequential-grid accumulation is a TPU guarantee; every other
+        # backend gets exact interpret-mode semantics (see _fused)
+        interpret = jax.default_backend() != "tpu"
+    idx, val = x.indices, x.values
+    n, k = idx.shape
+    d = coef.shape[0]
+    if n == 0:
+        zero = jnp.zeros((), jnp.float32)
+        return zero, jnp.zeros((d,), jnp.float32)
+    if k == 0:
+        # width-zero ELL (every row an empty segment): pad one inert
+        # slot so the tile shapes stay non-degenerate
+        idx = jnp.zeros((n, 1), jnp.int32)
+        val = jnp.zeros((n, 1), jnp.float32)
+        k = 1
+    tile = min(tile_n, max(8, n))
+    pad = (-n) % tile
+    y = jnp.asarray(labels, jnp.float32)
+    off = (jnp.zeros((n,), jnp.float32) if offsets is None
+           else jnp.asarray(offsets, jnp.float32))
+    w = (jnp.ones((n,), jnp.float32) if weights is None
+         else jnp.asarray(weights, jnp.float32))
+    if pad:
+        idx = jnp.pad(idx, ((0, pad), (0, 0)))
+        val = jnp.pad(val, ((0, pad), (0, 0)))
+        y = jnp.pad(y, (0, pad))
+        off = jnp.pad(off, (0, pad))
+        w = jnp.pad(w, (0, pad))        # zero weight: no contribution
+    npad = n + pad
+    return _fused_sparse(loss.loss_and_dz, idx, val, y.reshape(npad, 1),
+                         off.reshape(npad, 1), w.reshape(npad, 1), tile,
+                         bool(interpret), jnp.asarray(coef, jnp.float32))
+
+
+def _supported_serving(theta: Array, slot_width: int) -> bool:
+    """Serving gather+margin gate: f32 coefficient vector small enough
+    for the VMEM one-hot expansion tile, at least one gather slot, NOT
+    inside a ``disabled()`` region. Evaluated once per scorer program at
+    build time — the serving tables/batches are concrete by contract."""
+    if _TRACE_DISABLED.get():
+        return False
+    return (slot_width >= 1
+            and theta.ndim == 1
+            and theta.dtype == jnp.float32
+            and theta.shape[0] <= _MAX_SPARSE_DIM)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _fused_margin(idx, val, offsets, tile_b: int, interpret: bool, theta):
+    from jax.experimental import pallas as pl
+
+    n, k = idx.shape
+    d = theta.shape[0]
+
+    def kernel(idx_ref, val_ref, off_ref, theta_ref, out_ref):
+        # same one-hot expansion as the sparse training kernel: the
+        # request tile's (index, value) slots are read from HBM once and
+        # expanded in VMEM; the margin is one MXU contraction against
+        # the pinned coefficient vector. Pad slots (0, 0.0) and pad rows
+        # contribute exactly zero.
+        cols = jax.lax.broadcasted_iota(jnp.int32, (tile_b, d), 1)
+        dense = jnp.zeros((tile_b, d), jnp.float32)
+        for j in range(k):  # k is the static padded slot width
+            onehot = (cols == idx_ref[:, j:j + 1]).astype(jnp.float32)
+            dense = dense + onehot * val_ref[:, j:j + 1].astype(jnp.float32)
+        out_ref[:] = jnp.dot(dense, theta_ref[:],
+                             preferred_element_type=jnp.float32) + off_ref[:]
+
+    grid = (n // tile_b,)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_b, k), lambda i: (i, 0)),
+            pl.BlockSpec((tile_b, k), lambda i: (i, 0)),
+            pl.BlockSpec((tile_b, 1), lambda i: (i, 0)),
+            pl.BlockSpec((d, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_b, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        interpret=interpret,
+    )(idx, val, offsets, theta.reshape(d, 1))
+    return out[:, 0]
+
+
+def fused_gather_margin(
+    idx: Array,
+    val: Array,
+    offsets: Optional[Array],
+    theta: Array,
+    *,
+    tile_b: int = _TILE_B_SERVING,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Fixed-effect serving margins ``offsets + sum_j val[:, j] *
+    theta[idx[:, j]]`` with the request tile read from HBM once.
+
+    Drop-in for the serving scorer's per-shard gathered dot
+    (serving/scorer.py): the caller concatenates every fixed shard's
+    padded (index, value) slots with the shard's offset into one
+    coefficient vector, so the whole fixed-effect margin is ONE kernel
+    per batch instead of a gather + multiply + reduce per shard."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, k = idx.shape
+    if n == 0:
+        return jnp.zeros((0,), jnp.float32)
+    if k == 0:
+        idx = jnp.zeros((n, 1), jnp.int32)
+        val = jnp.zeros((n, 1), jnp.float32)
+        k = 1
+    off = (jnp.zeros((n,), jnp.float32) if offsets is None
+           else jnp.asarray(offsets, jnp.float32))
+    tile = min(tile_b, max(8, n))
+    pad = (-n) % tile
+    if pad:
+        idx = jnp.pad(idx, ((0, pad), (0, 0)))
+        val = jnp.pad(val, ((0, pad), (0, 0)))
+        off = jnp.pad(off, (0, pad))
+    npad = n + pad
+    out = _fused_margin(idx, val.astype(jnp.float32),
+                        off.reshape(npad, 1), tile, bool(interpret),
+                        jnp.asarray(theta, jnp.float32))
+    return out[:n]

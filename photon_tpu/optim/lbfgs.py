@@ -99,15 +99,28 @@ def minimize(
     x0: Array,
     *args,
     config: SolverConfig = SolverConfig(),
+    init_fg=None,
 ) -> SolverResult:
-    """Minimize ``value_and_grad(x, *args) -> (f, g)`` from ``x0``."""
+    """Minimize ``value_and_grad(x, *args) -> (f, g)`` from ``x0``.
+
+    ``init_fg``, when given, is ``(f0, g0)`` already evaluated at the
+    PROJECTED start point — the caller saves the solver's first full
+    evaluation (the hierarchical round body computes F_k(c) anyway for
+    the safeguard; optim/hier.py). Only valid when the caller guarantees
+    the pair really is ``value_and_grad(project_box(x0), *args)``; with
+    box constraints the projection may move x0, so callers without
+    box bounds are the intended users.
+    """
     m = config.num_corrections
     d = x0.shape[0]
     dtype = x0.dtype
     has_box = config.lower_bounds is not None or config.upper_bounds is not None
 
     x0 = project_box(x0, config)
-    f0, g0 = value_and_grad(x0, *args)
+    if init_fg is None:
+        f0, g0 = value_and_grad(x0, *args)
+    else:
+        f0, g0 = init_fg
     tols = absolute_tolerances(f0, g0, config.tolerance)
 
     def cond(c: _Carry):

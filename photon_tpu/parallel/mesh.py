@@ -204,21 +204,73 @@ def pad_batch(batch: DataBatch, multiple: int) -> DataBatch:
     )
 
 
-def shard_batch(batch: DataBatch, mesh: Mesh, axis: str = DATA_AXIS) -> DataBatch:
+def shard_batch(batch: DataBatch, mesh: Mesh, axis=DATA_AXIS) -> DataBatch:
     """Pad + place a DataBatch with its sample dim sharded over ``axis``.
+
+    ``axis`` may be a tuple of mesh axis names (e.g. ``(DCN_AXIS,
+    DATA_AXIS)`` on a two-level mesh) — the sample dim then shards over
+    their product, slice-major, matching ``staged_psum``'s reduction
+    order.
 
     The treeAggregate replacement: once inputs are placed this way, the
     jitted aggregator kernels' reductions compile to all-reduce over ICI.
     """
-    batch = pad_batch(batch, axis_size(mesh, axis))
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    mult = 1
+    for a in axes:
+        mult *= axis_size(mesh, a)
+    batch = pad_batch(batch, mult)
+    spec_axis = axes if len(axes) > 1 else axes[0]
 
     def put(a):
         if a is None:
             return None
-        spec = P(axis, *([None] * (a.ndim - 1)))
+        spec = P(spec_axis, *([None] * (a.ndim - 1)))
         return jax.device_put(a, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, batch)
+
+
+def count_axis_psums(fn, axis: str, *example_args) -> int:
+    """Count ``psum`` equations over mesh axis ``axis`` in the jaxpr of
+    ``fn(*example_args)``, recursing into every sub-jaxpr (jit, while,
+    cond, scan, shard_map bodies).
+
+    This is the static communication-structure oracle behind the
+    hierarchical solver's claim: its round function must contain exactly
+    ONE DCN-stage reduction regardless of how many inner iterations run
+    (tests/bench assert ``count_axis_psums(round_fn, DCN_AXIS, ...) == 1``
+    vs per-iteration for the reference solver)."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+
+    def walk(jaxpr) -> int:
+        n = 0
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            # shard_map's replication checker rewrites psum into
+            # psum-family primitives (psum2 / psum_invariant); all carry
+            # the same ``axes`` param and the same wire traffic
+            if prim.startswith("psum") and axis in tuple(
+                    eqn.params.get("axes", ()) or ()):
+                n += 1
+            for v in eqn.params.values():
+                n += sum(walk(j) for j in _sub_jaxprs(v))
+        return n
+
+    def _sub_jaxprs(v):
+        core = jax.core
+        if isinstance(v, core.ClosedJaxpr):
+            return [v.jaxpr]
+        if isinstance(v, core.Jaxpr):
+            return [v]
+        if isinstance(v, (list, tuple)):
+            out = []
+            for item in v:
+                out.extend(_sub_jaxprs(item))
+            return out
+        return []
+
+    return walk(closed.jaxpr)
 
 
 def replicate(params, mesh: Mesh):

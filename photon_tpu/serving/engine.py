@@ -51,7 +51,9 @@ from photon_tpu.serving.breaker import (
     CircuitBreaker,
 )
 from photon_tpu.serving.model_state import DeviceResidentModel
-from photon_tpu.serving.scorer import MODES, get_scorer, warmup_scorers
+from photon_tpu.serving.scorer import (INT8_MODE, get_scorer,
+                                       serving_modes, tables_for_mode,
+                                       warmup_scorers)
 from photon_tpu.serving.types import (
     Fallback,
     FallbackReason,
@@ -126,7 +128,9 @@ class ServingEngine:
                                     coeff_store=(config.coeff_store
                                                  if config else None),
                                     append_reserve=(config.append_reserve
-                                                    if config else 0))
+                                                    if config else 0),
+                                    int8=(config.int8_serving
+                                          if config else False))
         return cls(model, config=config, clock=clock)
 
     def _prefetch_lookahead(self, request: ScoreRequest) -> None:
@@ -167,7 +171,7 @@ class ServingEngine:
         _metrics.gauge("serving.warmup_programs").set(self._warmup_programs)
         return {"programs": self._warmup_programs,
                 "buckets": list(self.ladder.buckets),
-                "modes": list(MODES),
+                "modes": list(serving_modes(self.model)),
                 "seconds": self._warmup_seconds,
                 "compile_counts": compile_cache.compile_counts()}
 
@@ -266,9 +270,14 @@ class ServingEngine:
         full_ok, probe = self.breaker.allow_full()
         breaker_shed = not full_ok
         shed_any = shed or breaker_shed
-        mode = "fixed_only" if shed_any else "full"
         model = self.model    # one read: a concurrent publish lands on
         # the next batch, never mid-batch
+        if shed_any:
+            mode = "fixed_only"
+        elif getattr(model, "int8_enabled", False):
+            mode = INT8_MODE  # quantized arm IS the healthy-path program
+        else:
+            mode = "full"
 
         # two-tier consistency contract: assemble (slot lookups against the
         # host-side hot maps), the table read, and the scorer DISPATCH all
@@ -293,7 +302,7 @@ class ServingEngine:
                 if delay > 0:
                     time.sleep(delay)
                 raw = get_scorer(model, mode, bucket)(
-                    *args, model.current_tables())
+                    *args, tables_for_mode(model, mode))
             except Exception as e:  # device/dispatch fault: typed, counted
                 scorer_ok = False
                 record_failure("serving_scorer_error", error=repr(e),
@@ -571,7 +580,7 @@ class ServingEngine:
             "model_version": self.model_version,
             "model_label": self.model_label,
             "buckets": list(self.ladder.buckets),
-            "modes": list(MODES),
+            "modes": list(serving_modes(self.model)),
             "warmed": self._warmed,
             "warmup_seconds": self._warmup_seconds,
             "warmup_programs": self._warmup_programs,

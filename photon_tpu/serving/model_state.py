@@ -73,10 +73,29 @@ class _RandomState:
     # and the table shape (a compiled-program shape!) never change.
     append_reserve: int = 0
     append_used: int = 0
+    # int8 serving arm (full-resident coordinates only): row-quantized
+    # mirror of ``coef`` plus the per-row dequantization scales, staged
+    # at load/publish time. None everywhere unless the model was built
+    # with int8=True; two-tier coordinates never quantize.
+    coef_q: Optional[object] = None      # device [E_pad, K] int8
+    scales: Optional[object] = None      # device [E_pad, 1] float32
 
 
 class AssembledBatch(Tuple):
     pass
+
+
+def quantize_rows(rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-row int8 quantization: ``q = round(row / scale)``
+    with ``scale = max|row| / 127`` (all-zero rows get scale 1.0 so the
+    dequantized row is exactly zero). Deterministic and row-local, so a
+    row-level nearline publish can requantize only the touched rows and
+    stay bitwise-consistent with a from-scratch staging."""
+    rows = np.asarray(rows, np.float32)
+    amax = np.abs(rows).max(axis=-1, keepdims=True)
+    scales = np.where(amax > 0.0, amax / 127.0, np.float32(1.0))
+    q = np.clip(np.rint(rows / scales), -127, 127).astype(np.int8)
+    return q, scales.astype(np.float32)
 
 
 def _pad_width(dim: int, requested: Optional[int]) -> int:
@@ -94,7 +113,7 @@ class DeviceResidentModel:
     def __init__(self, model: ServingGameModel, mesh=None,
                  feature_pad: Optional[int] = None, dtype=None,
                  coeff_store: Optional[CoeffStoreConfig] = None,
-                 append_reserve: int = 0):
+                 append_reserve: int = 0, int8: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -103,6 +122,9 @@ class DeviceResidentModel:
         self.dtype = dtype or jnp.float32
         self.token = f"servmodel-{next(_model_counter)}"
         self.mesh = mesh
+        #: int8 serving arm requested: full-resident coordinates carry a
+        #: (coef_q, scales) mirror and "full_int8" programs are warmed
+        self.int8_enabled = bool(int8)
         # serializes batch assembly + scorer dispatch against the
         # two-tier stores' cold->hot transfer commits; recursive so the
         # engine can nest assemble inside its own hold. A model with no
@@ -167,13 +189,17 @@ class DeviceResidentModel:
             reserve = max(int(append_reserve), 0)
             coef = np.concatenate(
                 [coef, np.zeros((1 + reserve, K), coef.dtype)])
+            coef_q = scales = None
+            if self.int8_enabled:
+                q, s = quantize_rows(coef)
+                coef_q, scales = put_ent(q), put_ent(s)
             self.random.append(_RandomState(
                 re.coordinate_id, re.random_effect_type, re.feature_shard_id,
                 put_ent(coef.astype(np.float32) if self.dtype == jnp.float32
                         else coef),
                 E, E, K, dict(re.entity_rows),
                 pkeys[order], ps[order].astype(np.int64),
-                append_reserve=reserve))
+                append_reserve=reserve, coef_q=coef_q, scales=scales))
 
     # -- two-tier store plumbing --------------------------------------------
 
@@ -189,6 +215,16 @@ class DeviceResidentModel:
         scorer dispatch that consume them (the donated transfer scatter
         invalidates superseded table objects)."""
         return tuple(rs.store.table if rs.store is not None else rs.coef
+                     for rs in self.random)
+
+    def current_tables_int8(self) -> tuple:
+        """Gather tables for the "full_int8" programs: full-resident
+        coordinates pass their ``(coef_q, scales)`` pair, two-tier
+        coordinates pass the live f32 hot table (mixed-precision by
+        design — the cold tier is the capacity story there). Same
+        transfer_lock contract as ``current_tables``."""
+        return tuple(rs.store.table if rs.store is not None
+                     else (rs.coef_q, rs.scales)
                      for rs in self.random)
 
     def prefetch_request(self, request: ScoreRequest,
@@ -430,4 +466,5 @@ class DeviceResidentModel:
                        for r in self.random],
             "shard_pad": dict(self.shard_pad),
             "entity_sharded": self.mesh is not None,
+            "int8": self.int8_enabled,
         }

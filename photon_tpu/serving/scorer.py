@@ -17,6 +17,22 @@ random effects as an entity-row gather followed by a slot-aligned dot —
 which is what makes serving-vs-offline parity exact rather than
 approximate.
 
+Two optional hot-path arms layer on top of the same programs:
+
+* ``PHOTON_TPU_PALLAS_SERVING=1`` routes the fixed-effect margins
+  through the fused gather+margin Pallas kernel
+  (ops/pallas_glm.fused_gather_margin): every fixed shard's padded
+  slots concatenate against one coefficient vector, so the whole
+  fixed-effect term is ONE single-HBM-pass kernel per batch instead of
+  a gather + multiply + reduce per shard. Read at program-build time;
+  refusals fall back to the XLA expressions and tick
+  ``kernels.xla_fallbacks{path="serving"}``.
+* ``ServingConfig.int8_serving`` adds a third mode, ``"full_int8"``:
+  full-resident random-effect tables arrive as (int8 rows, per-row f32
+  scales) pairs and dequantize inside the gather — half the
+  random-effect HBM bytes. The mode is warmed alongside the others and
+  guarded by the swap ladder's int8 shadow gate (serving/swap.py).
+
 Programs are shared through ``utils/jitcache`` so every bucket compiles
 once per process; ``warmup_scorers`` dispatches each (mode, bucket)
 program on dummy inputs inside ``compile_cache.warmup`` so the full
@@ -26,7 +42,8 @@ traces.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+import os
+from typing import Callable, Sequence, Tuple
 
 from photon_tpu.serving.model_state import DeviceResidentModel
 from photon_tpu.utils import compile_cache, jitcache
@@ -36,17 +53,78 @@ from photon_tpu.utils import compile_cache, jitcache
 #: under load never triggers a compile
 MODES = ("full", "fixed_only")
 
+#: the opt-in quantized arm — only valid (and only warmed) for models
+#: built with int8=True; its tables argument is
+#: ``model.current_tables_int8()``
+INT8_MODE = "full_int8"
+
+
+def serving_modes(model: DeviceResidentModel) -> Tuple[str, ...]:
+    """The modes this model warms and may dispatch: the base ladder,
+    plus the int8 arm when the model carries quantized tables."""
+    if getattr(model, "int8_enabled", False):
+        return MODES + (INT8_MODE,)
+    return MODES
+
+
+def _fused_fixed_margin(model: DeviceResidentModel, thetas, fixed_pos):
+    """Build-time routing for the fixed-effect term: returns a
+    ``fn(fixed_idx, fixed_val, offsets) -> [B]`` using the fused Pallas
+    gather+margin kernel when the env flag asks for it and the shapes
+    qualify, else None (XLA expressions). Counted per compiled program
+    into ``kernels.pallas_hits`` / ``kernels.xla_fallbacks`` with
+    ``path="serving"`` — same telemetry contract as the training
+    kernels (ops/aggregators.py)."""
+    if os.environ.get("PHOTON_TPU_PALLAS_SERVING") != "1":
+        return None
+    import jax.numpy as jnp
+
+    from photon_tpu.ops import pallas_glm
+    from photon_tpu.ops.aggregators import (_kernel_counter,
+                                            _warn_kernel_refused)
+
+    k_total = sum(int(model.shard_pad[model.shard_order[p]])
+                  for p in fixed_pos)
+    dims = [int(t.shape[0]) for t in thetas]
+    ok = (model.mesh is None and model.dtype == jnp.float32
+          and len(thetas) > 0
+          and all(t.dtype == jnp.float32 for t in thetas)
+          and sum(dims) <= pallas_glm._MAX_SPARSE_DIM
+          and k_total >= 1
+          and not pallas_glm._TRACE_DISABLED.get())
+    if not ok:
+        _kernel_counter("xla_fallbacks", "serving")
+        if not pallas_glm._TRACE_DISABLED.get():
+            _warn_kernel_refused("serving")
+        return None
+    _kernel_counter("pallas_hits", "serving")
+    theta_all = jnp.concatenate([t.astype(jnp.float32) for t in thetas])
+    col_off = [0]
+    for d in dims[:-1]:
+        col_off.append(col_off[-1] + d)
+
+    def fn(fixed_idx, fixed_val, offsets):
+        idx = jnp.concatenate(
+            [fixed_idx[p] + col_off[j] for j, p in enumerate(fixed_pos)],
+            axis=1)
+        val = jnp.concatenate([fixed_val[p] for p in fixed_pos], axis=1)
+        return pallas_glm.fused_gather_margin(
+            idx, val, offsets, theta_all)
+
+    return fn
+
 
 def get_scorer(model: DeviceResidentModel, mode: str,
                bucket: int) -> Callable:
     """Compiled scorer for one (model, mode, bucket); cached process-wide.
 
     Call as ``fn(*args, re_tables)`` where ``args`` is the assemble
-    output and ``re_tables`` is ``model.current_tables()`` read inside
-    the same ``model.transfer_lock`` hold as the assemble (the two-tier
-    store's consistency contract).
+    output and ``re_tables`` is ``model.current_tables()`` — or
+    ``model.current_tables_int8()`` for the "full_int8" mode — read
+    inside the same ``model.transfer_lock`` hold as the assemble (the
+    two-tier store's consistency contract).
     """
-    if mode not in MODES:
+    if mode not in serving_modes(model):
         raise ValueError(f"unknown serving mode {mode!r}")
     key = ("serving_scorer", model.token, mode, int(bucket))
 
@@ -58,22 +136,40 @@ def get_scorer(model: DeviceResidentModel, mode: str,
         shard_pos = {sid: i for i, sid in enumerate(model.shard_order)}
         thetas = tuple(f.theta for f in model.fixed)
         fixed_pos = tuple(shard_pos[f.feature_shard_id] for f in model.fixed)
-        with_random = mode == "full"
+        with_random = mode != "fixed_only"
+        fused_fixed = _fused_fixed_margin(model, thetas, fixed_pos)
 
         @jax.jit
         def fn(fixed_idx, fixed_val, re_sidx, re_sval, re_ent, offsets,
                re_tables):
-            total = offsets.astype(dtype)
-            for theta, pos in zip(thetas, fixed_pos):
-                # ops/features.matvec on the padded ELL layout: pad slots
-                # are (0, 0.0) so they contribute nothing
-                total = total + jnp.sum(
-                    fixed_val[pos].astype(dtype) * theta[fixed_idx[pos]],
-                    axis=-1)
+            if fused_fixed is not None:
+                total = fused_fixed(fixed_idx, fixed_val, offsets) \
+                    .astype(dtype)
+            else:
+                total = offsets.astype(dtype)
+                for theta, pos in zip(thetas, fixed_pos):
+                    # ops/features.matvec on the padded ELL layout: pad
+                    # slots are (0, 0.0) so they contribute nothing
+                    total = total + jnp.sum(
+                        fixed_val[pos].astype(dtype)
+                        * theta[fixed_idx[pos]],
+                        axis=-1)
             if with_random:
                 for coef, sidx, sval, ent in zip(re_tables, re_sidx,
                                                  re_sval, re_ent):
-                    rows = coef.at[ent].get(mode="fill", fill_value=0.0)
+                    if isinstance(coef, tuple):
+                        # int8 arm: (quantized rows, per-row scales) —
+                        # gather both and dequantize in-register; the
+                        # unknown/zero rows quantize to (0, scale 1.0)
+                        # so they still contribute exactly nothing
+                        q, s = coef
+                        rows = (q.at[ent].get(mode="fill", fill_value=0)
+                                .astype(dtype)
+                                * s.at[ent].get(mode="fill",
+                                                fill_value=0.0))
+                    else:
+                        rows = coef.at[ent].get(mode="fill",
+                                                fill_value=0.0)
                     total = total + jnp.sum(
                         sval.astype(dtype)
                         * jnp.take_along_axis(rows, sidx, axis=1),
@@ -85,17 +181,27 @@ def get_scorer(model: DeviceResidentModel, mode: str,
     return jitcache.get_or_build(key, builder)
 
 
+def tables_for_mode(model: DeviceResidentModel, mode: str) -> tuple:
+    """The re_tables argument matching ``mode`` — int8 pairs for the
+    quantized arm, f32 tables otherwise. Same lock contract as
+    ``current_tables``."""
+    if mode == INT8_MODE:
+        return model.current_tables_int8()
+    return model.current_tables()
+
+
 def warmup_scorers(model: DeviceResidentModel,
                    buckets: Sequence[int]) -> int:
     """Compile-and-dispatch every (mode, bucket) program under the warmup
     phase flag. Returns the number of programs warmed."""
     warmed = 0
+    modes = serving_modes(model)
 
     def one_bucket(bucket):
         nonlocal warmed
         args = model.dummy_args(bucket)
-        tables = model.current_tables()
-        for mode in MODES:
+        for mode in modes:
+            tables = tables_for_mode(model, mode)
             out = get_scorer(model, mode, bucket)(*args, tables)
             out.block_until_ready()  # host-sync-ok: warmup only
             warmed += 1

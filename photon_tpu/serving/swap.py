@@ -47,7 +47,8 @@ from photon_tpu.resilience import io as rio
 from photon_tpu.resilience.failures import record_failure
 from photon_tpu.serving.engine import ServingEngine
 from photon_tpu.serving.model_state import DeviceResidentModel
-from photon_tpu.serving.scorer import get_scorer, warmup_scorers
+from photon_tpu.serving.scorer import (INT8_MODE, get_scorer,
+                                       tables_for_mode, warmup_scorers)
 from photon_tpu.utils import compile_cache
 
 MANIFEST_FILE = "swap-manifest.json"
@@ -148,10 +149,12 @@ def verify_swap_manifest(model_dir: str) -> Dict[str, object]:
 
 
 def _shadow_scores(model: DeviceResidentModel, requests: List,
-                   ladder) -> np.ndarray:
+                   ladder, mode: str = "full") -> np.ndarray:
     """Score ``requests`` through ``model`` full-effort, chunked over the
     engine's bucket ladder (every (mode, bucket) program is warmed, so
-    this dispatches zero new compiles).
+    this dispatches zero new compiles). ``mode`` selects the program arm
+    — the int8 gate scores the SAME staged model through "full" and
+    "full_int8" to bound the quantization error in score units.
 
     Two-tier models first promote the shadow sample's entities into the
     hot tier and drain the transfer queue — the shadow gate compares real
@@ -170,8 +173,8 @@ def _shadow_scores(model: DeviceResidentModel, requests: List,
         bucket = ladder.bucket_for(len(chunk))
         with model.transfer_lock:
             args, _fallbacks, _counters = model.assemble(chunk, bucket)
-            raw = get_scorer(model, "full", bucket)(
-                *args, model.current_tables())
+            raw = get_scorer(model, mode, bucket)(
+                *args, tables_for_mode(model, mode))
         out.append(np.asarray(raw)[:len(chunk)])
     return np.concatenate(out) if out else np.zeros(0, np.float32)
 
@@ -250,7 +253,8 @@ def swap_staged(engine: ServingEngine, serving_model, label: str,
             serving_model, mesh=mesh if mesh is not None else engine.model.mesh,
             feature_pad=engine.config.feature_pad,
             coeff_store=engine.config.coeff_store,
-            append_reserve=engine.config.append_reserve)
+            append_reserve=engine.config.append_reserve,
+            int8=engine.config.int8_serving)
         warmup_scorers(staged, engine.ladder.buckets)
     except Exception as e:  # any staging fault refuses, live keeps serving
         return _reject(engine, label, gates, "staging",
@@ -290,6 +294,41 @@ def swap_staged(engine: ServingEngine, serving_model, label: str,
         gates["shadow"] = "pass"
     else:
         gates["shadow"] = "skip"
+
+    # int8_shadow: when the candidate was staged with the quantized arm,
+    # bound the quantization error in SCORE units — the same captured
+    # requests through the staged model's f32 ("full") and int8
+    # ("full_int8") programs must agree within int8_max_deviation. Runs
+    # inside the compile window: both arms were warmed in staging, so a
+    # retrace here fails the compiles gate too.
+    if getattr(staged, "int8_enabled", False):
+        if shadow_n >= cfg.min_shadow_requests:
+            try:
+                f32_scores = _shadow_scores(staged, sample, engine.ladder)
+                q_scores = _shadow_scores(staged, sample, engine.ladder,
+                                          mode=INT8_MODE)
+            except Exception as e:
+                staged.close_stores()
+                return _reject(engine, label, gates, "int8_shadow",
+                               f"int8 shadow scoring failed: {e!r}",
+                               shadow_requests=shadow_n,
+                               shadow_max_deviation=max_dev)
+            int8_dev = float(np.max(np.abs(f32_scores - q_scores))) \
+                if shadow_n else 0.0
+            _metrics.histogram("serving.swap_int8_deviation",
+                               DEVIATION_BUCKETS).observe(int8_dev)
+            if not np.all(np.isfinite(q_scores)) \
+                    or int8_dev > cfg.int8_max_deviation:
+                staged.close_stores()
+                return _reject(engine, label, gates, "int8_shadow",
+                               f"int8 deviation {int8_dev:.3e} > "
+                               f"{cfg.int8_max_deviation:.3e} "
+                               f"over {shadow_n} requests",
+                               shadow_requests=shadow_n,
+                               shadow_max_deviation=max_dev)
+            gates["int8_shadow"] = "pass"
+        else:
+            gates["int8_shadow"] = "skip"
 
     # compiles: staging+shadow must not have compiled on the steady path
     steady1 = compile_cache.compile_counts().get("steady_state", 0)

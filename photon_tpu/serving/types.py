@@ -233,6 +233,12 @@ class SwapConfig:
     min_shadow_requests: int = 1
     #: refuse candidates without a crc32 swap manifest (swap-manifest.json)
     require_manifest: bool = False
+    #: int8 arm gate: reject a candidate staged with ``int8_serving``
+    #: whose quantized ("full_int8") shadow scores deviate from its own
+    #: f32 ("full") scores by more than this (max abs over the captured
+    #: requests). inf = accept any quantization error that is finite.
+    #: Only evaluated when the staged model actually has the int8 arm.
+    int8_max_deviation: float = float("inf")
     #: post-publish probation: a breaker trip within this window triggers
     #: automatic rollback to the prior version; 0 disables the guard
     probation_s: float = 30.0
@@ -319,3 +325,12 @@ class ServingConfig:
     #: typed capacity gate until the next full swap. Two-tier coordinates
     #: ignore this — their cold file carries its own reserve.
     append_reserve: int = 0
+    #: OPT-IN int8 serving arm: full-resident random-effect tables are
+    #: additionally staged as (int8 rows, per-row f32 scales) at model
+    #: load / swap-staging time, and healthy (non-shed) traffic scores
+    #: through the dequantizing "full_int8" programs — halving the
+    #: random-effect gather bytes. Guarded by the swap ladder's
+    #: ``SwapConfig.int8_max_deviation`` shadow gate; two-tier
+    #: coordinates keep their f32 hot tables (the cold tier is the
+    #: capacity lever there). Off = exact f32 behavior, no extra tables.
+    int8_serving: bool = False

@@ -41,6 +41,19 @@ _PEAK_FLOPS_BY_KIND = (
 _CPU_FALLBACK_PEAK = 1e11     # nominal; flags MFU as not-a-TPU number
 _UNKNOWN_TPU_PEAK = 275e12    # v4 figure, assumed for unrecognized TPU kinds
 
+# peak HBM bandwidth per chip (bytes/s), same device_kind matching.
+# (Public figures; normalizes the bandwidth-utilization estimate.)
+_PEAK_HBM_BW_BY_KIND = (
+    ("v6", 1640e9),           # Trillium / v6e
+    ("v5p", 2765e9),
+    ("v5", 819e9),            # v5e
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+_CPU_FALLBACK_BW = 5e10       # nominal DRAM figure; flags not-a-TPU
+_UNKNOWN_TPU_BW = 1228e9      # v4 figure for unrecognized TPU kinds
+
 
 def peak_flops(device) -> tuple:
     """(peak_flops, label) for a jax device; CPU gets a nominal figure."""
@@ -54,11 +67,80 @@ def peak_flops(device) -> tuple:
     return _CPU_FALLBACK_PEAK, kind or "cpu"
 
 
+def peak_hbm_bw(device) -> tuple:
+    """(peak HBM bytes/s, label) for a jax device; CPU gets a nominal
+    figure so the utilization number is still computable (and obviously
+    labelled as not a TPU measurement)."""
+    kind = getattr(device, "device_kind", "") or ""
+    low = kind.lower()
+    for marker, bw in _PEAK_HBM_BW_BY_KIND:
+        if marker in low:
+            return bw, kind
+    if getattr(device, "platform", "") in ("tpu", "axon"):
+        return _UNKNOWN_TPU_BW, kind or "tpu-unknown(v4 assumed)"
+    return _CPU_FALLBACK_BW, kind or "cpu"
+
+
 def _nnz_slots(features) -> int:
     """Feature slots touched per objective pass (dense: n*d; ELL: n*K)."""
     if isinstance(features, F.SparseFeatures):
         return int(np.prod(features.values.shape))
     return int(np.prod(features.shape))
+
+
+def value_grad_pass_bytes(features, dim: int, fused: bool = False) -> int:
+    """HBM bytes one value+gradient evaluation must move, from shapes:
+    the feature stream (dense f32 tile or ELL int32 index + f32 value
+    slots), the per-sample vectors (labels, offsets, weights), and the
+    coefficient/gradient vectors. The XLA two-contraction path streams
+    the features TWICE (margins, then the transposed contraction);
+    ``fused=True`` models the single-HBM-pass Pallas kernels
+    (ops/pallas_glm.py). A deliberate lower bound — intermediates that
+    XLA may spill are not counted."""
+    nnz = _nnz_slots(features)
+    if isinstance(features, F.SparseFeatures):
+        n = int(features.values.shape[0])
+        stream = nnz * (4 + 4)            # int32 index + f32 value
+    else:
+        n = int(features.shape[0])
+        stream = nnz * int(np.dtype(features.dtype).itemsize)
+    passes = 1 if fused else 2
+    return passes * stream + 3 * n * 4 + 2 * int(dim) * 4
+
+
+def phase_utilization(model_flops: int, bytes_moved: int, seconds: float,
+                      device=None, phase: str = "solve") -> dict:
+    """MFU and HBM-bandwidth-utilization estimate for one solve phase.
+
+    Both are model-work ratios against chip peaks — deliberate lower
+    bounds computed from shapes, not hardware counters. The dict lands
+    in bench records, and the two gauges (``perf.mfu`` /
+    ``perf.hbm_bw_util`` with a ``phase`` label) put the same numbers in
+    every RunReport via the metrics-registry snapshot."""
+    import jax
+
+    from photon_tpu.obs.metrics import registry
+
+    if device is None:
+        device = jax.devices()[0]
+    peak, kind = peak_flops(device)
+    peak_bw, _ = peak_hbm_bw(device)
+    seconds = max(float(seconds), 1e-12)
+    mfu = model_flops / seconds / peak
+    bw_util = bytes_moved / seconds / peak_bw
+    registry.gauge("perf.mfu", phase=phase).set(mfu)
+    registry.gauge("perf.hbm_bw_util", phase=phase).set(bw_util)
+    return {
+        "phase": phase,
+        "device_kind": kind,
+        "model_flops": int(model_flops),
+        "bytes_moved": int(bytes_moved),
+        "seconds": float(seconds),
+        "mfu": float(mfu),
+        "hbm_bw_utilization": float(bw_util),
+        "peak_flops": float(peak),
+        "peak_hbm_bw": float(peak_bw),
+    }
 
 
 def fixed_effect_flops(coord) -> int:

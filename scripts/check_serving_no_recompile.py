@@ -128,10 +128,12 @@ def drive_traffic(engine, names):
 
 
 def _jitted_programs(model, ladder):
-    from photon_tpu.serving.scorer import MODES, get_scorer
+    # per-model mode set: an int8 engine carries the extra full_int8
+    # programs, and those must be trace-frozen too
+    from photon_tpu.serving.scorer import get_scorer, serving_modes
 
     programs = [get_scorer(model, mode, b)
-                for mode in MODES for b in ladder.buckets]
+                for mode in serving_modes(model) for b in ladder.buckets]
     # unwrap telemetry first-call timers to reach the jitted fn (a jit fn
     # itself carries __wrapped__, so test for the jit API, don't unwrap
     # unconditionally)
@@ -427,17 +429,141 @@ def delta_publish_arm(baseline, registry, compile_cache) -> list:
     return failures
 
 
+def int8_arm(baseline, registry, compile_cache) -> list:
+    """Same contract with the int8 quantized serving arm active: the
+    warmed set gains the full_int8 programs (mixed int8/f32 pytree
+    tables), traffic dispatches through them, and a live swap restages
+    quantized tables through the int8_shadow gate — the steady-state
+    compile counter, jitcache entries, and per-program trace counts must
+    stay frozen throughout."""
+    import tempfile
+
+    import numpy as np
+
+    from photon_tpu.io.model_io import load_for_serving
+    from photon_tpu.serving import (
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+        SLOConfig,
+    )
+    from photon_tpu.serving.scorer import serving_modes
+    from photon_tpu.serving.swap import swap_staged
+    from photon_tpu.serving.types import SwapConfig
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="int8_ck_") as td:
+        import os as _os
+        d1, d2 = _os.path.join(td, "v1"), _os.path.join(td, "v2")
+        names = build_model_dir(7, d1)
+        build_model_dir(23, d2)
+        engine = ServingEngine.from_model_dir(d1, config=ServingConfig(
+            max_batch=8, max_wait_s=0.0, int8_serving=True,
+            slo=SLOConfig(shed_queue_depth=6, reject_queue_depth=100),
+            swap=SwapConfig(int8_max_deviation=0.5)))
+        info = engine.warmup()
+        if "full_int8" not in info["modes"]:
+            engine.shutdown()
+            return [f"int8 arm: full_int8 missing from warmed modes "
+                    f"{info['modes']}"]
+        n_modes = len(serving_modes(engine.model))
+        if info["programs"] != len(engine.ladder.buckets) * n_modes:
+            engine.shutdown()
+            return [f"int8 arm: warmed {info['programs']} programs, "
+                    f"expected {len(engine.ladder.buckets) * n_modes}"]
+
+        # re-baseline: the delta-publish arm's trainer solves move the
+        # steady-state counter by design; this arm guards its own window
+        baseline = compile_cache.compile_counts()
+        misses0 = registry.counter("jitcache.misses").value
+        jitted = _jitted_programs(engine.model, engine.ladder)
+        traces0 = [f._cache_size() for f in jitted]
+
+        rng = np.random.default_rng(17)
+
+        def req(uid, n_feats, user):
+            feats = [(str(names[j]), "", float(rng.normal()))
+                     for j in rng.choice(len(names), size=n_feats,
+                                         replace=False)]
+            return ScoreRequest(uid, {"shardA": feats},
+                                {"userId": user} if user else {})
+
+        served = 0
+        for n in range(1, engine.ladder.max_batch + 1):
+            reqs = [req(f"i{n}-{i}", int(rng.integers(0, len(names))),
+                        f"u{i % 5}" if i % 3 else "cold-entity")
+                    for i in range(n)]
+            served += len(engine.serve(reqs))
+        for i in range(engine.config.slo.shed_queue_depth + 3):
+            engine.submit(req(f"is{i}", 4, f"u{i % 5}"))
+        served += len(engine.drain())
+
+        after = compile_cache.compile_counts()
+        misses1 = registry.counter("jitcache.misses").value
+        traces1 = [f._cache_size() for f in jitted]
+        if after["steady_state"] != baseline["steady_state"]:
+            failures.append(
+                f"int8 steady-state compiles moved: "
+                f"{baseline['steady_state']} -> {after['steady_state']}")
+        if misses1 != misses0:
+            failures.append(f"int8 jitcache.misses moved: "
+                            f"{misses0} -> {misses1}")
+        for i, (t0, t1) in enumerate(zip(traces0, traces1)):
+            if t1 > t0:
+                failures.append(f"int8 program {i} re-traced: "
+                                f"_cache_size {t0} -> {t1}")
+
+        # live swap: restages quantized tables through the int8_shadow
+        # deviation gate; steady-state counter stays frozen
+        result = swap_staged(engine, load_for_serving(d2), "v2")
+        if not result.accepted:
+            failures.append(f"int8 swap rejected: {result.reason} "
+                            f"(gates {result.gates})")
+        elif result.gates.get("int8_shadow") != "pass":
+            failures.append(f"int8 swap skipped the int8_shadow gate: "
+                            f"{result.gates}")
+        else:
+            misses2 = registry.counter("jitcache.misses").value
+            jitted += _jitted_programs(engine.model, engine.ladder)
+            traces2 = [f._cache_size() for f in jitted]
+            for n in range(1, engine.ladder.max_batch + 1):
+                reqs = [req(f"ip{n}-{i}", int(rng.integers(0, len(names))),
+                            f"u{i % 5}" if i % 3 else "cold-entity")
+                        for i in range(n)]
+                served += len(engine.serve(reqs))
+            final = compile_cache.compile_counts()
+            if final["steady_state"] != baseline["steady_state"]:
+                failures.append(
+                    f"int8 post-swap steady-state compiles moved: "
+                    f"{baseline['steady_state']} -> "
+                    f"{final['steady_state']}")
+            if registry.counter("jitcache.misses").value != misses2:
+                failures.append("int8 post-swap jitcache.misses moved")
+            for i, (t0, t1) in enumerate(
+                    zip(traces2, [f._cache_size() for f in jitted])):
+                if t1 > t0:
+                    failures.append(f"int8 post-swap program {i} "
+                                    f"re-traced: {t0} -> {t1}")
+        engine.shutdown()
+        if not failures:
+            print(f"ok: int8 arm served {served} over "
+                  f"{n_modes} modes, swap to v{result.version} "
+                  f"(int8_shadow=pass), steady-state compiles=0")
+    return failures
+
+
 def main() -> int:
     from photon_tpu.obs.metrics import registry
-    from photon_tpu.serving.scorer import MODES
+    from photon_tpu.serving.scorer import serving_modes
     from photon_tpu.serving.swap import swap_staged
     from photon_tpu.utils import compile_cache
 
     engine, names = build_engine()
     info = engine.warmup()
-    if info["programs"] != len(engine.ladder.buckets) * len(MODES):
+    n_modes = len(serving_modes(engine.model))
+    if info["programs"] != len(engine.ladder.buckets) * n_modes:
         print(f"FAIL: warmed {info['programs']} programs, expected "
-              f"{len(engine.ladder.buckets) * len(MODES)}")
+              f"{len(engine.ladder.buckets) * n_modes}")
         return 1
 
     baseline = compile_cache.compile_counts()
@@ -526,8 +652,18 @@ def main() -> int:
         for f in dp_failures:
             print("  " + f)
         return 1
+
+    # -- int8 quantized-serving arm: the full_int8 programs join the
+    # warmed set and must stay just as compile-free
+    i8_failures = int8_arm(baseline, registry, compile_cache)
+    if i8_failures:
+        print("FAIL: int8 serving compiled:")
+        for f in i8_failures:
+            print("  " + f)
+        return 1
     print(f"ok: {served} responses over buckets {list(engine.ladder.buckets)}"
-          f" x modes {list(MODES)}, live swap to v{result.version} "
+          f" x modes {list(serving_modes(engine.model))}, "
+          f"live swap to v{result.version} "
           f"(shadow dev {result.shadow_max_deviation:.3e} over "
           f"{result.shadow_requests} reqs), warmup compiles="
           f"{int(final['warmup'])}, steady-state compiles=0")

@@ -158,3 +158,21 @@ def test_two_process_sparse_tp_model_axis_spans_processes(tmp_path):
     single = np.asarray(model.coefficients.means)
 
     np.testing.assert_allclose(multi, single, rtol=5e-4, atol=5e-4)
+
+
+def test_two_process_hier_round_psum_crosses_dcn(tmp_path):
+    """Hierarchical solver over a real 2-process cluster whose DCN mesh
+    axis IS the process boundary: the round program carries exactly ONE
+    DCN-stage psum (static oracle, checked in each worker under the
+    multi-process mesh), the accept-always rounds land within 1e-5
+    relative loss of the per-evaluation-DCN reference L-BFGS, and the
+    round solve crossed the process boundary fewer times than the
+    reference paid evaluations."""
+    out = str(tmp_path / "hier.npy")
+    logs = _run_workers(out, mode="hier")
+
+    assert any("devices 8" in l for l in logs), logs
+    assert sum("dcn-axis-procs 2" in l for l in logs) == 2, logs
+    assert sum("round-psums 1" in l for l in logs) == 2, logs
+    assert not any("hier-bad" in l for l in logs), logs
+    assert sum("hier-ok" in l for l in logs) == 2, logs

@@ -662,3 +662,65 @@ def test_bench_nearline_quick_smoke():
     assert rec["zero_steady_state_compiles"] is True
     assert rec["publish_parity_ok"] is True
     assert rec["quick"] is True
+
+
+# -- int8 serving arm: publish consistency + rollback ------------------------
+
+
+def test_int8_tables_track_publishes_and_rollback():
+    """Row-level publishes into an int8 engine must keep the quantized
+    tables consistent with the f32 rows: touched rows are requantized at
+    commit (per-row symmetric quantization is row-local and
+    deterministic, so this equals from-scratch staging), appends land in
+    both representations, and rollback restores the quantized tables
+    bitwise alongside the f32 ones."""
+    from photon_tpu.serving.model_state import quantize_rows
+
+    with tempfile.TemporaryDirectory(prefix="nl_i8_") as td:
+        d = os.path.join(td, "m")
+        names = _build_model_dir(7, d)
+        engine = ServingEngine.from_model_dir(d, config=ServingConfig(
+            max_batch=4, max_wait_s=0.0, append_reserve=4,
+            slo=SLOConfig(shed_queue_depth=60, reject_queue_depth=100),
+            int8_serving=True))
+        engine.warmup()
+        try:
+            rng = np.random.default_rng(51)
+            users = [f"u{i}" for i in range(5)]
+            _drive(engine, rng, names, users)
+            rs = engine.model.random[0]
+            assert rs.coef_q is not None
+            q_before = np.asarray(rs.coef_q).tobytes()
+            s_before = np.asarray(rs.scales).tobytes()
+
+            log_dir = os.path.join(td, "log")
+            _write_events(log_dir, rng, names, ["u0", "u1", "newuser"])
+            pipe = _pipeline(engine, log_dir, d)
+            s = pipe.run_round()
+            assert s["publish"]["accepted"], s["publish"]
+            assert s["publish"]["rows_appended"] == 1
+
+            # requantize-on-commit invariant: every known entity's live
+            # int8 row equals from-scratch quantization of its f32 row
+            rs = engine.model.random[0]
+            coef = np.asarray(rs.coef, np.float32)
+            q_now = np.asarray(rs.coef_q)
+            sc_now = np.asarray(rs.scales, np.float32)
+            for e in rs.entity_rows.values():
+                qe, se = quantize_rows(coef[e][None])
+                np.testing.assert_array_equal(q_now[e], qe[0])
+                np.testing.assert_array_equal(sc_now[e], se[0])
+            assert q_now.tobytes() != q_before    # the publish was live
+
+            # the appended entity scores through the int8 arm
+            post = engine.serve([_mkreq(rng, "post", names, "newuser")])[0]
+            assert "UNKNOWN_ENTITY" not in \
+                {f.reason.name for f in post.fallbacks}
+
+            # rollback restores the quantized tables bitwise
+            assert pipe.publisher.rollback_last("test")
+            rs = engine.model.random[0]
+            assert np.asarray(rs.coef_q).tobytes() == q_before
+            assert np.asarray(rs.scales).tobytes() == s_before
+        finally:
+            engine.shutdown()

@@ -258,3 +258,150 @@ def test_fused_bf16_feature_storage():
     np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_x, np.float32),
                                rtol=5e-2, atol=5e-2)
     assert g_f.dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# sparse ELL kernel edges: tile remainders, zero weights, empty segments
+# ---------------------------------------------------------------------------
+
+
+def _sparse_problem(n, k, d, seed=13):
+    from photon_tpu.ops import features as F
+
+    rng = np.random.default_rng(seed)
+    idx = jnp.asarray(rng.integers(0, d, size=(n, k)), jnp.int32)
+    val = jnp.asarray(rng.normal(size=(n, k)) / np.sqrt(max(k, 1)),
+                      jnp.float32)
+    y = jnp.asarray((rng.random(n) > 0.4), jnp.float32)
+    off = jnp.asarray(rng.normal(size=n) * 0.2, jnp.float32)
+    w = jnp.asarray(rng.random(n) + 0.1, jnp.float32)
+    coef = jnp.asarray(rng.normal(size=d) * 0.4, jnp.float32)
+    return F.SparseFeatures(idx, val), y, off, w, coef
+
+
+def _sparse_xla(x, y, off, w, coef):
+    from photon_tpu.ops import pallas_glm
+
+    with pallas_glm.disabled():
+        return aggregators.value_and_gradient(
+            LogisticLoss, x, y, off, w, coef, no_normalization())
+
+
+@pytest.mark.parametrize("n", [1, 7, 127, 128, 129, 333])
+def test_sparse_tile_remainders(n):
+    """N not divisible by the tile: pad rows are zero-weight all-pad rows
+    and must contribute exactly nothing."""
+    from photon_tpu.ops.pallas_glm import fused_sparse_value_grad
+
+    x, y, off, w, coef = _sparse_problem(n, 4, 64)
+    v0, g0 = _sparse_xla(x, y, off, w, coef)
+    v1, g1 = fused_sparse_value_grad(LogisticLoss, x, y, off, w, coef,
+                                     tile_n=128)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=5e-6)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=5e-5, atol=5e-5)
+
+
+def test_sparse_zero_weight_rows():
+    from photon_tpu.ops.pallas_glm import fused_sparse_value_grad
+
+    x, y, off, w, coef = _sparse_problem(100, 4, 64)
+    w = w.at[::3].set(0.0)
+    v0, g0 = _sparse_xla(x, y, off, w, coef)
+    v1, g1 = fused_sparse_value_grad(LogisticLoss, x, y, off, w, coef)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=5e-6)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=5e-5, atol=5e-5)
+
+
+def test_sparse_empty_segments_and_zero_width():
+    """Rows whose slots are ALL pads contribute only their offset's
+    loss; a width-zero ELL block (k=0) is every row empty."""
+    from photon_tpu.ops import features as F
+    from photon_tpu.ops.pallas_glm import fused_sparse_value_grad
+
+    x, y, off, w, coef = _sparse_problem(60, 3, 32)
+    idx = x.indices.at[::4].set(0)
+    val = x.values.at[::4].set(0.0)          # (0, 0.0) = pad slots
+    x2 = F.SparseFeatures(idx, val)
+    v0, g0 = _sparse_xla(x2, y, off, w, coef)
+    v1, g1 = fused_sparse_value_grad(LogisticLoss, x2, y, off, w, coef)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=5e-6)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0),
+                               rtol=5e-5, atol=5e-5)
+
+    k0 = F.SparseFeatures(jnp.zeros((16, 0), jnp.int32),
+                          jnp.zeros((16, 0), jnp.float32))
+    y0, off0, w0 = y[:16], off[:16], w[:16]
+    v0, g0 = _sparse_xla(k0, y0, off0, w0, coef)
+    v1, g1 = fused_sparse_value_grad(LogisticLoss, k0, y0, off0, w0, coef)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=5e-6)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), atol=5e-6)
+
+
+def test_sparse_empty_batch():
+    from photon_tpu.ops import features as F
+    from photon_tpu.ops.pallas_glm import fused_sparse_value_grad
+
+    x = F.SparseFeatures(jnp.zeros((0, 4), jnp.int32),
+                         jnp.zeros((0, 4), jnp.float32))
+    v, g = fused_sparse_value_grad(
+        LogisticLoss, x, jnp.zeros((0,), jnp.float32), None, None,
+        jnp.zeros(8, jnp.float32))
+    assert float(v) == 0.0
+    np.testing.assert_array_equal(np.asarray(g), np.zeros(8, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# serving gather+margin kernel edges
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 5, 64, 127, 128, 129])
+def test_serving_margin_tile_remainders(n):
+    from photon_tpu.ops.pallas_glm import fused_gather_margin
+
+    rng = np.random.default_rng(21)
+    d, k = 96, 6
+    idx = jnp.asarray(rng.integers(0, d, size=(n, k)), jnp.int32)
+    val = jnp.asarray(rng.normal(size=(n, k)), jnp.float32)
+    off = jnp.asarray(rng.normal(size=n), jnp.float32)
+    theta = jnp.asarray(rng.normal(size=d) * 0.3, jnp.float32)
+    got = fused_gather_margin(idx, val, off, theta)
+    want = off + jnp.sum(val * theta[idx], axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_serving_margin_degenerate_shapes():
+    from photon_tpu.ops.pallas_glm import fused_gather_margin
+
+    theta = jnp.arange(8, dtype=jnp.float32)
+    # empty batch
+    out = fused_gather_margin(jnp.zeros((0, 3), jnp.int32),
+                              jnp.zeros((0, 3), jnp.float32), None, theta)
+    assert out.shape == (0,)
+    # zero slot width: margins are just the offsets
+    off = jnp.asarray([1.5, -2.0], jnp.float32)
+    out = fused_gather_margin(jnp.zeros((2, 0), jnp.int32),
+                              jnp.zeros((2, 0), jnp.float32), off, theta)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(off))
+    # None offsets
+    idx = jnp.asarray([[2], [5]], jnp.int32)
+    val = jnp.asarray([[2.0], [1.0]], jnp.float32)
+    out = fused_gather_margin(idx, val, None, theta)
+    np.testing.assert_allclose(np.asarray(out), [4.0, 5.0])
+
+
+def test_serving_supported_gate():
+    from photon_tpu.ops import pallas_glm
+
+    theta = jnp.zeros(64, jnp.float32)
+    assert pallas_glm._supported_serving(theta, 4)
+    assert not pallas_glm._supported_serving(theta, 0)
+    assert not pallas_glm._supported_serving(
+        jnp.zeros(64, jnp.float64), 4)
+    assert not pallas_glm._supported_serving(
+        jnp.zeros(pallas_glm._MAX_SPARSE_DIM + 1, jnp.float32), 4)
+    with pallas_glm.disabled():
+        assert not pallas_glm._supported_serving(theta, 4)

@@ -629,3 +629,131 @@ def test_bench_coldtier_quick_smoke():
     assert rec["zero_steady_state_compiles"] is True
     assert rec["value"] > 0.5                 # quick Zipf still mostly hits
     assert rec["store"]["promotes"] > 0
+
+
+# -- fused serving kernel + int8 quantized arm -------------------------------
+
+
+def test_fused_serving_kernel_parity(tmp_path, monkeypatch):
+    """PHOTON_TPU_PALLAS_SERVING=1 routes the fixed-effect margin through
+    the fused gather+margin kernel with offline parity intact, and the
+    serving kernel-activation counter records the hits."""
+    from photon_tpu.obs.metrics import registry
+
+    monkeypatch.setenv("PHOTON_TPU_PALLAS_SERVING", "1")
+    model_dir, imaps, vocab, users = _build_model_dir(tmp_path)
+    samples = _make_traffic(23, users)
+    offline = _offline_scores(model_dir, imaps, vocab, samples)
+    hits0 = registry.counter("kernels.pallas_hits", path="serving").value
+    engine = ServingEngine.from_model_dir(
+        model_dir, config=ServingConfig(max_batch=8, max_wait_s=0.0))
+    engine.warmup()
+    got = np.asarray([r.score for r in engine.serve(_requests(samples))])
+    np.testing.assert_allclose(got, offline, atol=1e-6)
+    hits1 = registry.counter("kernels.pallas_hits", path="serving").value
+    assert hits1 > hits0
+    engine.shutdown()
+
+
+def test_int8_arm_bounded_deviation_zero_compiles(tmp_path):
+    """The int8 quantized arm: full_int8 joins the warmed modes, scores
+    stay within quantization tolerance of the f32 offline scores (but
+    are NOT bitwise-identical — the arm must actually be live), and
+    steady-state traffic stays compile-free."""
+    from photon_tpu.utils import compile_cache
+
+    model_dir, imaps, vocab, users = _build_model_dir(tmp_path)
+    samples = _make_traffic(23, users)
+    offline = _offline_scores(model_dir, imaps, vocab, samples)
+    engine = ServingEngine.from_model_dir(
+        model_dir, config=ServingConfig(max_batch=8, max_wait_s=0.0,
+                                        int8_serving=True))
+    info = engine.warmup()
+    assert "full_int8" in info["modes"]
+    got = np.asarray([r.score for r in engine.serve(_requests(samples))])
+    dev = float(np.max(np.abs(got - offline)))
+    assert 0.0 < dev < 0.05, dev
+    c0 = compile_cache.compile_counts().get("steady_state", 0)
+    engine.serve(_requests(samples))
+    assert compile_cache.compile_counts().get("steady_state", 0) == c0
+    engine.shutdown()
+
+
+def test_int8_quantize_rows_invariants():
+    """Per-row symmetric int8: deterministic, row-local, zero rows get
+    scale 1.0 (inert), and dequantization error is bounded by scale/2
+    per slot."""
+    from photon_tpu.serving.model_state import quantize_rows
+
+    rng = np.random.default_rng(5)
+    rows = rng.normal(size=(32, 6)).astype(np.float32) * 3.0
+    rows[7] = 0.0
+    q, s = quantize_rows(rows)
+    q2, s2 = quantize_rows(rows)
+    np.testing.assert_array_equal(q, q2)       # deterministic
+    np.testing.assert_array_equal(s, s2)
+    assert q.dtype == np.int8 and s.dtype == np.float32
+    assert s[7, 0] == 1.0 and not q[7].any()   # zero row inert
+    deq = q.astype(np.float32) * s
+    assert np.max(np.abs(deq - rows)) <= float(np.max(s)) / 2.0 + 1e-7
+
+
+def test_swap_int8_shadow_gate(tmp_path):
+    """The swap ladder's int8_shadow gate: a sane deviation bound
+    accepts (gate=pass); an impossible bound rejects with the typed
+    gate failure and the live model is untouched."""
+    from photon_tpu.serving.swap import swap_staged
+    from photon_tpu.serving.types import SwapConfig
+
+    model_dir, imaps, vocab, users = _build_model_dir(tmp_path)
+    samples = _make_traffic(23, users)
+    engine = ServingEngine.from_model_dir(
+        model_dir, config=ServingConfig(
+            max_batch=8, max_wait_s=0.0, int8_serving=True,
+            swap=SwapConfig(int8_max_deviation=0.5)))
+    engine.warmup()
+    engine.serve(_requests(samples))           # shadow-gate sample
+    res = swap_staged(engine, load_for_serving(model_dir), "v2")
+    assert res.accepted, (res.reason, res.gates)
+    assert res.gates.get("int8_shadow") == "pass"
+
+    engine2 = ServingEngine.from_model_dir(
+        model_dir, config=ServingConfig(
+            max_batch=8, max_wait_s=0.0, int8_serving=True,
+            swap=SwapConfig(int8_max_deviation=1e-12)))
+    engine2.warmup()
+    engine2.serve(_requests(samples))
+    res2 = swap_staged(engine2, load_for_serving(model_dir), "v3")
+    assert not res2.accepted
+    assert res2.gates.get("int8_shadow") == "fail"
+    engine.shutdown()
+    engine2.shutdown()
+
+
+# -- fused bench smoke (tier-1 wiring for bench.py --mode fused) -------------
+
+
+def test_bench_fused_quick_smoke():
+    """Asserts the record's structural/parity fields, not wall-clock:
+    on CPU the kernels run in interpret mode, so the wallclock gate is
+    waived and the single-HBM-pass claim is certified via the
+    kernel-activation counters instead."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--mode", "fused", "--quick"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads([l for l in proc.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert rec["metric"] == "fused_sparse_speedup"
+    assert "error" not in rec, rec
+    assert rec["quick"] is True
+    assert rec["single_hbm_pass_structure"] is True, rec
+    assert rec["sparse_pallas_hits"] >= 1
+    assert rec["sparse_parity_dev"] < 1e-5
+    assert rec["serving"]["parity_dev"] < 1e-5
+    assert rec["int8"]["within_bound"] is True
+    import jax
+    if jax.default_backend() == "tpu":
+        assert rec["fused_beats_xla_wallclock"] is True, rec
