@@ -2958,6 +2958,142 @@ def run_fused_bench(scale: float, quick: bool = False):
     return rec
 
 
+# --------------------------------------------------------------------------
+# stream mode: --mode stream -> BENCH_STREAM_r01.json
+# --------------------------------------------------------------------------
+
+def run_stream_bench(scale: float, quick: bool = False):
+    """Out-of-core streaming training vs the fully-resident solve.
+
+    Same f64 logistic problem fit two ways: (a) resident — whole batch in
+    device memory, the jitted lax L-BFGS; (b) streamed — the data only
+    ever exists on device one double-buffered chunk pair at a time
+    (staging budget <= 25% of the dataset), host-loop L-BFGS over
+    chunk-accumulated passes. Reports full-fit grad/value parity, wall
+    ratio against a 1.3x budget, bitwise run-to-run reproducibility of
+    the streamed fit, and the transfer-vs-compute overlap-efficiency
+    gauges from one instrumented pass. ``--quick`` is the tier-1 smoke
+    shape with NO artifact write."""
+    del scale  # fixed shapes: the staging-budget fraction IS the point
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    import gc
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_tpu.data.dataset import DataBatch
+    from photon_tpu.data.ingest import generate_binary_classification
+    from photon_tpu.data.streaming import (ChunkLoader, DenseSource,
+                                            StreamConfig, ensure_aligned)
+    from photon_tpu.function.objective import GLMObjective, Hyper
+    from photon_tpu.optim import lbfgs
+    from photon_tpu.optim.base import SolverConfig
+    from photon_tpu.optim.streaming import StreamedProblem, minimize_streamed
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.utils.flops import stream_overlap_utilization
+
+    n, d = (16384, 64) if quick else (131072, 256)
+    l2 = 0.1
+    rng = np.random.default_rng(11)
+    X, y, _ = generate_binary_classification(rng, n, d)
+    # 64-byte-aligned sources keep the loader's zero-copy fast path live
+    X = ensure_aligned(np.ascontiguousarray(X, np.float64))
+    y = ensure_aligned(np.ascontiguousarray(y, np.float64))
+    dataset_bytes = X.nbytes + y.nbytes
+
+    obj = GLMObjective(loss=LogisticLoss)
+    cfg = SolverConfig(max_iterations=100, tolerance=1e-9)
+    # chunk = n/8 rows, 2 staging buffers -> 2/8 = 25% of the dataset is
+    # the most host+device staging memory the pipeline ever holds
+    stream_cfg = StreamConfig(chunk_rows=n // 8, num_buffers=2,
+                              dtype=np.float64)
+
+    def make_loader():
+        return ChunkLoader(DenseSource(X, y), stream_cfg)
+
+    def make_problem():
+        return StreamedProblem(obj, make_loader(), l2_weight=l2)
+
+    staging_fraction = (stream_cfg.num_buffers
+                        * make_loader().chunk_bytes() / dataset_bytes)
+
+    # -- resident arm (warm, then timed) ------------------------------------
+    batch = DataBatch(features=jnp.asarray(X), labels=jnp.asarray(y))
+    hyper = Hyper.of(l2, jnp.float64)
+    x0 = jnp.zeros(d, jnp.float64)
+    vg = lambda c: obj.value_and_gradient(c, batch, hyper)
+    res_resident = lbfgs.minimize(vg, x0, config=cfg)
+    jax.block_until_ready(res_resident.coef)
+    t0 = time.perf_counter()
+    res_resident = lbfgs.minimize(vg, x0, config=cfg)
+    jax.block_until_ready(res_resident.coef)
+    resident_s = time.perf_counter() - t0
+
+    # -- streamed arm (warm compile via run 1; run 2 timed; run 3 = the
+    #    bitwise run-to-run witness) ----------------------------------------
+    res_stream = minimize_streamed(make_problem(), np.zeros(d), config=cfg)
+    gc.collect()
+    t0 = time.perf_counter()
+    res_stream = minimize_streamed(make_problem(), np.zeros(d), config=cfg)
+    streamed_s = time.perf_counter() - t0
+    res_repro = minimize_streamed(make_problem(), np.zeros(d), config=cfg)
+    bitwise = bool(np.array_equal(np.asarray(res_stream.coef),
+                                  np.asarray(res_repro.coef)))
+
+    # -- full-pass (f, g) parity at the fitted point ------------------------
+    coef_fit = np.asarray(res_resident.coef)
+    f_res, g_res = vg(jnp.asarray(coef_fit))
+    prob = make_problem()
+    f_str, g_str = prob.value_and_gradient(coef_fit)
+    scale_f = max(abs(float(f_res)), 1.0)
+    value_dev = abs(float(f_res) - float(f_str)) / scale_f
+    grad_dev = float(np.max(np.abs(np.asarray(g_res) - g_str))
+                     / max(float(np.max(np.abs(np.asarray(g_res)))), 1e-30))
+    fit_dev = float(np.max(np.abs(coef_fit - np.asarray(res_stream.coef))))
+
+    # -- overlap gauges from that instrumented pass -------------------------
+    st = prob.loader.last_stats
+    overlap = stream_overlap_utilization(
+        st.reader_busy_s, st.consumer_stall_s, st.wall_s, st.bytes_h2d)
+
+    ratio = streamed_s / max(resident_s, 1e-12)
+    rec = {
+        "metric": "stream_vs_resident_wall_ratio",
+        "value": round(ratio, 3),
+        "unit": "x (streamed / resident, full L-BFGS fit)",
+        "ratio_budget": 1.3,
+        "within_budget": bool(ratio <= 1.3),
+        "resident_wall_s": round(resident_s, 3),
+        "streamed_wall_s": round(streamed_s, 3),
+        "grad_parity": bool(grad_dev <= 1e-6 and value_dev <= 1e-6),
+        "value_rel_dev": value_dev,
+        "grad_rel_dev": grad_dev,
+        "fit_coef_dev": fit_dev,
+        "bitwise_run_to_run": bitwise,
+        "resident_iterations": int(np.asarray(res_resident.iterations)),
+        "streamed_iterations": int(np.asarray(res_stream.iterations)),
+        "n": n, "dim": d,
+        "chunk_rows": int(make_loader().chunk_rows),
+        "num_chunks": int(make_loader().num_chunks),
+        "num_buffers": stream_cfg.num_buffers,
+        "dataset_mb": round(dataset_bytes / 2**20, 1),
+        "staging_budget_fraction": round(staging_fraction, 4),
+        "overlap": overlap,
+        "quick": quick,
+    }
+    if not quick:
+        out = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(out, "BENCH_STREAM_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"stream: wall ratio {ratio:.3f}x (budget 1.3), grad dev "
+        f"{grad_dev:.2e}, bitwise={bitwise}, overlap "
+        f"{overlap['overlap_efficiency']:.2f}, staging "
+        f"{staging_fraction:.0%} of dataset")
+    return rec
+
+
 # Order = on-chip capture priority (each config emits its JSON line the
 # moment it completes, so when the flaky relay dies mid-run the most
 # decision-relevant numbers are already on disk): the NEWTON flagship,
@@ -2990,7 +3126,7 @@ def main():
                     help="comma-separated subset of config names")
     ap.add_argument("--mode", default=os.environ.get("BENCH_MODE", "train"),
                     choices=("train", "serving", "game_cd", "coldtier",
-                             "nearline", "hier", "fused"),
+                             "nearline", "hier", "fused", "stream"),
                     help="train = the solver configs (default); serving = "
                          "the online-serving bench -> BENCH_SERVING_r01.json; "
                          "game_cd = parallel-vs-sequential CD sweeps "
@@ -3002,10 +3138,12 @@ def main():
                          "solver DCN-reduction ratio vs reference "
                          "-> BENCH_HIER_r01.json; fused = fused-kernel "
                          "sparse/serving/int8 coverage "
-                         "-> BENCH_FUSED_r01.json")
+                         "-> BENCH_FUSED_r01.json; stream = out-of-core "
+                         "streamed vs resident training "
+                         "-> BENCH_STREAM_r01.json")
     ap.add_argument("--quick", action="store_true",
-                    help="game_cd/coldtier/nearline/hier/fused: tiny "
-                         "tier-1 smoke shape (no artifact write)")
+                    help="game_cd/coldtier/nearline/hier/fused/stream: "
+                         "tiny tier-1 smoke shape (no artifact write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -3124,6 +3262,22 @@ def main():
             emit({"metric": "fused_sparse_speedup", "value": 0.0,
                   "unit": "x vs XLA sparse path", "error": repr(e)})
         _DONE.set()     # fused mode: the record above IS the summary
+        return
+
+    if args.mode == "stream":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/stream"):
+                emit(run_stream_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"stream bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "stream_vs_resident_wall_ratio", "value": 0.0,
+                  "unit": "x (streamed / resident, full L-BFGS fit)",
+                  "error": repr(e)})
+        _DONE.set()     # stream mode: the record above IS the summary
         return
 
     if args.mode == "game_cd":

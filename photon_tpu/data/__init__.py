@@ -1,0 +1,12 @@
+"""Host-side data containers, ingestion and the out-of-core streaming
+loader."""
+
+from photon_tpu.data.dataset import DataBatch  # noqa: F401
+from photon_tpu.data.streaming import (  # noqa: F401
+    ChunkLoader,
+    ensure_aligned,
+    CsrSource,
+    DenseSource,
+    StreamConfig,
+    StreamStats,
+)

@@ -47,3 +47,18 @@ class DataBatch(NamedTuple):
         if self.weights is None:
             return jnp.asarray(float(self.num_samples), dtype=self.labels.dtype)
         return jnp.sum(self.weights)
+
+    def row_slice(self, start: int, stop: int) -> "DataBatch":
+        """Static row window [start, stop) of every per-sample leaf
+        (dense or padded-ELL features) — the resident-side chunking
+        primitive the streaming parity tests and bench compare against."""
+        def cut(a):
+            return None if a is None else a[start:stop]
+        if isinstance(self.features, F.SparseFeatures):
+            feats = F.SparseFeatures(
+                indices=self.features.indices[start:stop],
+                values=self.features.values[start:stop])
+        else:
+            feats = self.features[start:stop]
+        return DataBatch(features=feats, labels=self.labels[start:stop],
+                         offsets=cut(self.offsets), weights=cut(self.weights))

@@ -204,6 +204,29 @@ def to_batch(data: LibSVMData, dtype=np.float32,
     )
 
 
+def chunk_source(data: LibSVMData, dtype=np.float32):
+    """LibSVM rows -> a ``data.streaming.CsrSource`` for out-of-core
+    training: the same padded-ELL rows ``to_batch`` would build, but
+    materialized one chunk at a time by the streaming loader instead of
+    as one resident batch. The row-list storage form is flattened to the
+    CSR arrays once, on the host."""
+    from photon_tpu.data.streaming import CsrSource
+    from photon_tpu.game.dataset import CsrRows
+
+    if isinstance(data.rows, CsrRows):
+        indptr, cols, vals = data.rows.indptr, data.rows.cols, data.rows.vals
+    else:
+        nnz = np.asarray([len(r[0]) for r in data.rows], np.int64)
+        indptr = np.zeros(len(data.rows) + 1, np.int64)
+        np.cumsum(nnz, out=indptr[1:])
+        cols = (np.concatenate([np.asarray(r[0]) for r in data.rows])
+                if len(data.rows) else np.zeros(0, np.int32))
+        vals = (np.concatenate([np.asarray(r[1]) for r in data.rows])
+                if len(data.rows) else np.zeros(0, np.float64))
+    return CsrSource(indptr, cols, vals, data.labels, dim=data.dim,
+                     max_nnz=data.max_nnz, dtype=dtype)
+
+
 # -- synthetic generators (reference: SparkTestUtils.scala:66+) -------------
 
 def generate_binary_classification(

@@ -1,0 +1,677 @@
+"""Out-of-core streaming ingest: double-buffered host->device chunk pipeline.
+
+Photon ML's Spark runtime streams training data from disk through
+executors, so dataset size never bounds a fit; the TPU rebuild held every
+shard in device memory. This module removes that assumption with the
+pipeline shape of Snap ML (PAPERS.md): a fixed pool of pow2-shaped host
+staging buffers filled by a reader thread, with the device transfer of
+chunk k+1 dispatched while the consumer computes on chunk k.
+
+Invariants the rest of the system builds on:
+
+- **Static chunk shape.** Every chunk is exactly ``chunk_rows`` rows
+  (rounded up to a power of two); the tail is zero-padded with weight-0
+  rows. One jitted per-chunk program therefore serves the entire stream.
+- **Deterministic chunk order.** Chunks are emitted in ascending raw-row
+  order, always — there is no shuffling and no reader-side reordering, so
+  two runs over the same source produce bitwise-identical chunk
+  sequences (the foundation of the streamed solver's run-to-run and
+  kill/resume bitwise guarantees).
+- **Filter-stable chunk assignment.** With ``drop_invalid``, rows are
+  filtered per raw block by ``validators.invalid_chunk_mask`` (the same
+  row-local rules the resident validator applies) and survivors are
+  packed densely across chunk boundaries — surviving row i lands in
+  chunk i // chunk_rows exactly as it would after filtering the resident
+  dataset up front.
+- **Bounded staging memory.** Host-side memory is ``num_buffers`` staging
+  buffers plus one raw block; device-side memory is at most the chunks
+  in flight through the bounded queue. Neither scales with dataset size.
+- **Safe buffer recycling.** A staging buffer is reused only after the
+  reader has fenced the consumer out of it — on the reader thread,
+  never the consumer's per-chunk path. In copy mode (any accelerator,
+  or any meshed run) the fence is ``block_until_ready`` on the prior
+  device arrays: once the DMA copy lands, the staging memory is free.
+  On unmeshed CPU backends the loader instead *aliases* the staging
+  buffers into device arrays via dlpack (zero-copy — ``device_put`` on
+  CPU is a slow single-threaded memcpy that would triple host traffic),
+  and the fence becomes a **consumption token**: an async consumer
+  calls ``loader.release(chunk, token)`` with an output of the
+  computation that read the chunk (the streamed solver passes the new
+  carry), and the reader blocks on that token before refilling the
+  buffer. Consumers that read chunks synchronously need nothing — the
+  generator auto-releases a chunk when the next one is requested.
+
+Chaos hooks: ``chaos.chunk_read_delay`` (slow disk) and
+``chaos.chunk_read_error`` (transient read failure, retried under the
+``resilience/retry`` env knobs) fire inside the reader thread, so fault
+injection exercises the real overlap path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Iterator, List, NamedTuple, Optional
+
+import numpy as np
+
+from photon_tpu.data.dataset import DataBatch
+from photon_tpu.ops import features as F
+from photon_tpu.resilience import chaos
+from photon_tpu.resilience.retry import RetryPolicy, with_retries
+from photon_tpu.types import TaskType
+
+
+class RawBlock(NamedTuple):
+    """One raw block read from a ChunkSource (host numpy, row-major).
+
+    Dense sources fill ``x`` [rows, dim]; sparse sources fill the
+    padded-ELL pair ``idx``/``val`` [rows, ell_width]. ``weights`` and
+    ``offsets`` are optional per-row columns.
+    """
+
+    labels: np.ndarray
+    x: Optional[np.ndarray] = None
+    idx: Optional[np.ndarray] = None
+    val: Optional[np.ndarray] = None
+    offsets: Optional[np.ndarray] = None
+    weights: Optional[np.ndarray] = None
+
+    @property
+    def rows(self) -> int:
+        return int(self.labels.shape[0])
+
+
+class DenseSource:
+    """Dense [n, dim] design matrix (ndarray or np.memmap) as a chunk
+    source. ``read_block`` returns views; the loader either copies them
+    into its staging buffers or (zero-copy mode, full aligned chunks)
+    publishes the views directly, so a memmapped X streams from disk
+    without ever materializing in RAM beyond one block. The source
+    arrays are assumed immutable for the lifetime of the stream."""
+
+    def __init__(self, X, labels, offsets=None, weights=None):
+        if X.ndim != 2 or X.shape[0] != np.shape(labels)[0]:
+            raise ValueError(f"X {X.shape} does not match labels "
+                             f"{np.shape(labels)}")
+        self.X = X
+        self.labels = labels
+        self.offsets = offsets
+        self.weights = weights
+        self.num_rows, self.dim = X.shape
+        self.ell_width: Optional[int] = None   # dense
+
+    def read_block(self, start: int, stop: int) -> RawBlock:
+        sl = slice(start, stop)
+        return RawBlock(
+            labels=np.asarray(self.labels[sl]),
+            x=np.asarray(self.X[sl]),
+            offsets=None if self.offsets is None
+            else np.asarray(self.offsets[sl]),
+            weights=None if self.weights is None
+            else np.asarray(self.weights[sl]),
+        )
+
+
+class CsrSource:
+    """CSR rows streamed as fixed-width padded-ELL blocks. ``max_nnz`` is
+    a global static so every chunk lowers to the same compiled program;
+    rows wider than it are rejected up front (silent truncation would
+    corrupt margins, same contract as ops/features.from_csr_arrays)."""
+
+    def __init__(self, indptr, cols, vals, labels, dim: int,
+                 max_nnz: Optional[int] = None, offsets=None, weights=None,
+                 dtype=np.float32):
+        self.indptr = np.asarray(indptr, np.int64)
+        self.cols = np.asarray(cols)
+        self.vals = np.asarray(vals)
+        self.labels = labels
+        self.offsets = offsets
+        self.weights = weights
+        self.num_rows = len(self.indptr) - 1
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        row_nnz = np.diff(self.indptr)
+        widest = int(row_nnz.max()) if self.num_rows else 0
+        k = int(max_nnz) if max_nnz is not None else widest
+        if widest > k:
+            raise ValueError(f"row has {widest} nonzeros > max_nnz={k}; "
+                             "refusing to silently truncate features")
+        self.ell_width = k
+
+    def read_block(self, start: int, stop: int) -> RawBlock:
+        indptr = self.indptr[start:stop + 1]
+        r = stop - start
+        k = self.ell_width
+        row_nnz = np.diff(indptr)
+        idx = np.zeros((r, k), np.int32)
+        val = np.zeros((r, k), self.dtype)
+        if r and k:
+            slot = np.arange(k)[None, :]
+            mask = slot < row_nnz[:, None]
+            src = indptr[:-1, None] + slot
+            idx[mask] = self.cols[src[mask]]
+            val[mask] = self.vals[src[mask]]
+        sl = slice(start, stop)
+        return RawBlock(
+            labels=np.asarray(self.labels[sl]), idx=idx, val=val,
+            offsets=None if self.offsets is None
+            else np.asarray(self.offsets[sl]),
+            weights=None if self.weights is None
+            else np.asarray(self.weights[sl]),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """Knobs for the streaming chunk loader.
+
+    ``chunk_rows`` is rounded UP to a power of two (static shapes; one
+    compiled per-chunk program). ``num_buffers=2`` is classic double
+    buffering: one buffer in flight to the device while the reader fills
+    the other; raise it to deepen prefetch when reads are bursty.
+    ``drop_invalid`` applies the resident validator's row-local rules
+    per chunk (``task`` required). ``retry`` defaults to the env-tunable
+    ``RetryPolicy.from_env()`` (PHOTON_TPU_IO_RETRIES / _RETRY_BASE_S /
+    _RETRY_MAX_S), the same knobs the checkpoint/cold-store I/O uses.
+    """
+
+    chunk_rows: int = 8192
+    num_buffers: int = 2
+    dtype: object = np.float32
+    drop_invalid: bool = False
+    task: Optional[TaskType] = None
+    retry: Optional[RetryPolicy] = None
+    # None = auto: alias staging buffers into device arrays (dlpack,
+    # zero-copy) on unmeshed CPU backends, DMA-copy everywhere else.
+    # False forces copy mode (e.g. a consumer that dispatches async
+    # compute on chunks but cannot provide release tokens).
+    zero_copy: Optional[bool] = None
+
+
+class DeviceChunk(NamedTuple):
+    index: int          # position in the deterministic chunk order
+    rows: int           # real rows (tail chunks: < chunk_rows; rest pad)
+    batch: DataBatch    # device-resident, chunk_rows rows, weight-0 pads
+    # True when the chunk occupies a recycled staging buffer and so needs
+    # a consumption token before reuse; False for chunks aliased straight
+    # off the (immutable, never-recycled) source arrays
+    fenced: bool = True
+
+
+@dataclasses.dataclass
+class StreamStats:
+    """Wall-clock accounting of one pass, read by the overlap gauges
+    (utils/flops.stream_overlap_utilization). ``reader_busy_s`` is the
+    hideable work (read + validate + stage + transfer dispatch);
+    ``consumer_stall_s`` is how much of it was NOT hidden (consumer sat
+    in q.get); ``transfer_wait_s`` is reader-side backpressure waiting to
+    recycle a buffer still in flight."""
+
+    chunks: int = 0
+    rows: int = 0
+    rows_dropped: int = 0
+    bytes_h2d: int = 0
+    reader_busy_s: float = 0.0
+    transfer_wait_s: float = 0.0
+    consumer_stall_s: float = 0.0
+    wall_s: float = 0.0
+
+
+class _EndOfPass(NamedTuple):
+    num_chunks: int
+
+
+class _ReaderError(NamedTuple):
+    error: BaseException
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(int(n) - 1, 0).bit_length() if n > 1 else 1
+
+
+_ALIGN = 64   # XLA:CPU requires 64-byte alignment to alias a host buffer
+
+
+def _aligned_zeros(shape, dtype) -> np.ndarray:
+    """Zeroed ndarray whose data pointer is ``_ALIGN``-byte aligned, so
+    dlpack import of the staging buffer is a true alias (an unaligned
+    buffer silently degrades to a copy and the whole zero-copy path
+    loses its point)."""
+    dt = np.dtype(dtype)
+    n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    raw = np.zeros(n + _ALIGN, np.uint8)
+    off = (-raw.ctypes.data) % _ALIGN
+    return raw[off:off + n].view(dt).reshape(shape)
+
+
+def ensure_aligned(a: np.ndarray) -> np.ndarray:
+    """Return ``a`` if its buffer is 64-byte aligned and C-contiguous,
+    else a one-time aligned copy. XLA:CPU only aliases aligned host
+    buffers, and numpy's default allocator gives 16 — so an in-RAM dense
+    source built straight from ``rng.normal``/``np.load`` silently loses
+    the source-alias fast path on every chunk of every pass. Memmapped
+    and freshly materialized large arrays are page-aligned already; this
+    is for the in-RAM case, where one copy is affordable and amortizes
+    over the whole fit."""
+    a = np.ascontiguousarray(a)
+    if a.ctypes.data % _ALIGN == 0:
+        return a
+    out = _aligned_zeros(a.shape, a.dtype)
+    np.copyto(out, a)
+    return out
+
+
+class ChunkLoader:
+    """Async prefetching chunk loader over a ChunkSource.
+
+    ``stream(start_chunk=k)`` yields DeviceChunks in deterministic
+    ascending order; one stream may be active per loader at a time. The
+    reader thread owns the staging pool and all raw I/O; the consumer
+    only ever touches device arrays, so its per-chunk path stays free of
+    host syncs.
+    """
+
+    def __init__(self, source, config: StreamConfig = StreamConfig(),
+                 mesh=None):
+        if config.drop_invalid and config.task is None:
+            raise ValueError("drop_invalid requires StreamConfig.task")
+        if config.num_buffers < 2:
+            raise ValueError("need >= 2 staging buffers to double-buffer")
+        self.source = source
+        self.config = config
+        self.mesh = mesh
+        self.dtype = np.dtype(config.dtype)
+        self.chunk_rows = _pow2_ceil(config.chunk_rows)
+        if mesh is not None:
+            from photon_tpu.parallel import mesh as M
+            self._axes = ((M.DCN_AXIS, M.DATA_AXIS)
+                          if M.DCN_AXIS in mesh.axis_names else M.DATA_AXIS)
+            names = (self._axes if isinstance(self._axes, tuple)
+                     else (self._axes,))
+            shards = int(np.prod([mesh.shape[a] for a in names]))
+            if self.chunk_rows % shards:
+                raise ValueError(f"chunk_rows={self.chunk_rows} not "
+                                 f"divisible by {shards} sample shards")
+        import jax
+        cpu = jax.devices()[0].platform not in ("tpu", "axon")
+        # Zero-copy alias mode: on an unmeshed CPU backend the "device"
+        # is the host, so publishing a chunk is a dlpack import of the
+        # staging buffer (~0 cost) instead of device_put's slow
+        # single-threaded memcpy. Recycling then fences on consumption
+        # tokens (see release()). Anywhere a real transfer happens
+        # (accelerators, meshed runs) we copy, and fence on the copy.
+        self._alias = (cpu and mesh is None) if config.zero_copy is None \
+            else bool(config.zero_copy)
+        # Copy mode on CPU: device_put may itself alias host memory, so
+        # leaves are defensively copied at put time.
+        self._copy_on_put = cpu and not self._alias
+        self._policy = config.retry or RetryPolicy.from_env()
+        self._buffers = [self._alloc_buffer()
+                         for _ in range(config.num_buffers)]
+        # shared all-ones weights column for source-aliased full chunks
+        # (immutable once built, so it needs no fence either)
+        self._ones = _aligned_zeros(self.chunk_rows, self.dtype)
+        self._ones[:] = 1
+        self._inflight: List[Optional[DataBatch]] = \
+            [None] * config.num_buffers
+        self._release_q: queue.Queue = queue.Queue()
+        self._released_idx = -1
+        self._streaming = False
+        self._num_chunks: Optional[int] = None
+        self.last_stats = StreamStats()
+
+    # -- geometry -----------------------------------------------------------
+
+    @property
+    def num_chunks(self) -> Optional[int]:
+        """Chunks per pass. Known a priori without filtering; with
+        ``drop_invalid`` it depends on the survivor count and is cached
+        after the first complete pass (None before that)."""
+        if not self.config.drop_invalid:
+            n = self.source.num_rows
+            return max(1, -(-n // self.chunk_rows))
+        return self._num_chunks
+
+    def chunk_bytes(self) -> int:
+        """Host bytes of one staged chunk (= device bytes per chunk)."""
+        return sum(a.nbytes for a in self._buffers[0].values())
+
+    # -- staging pool -------------------------------------------------------
+
+    def _alloc_buffer(self) -> dict:
+        c, dt = self.chunk_rows, self.dtype
+        buf = {"labels": _aligned_zeros(c, dt),
+               "weights": _aligned_zeros(c, dt)}
+        if getattr(self.source, "offsets", None) is not None:
+            buf["offsets"] = _aligned_zeros(c, dt)
+        if self.source.ell_width is None:
+            buf["x"] = _aligned_zeros((c, self.source.dim), dt)
+        else:
+            buf["idx"] = _aligned_zeros((c, self.source.ell_width), np.int32)
+            buf["val"] = _aligned_zeros((c, self.source.ell_width), dt)
+        return buf
+
+    def _acquire(self, b: int, stop: threading.Event,
+                 stats: StreamStats) -> dict:
+        """Fence the consumer out of buffer ``b`` before the reader
+        refills it. Runs on the reader thread only — the consumer's
+        per-chunk path never blocks on device state. Copy mode fences on
+        the chunk's own device arrays (transfer landed => staging free);
+        alias mode pops the next consumption token (chunk order equals
+        recycle order, so one token frees exactly one buffer)."""
+        import jax
+        prev = self._inflight[b]
+        self._inflight[b] = None
+        if prev is None:
+            return self._buffers[b]
+        t0 = time.perf_counter()
+        fence = prev
+        if self._alias:
+            fence = None
+            while not stop.is_set():
+                try:
+                    fence = self._release_q.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    continue
+        if fence is not None:
+            for leaf in jax.tree_util.tree_leaves(fence):
+                leaf.block_until_ready()  # host-sync-ok: reader-side buffer-recycle fence
+        stats.transfer_wait_s += time.perf_counter() - t0
+        return self._buffers[b]
+
+    def _pack(self, buf: dict, fill: int, block: RawBlock,
+              pos: int, take: int) -> None:
+        end, bsl = fill + take, slice(pos, pos + take)
+        buf["labels"][fill:end] = block.labels[bsl]
+        if block.weights is not None:
+            buf["weights"][fill:end] = block.weights[bsl]
+        else:
+            buf["weights"][fill:end] = 1.0
+        if "offsets" in buf:
+            buf["offsets"][fill:end] = block.offsets[bsl]
+        if "x" in buf:
+            buf["x"][fill:end] = block.x[bsl]
+        else:
+            buf["idx"][fill:end] = block.idx[bsl]
+            buf["val"][fill:end] = block.val[bsl]
+
+    def _zero_tail(self, buf: dict, fill: int) -> None:
+        for a in buf.values():
+            a[fill:] = 0
+
+    def _alias_put(self, buf: dict) -> Optional[dict]:
+        """Publish staging arrays as zero-copy device aliases. Returns
+        None (and permanently downgrades to copy mode) if this backend
+        will not alias — the pointer check catches a silent dlpack copy,
+        which would reintroduce the triple host traffic AND break the
+        token fence's assumption that the device reads staging memory."""
+        import jax.numpy as jnp
+        try:
+            out = {}
+            for k, a in buf.items():
+                d = jnp.from_dlpack(a)
+                if d.unsafe_buffer_pointer() != a.ctypes.data:
+                    return None
+                out[k] = d
+            return out
+        except Exception:   # noqa: BLE001 — alias is an optimization only
+            return None
+
+    @staticmethod
+    def _to_batch(buf: dict, sparse: bool) -> DataBatch:
+        if sparse:
+            feats = F.SparseFeatures(indices=buf["idx"], values=buf["val"])
+        else:
+            feats = buf["x"]
+        return DataBatch(features=feats, labels=buf["labels"],
+                         offsets=buf.get("offsets"),
+                         weights=buf["weights"])
+
+    def _put(self, buf: dict) -> DataBatch:
+        import jax
+        if self._alias:
+            aliased = self._alias_put(buf)
+            if aliased is None:
+                self._alias = False
+                self._copy_on_put = True
+            else:
+                return self._to_batch(aliased,
+                                      self.source.ell_width is not None)
+        batch = self._to_batch(buf, self.source.ell_width is not None)
+        if self._copy_on_put:
+            batch = jax.tree_util.tree_map(np.copy, batch)
+        if self.mesh is not None:
+            from photon_tpu.parallel import mesh as M
+            return M.shard_batch(batch, self.mesh, axis=self._axes)
+        return jax.device_put(batch)
+
+    def _alias_block(self, block: RawBlock) -> Optional[DataBatch]:
+        """Source-alias fast path: a full chunk whose block arrays
+        already have the exact staged layout (shape, dtype, row-major,
+        64-byte aligned) is published without touching the staging pool
+        at all — for a dense source these are views of the (immutable)
+        design matrix, for CSR the block's freshly materialized ELL
+        arrays, so no buffer is ever recycled and no fence is needed.
+        This halves host memory traffic, which is the whole cost of
+        streaming a memory-bound objective on CPU. Returns None when any
+        array misses the layout contract (the staging path handles it)."""
+        arrs = {"labels": block.labels,
+                "weights": self._ones if block.weights is None
+                else block.weights}
+        if "offsets" in self._buffers[0]:
+            arrs["offsets"] = block.offsets
+        if self.source.ell_width is None:
+            arrs["x"] = block.x
+        else:
+            arrs["idx"] = block.idx
+            arrs["val"] = block.val
+        proto = self._buffers[0]
+        for k, a in arrs.items():
+            if (a is None or a.shape != proto[k].shape
+                    or a.dtype != proto[k].dtype
+                    or not a.flags["C_CONTIGUOUS"]
+                    or a.ctypes.data % _ALIGN):
+                return None
+        aliased = self._alias_put(arrs)
+        if aliased is None:
+            return None
+        return self._to_batch(aliased, self.source.ell_width is not None)
+
+    # -- reader thread ------------------------------------------------------
+
+    def _read_raw(self, start: int, stop: int) -> RawBlock:
+        chaos.chunk_read_error()
+        d = chaos.chunk_read_delay()
+        if d > 0:
+            time.sleep(d)
+        return self.source.read_block(start, stop)
+
+    def _filter(self, block: RawBlock, stats: StreamStats) -> RawBlock:
+        # deferred: validators reaches game.dataset, which itself imports
+        # this package — a module-level import would be circular
+        from photon_tpu.data import validators
+
+        fv = block.x if block.x is not None else block.val
+        bad = validators.invalid_chunk_mask(
+            block.labels, self.config.task, offsets=block.offsets,
+            weights=block.weights, feature_values=fv)
+        n_bad = int(bad.sum())
+        if not n_bad:
+            return block
+        stats.rows_dropped += n_bad
+        keep = ~bad
+        return RawBlock(*(None if a is None else a[keep] for a in block))
+
+    def _produce(self, q: queue.Queue, stop: threading.Event,
+                 start_chunk: int, stats: StreamStats) -> None:
+        try:
+            c, n = self.chunk_rows, self.source.num_rows
+            # staged_i rotates the staging pool independently of the
+            # global chunk index: source-aliased chunks consume no buffer
+            emitted, staged_i, fill = 0, 0, 0
+            buf = self._acquire(0, stop, stats)
+            for s in range(0, n, c):
+                if stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                block = with_retries(self._read_raw, s, min(s + c, n),
+                                     op="stream.chunk_read",
+                                     policy=self._policy)
+                if self.config.drop_invalid:
+                    block = self._filter(block, stats)
+                if (self._alias and fill == 0 and block.rows == c
+                        and not self.config.drop_invalid):
+                    dev = (None if emitted < start_chunk
+                           else self._alias_block(block))
+                    if dev is not None or emitted < start_chunk:
+                        self._emit_aliased(q, stop, emitted, c, dev, stats,
+                                           t0)
+                        emitted += 1
+                        if stop.is_set():
+                            return
+                        continue
+                pos, remaining = 0, block.rows
+                while remaining:
+                    take = min(c - fill, remaining)
+                    self._pack(buf, fill, block, pos, take)
+                    fill += take
+                    pos += take
+                    remaining -= take
+                    if fill == c:
+                        self._emit(q, stop, emitted,
+                                   staged_i % self.config.num_buffers, c,
+                                   start_chunk, stats, t0)
+                        emitted += 1
+                        staged_i += 1
+                        fill = 0
+                        if stop.is_set():
+                            return
+                        buf = self._acquire(
+                            staged_i % self.config.num_buffers, stop, stats)
+                        t0 = time.perf_counter()  # recycle wait != work
+                stats.reader_busy_s += time.perf_counter() - t0
+            if fill > 0 or emitted == 0:
+                t0 = time.perf_counter()
+                self._zero_tail(buf, fill)
+                self._emit(q, stop, emitted,
+                           staged_i % self.config.num_buffers, fill,
+                           start_chunk, stats, t0)
+                emitted += 1
+            self._q_put(q, stop, _EndOfPass(emitted))
+        except BaseException as e:  # noqa: BLE001 — surfaced to consumer
+            self._q_put(q, stop, _ReaderError(e))
+
+    def _emit_aliased(self, q: queue.Queue, stop: threading.Event,
+                      index: int, rows: int, dev: Optional[DataBatch],
+                      stats: StreamStats, t0: float) -> None:
+        stats.reader_busy_s += time.perf_counter() - t0
+        if dev is None:   # resume fast-forward: nothing to publish
+            return
+        stats.chunks += 1
+        stats.rows += rows
+        stats.bytes_h2d += self.chunk_bytes()
+        self._q_put(q, stop, DeviceChunk(index=index, rows=rows, batch=dev,
+                                         fenced=False))
+
+    def _emit(self, q: queue.Queue, stop: threading.Event, index: int,
+              b: int, rows: int, start_chunk: int, stats: StreamStats,
+              t0: float) -> None:
+        if index < start_chunk:
+            # resume fast-forward: the raw read/pack had to happen (chunk
+            # packing state carries across chunks) but the transfer is
+            # skipped — the consumer restarts at its checkpointed cursor
+            stats.reader_busy_s += time.perf_counter() - t0
+            return
+        dev = self._put(self._buffers[b])
+        self._inflight[b] = dev
+        stats.chunks += 1
+        stats.rows += rows
+        stats.bytes_h2d += self.chunk_bytes()
+        stats.reader_busy_s += time.perf_counter() - t0
+        self._q_put(q, stop, DeviceChunk(index=index, rows=rows, batch=dev))
+
+    @staticmethod
+    def _q_put(q: queue.Queue, stop: threading.Event, item) -> None:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # -- consumer -----------------------------------------------------------
+
+    def release(self, chunk: DeviceChunk, token) -> None:
+        """Hand buffer ``chunk`` back to the reader. ``token`` is any
+        device pytree whose readiness implies every read of the chunk
+        has completed — the streamed solver passes the carry its chunk
+        partial produced. Required (per chunk, in order) by consumers
+        that dispatch async compute on zero-copy chunks; a no-op in copy
+        mode. Consumers that read chunks synchronously may skip it: the
+        generator auto-releases when the next chunk is requested."""
+        if (self._alias and self._streaming and chunk.fenced
+                and chunk.index > self._released_idx):
+            self._released_idx = chunk.index
+            self._release_q.put(token)
+
+    def stream(self, start_chunk: int = 0) -> Iterator[DeviceChunk]:
+        """Yield DeviceChunks in deterministic ascending order, chunk
+        k+1's staging overlapping chunk k's compute. ``start_chunk``
+        resumes mid-pass (chunks before it are read but not transferred).
+        Stats for the pass land in ``self.last_stats`` on close.
+
+        A new pass reuses the staging pool unfenced, so in zero-copy
+        mode all chunks of the previous pass must be fully consumed
+        before the next ``stream()`` begins — the streamed solver's
+        per-pass host read of (f, g) guarantees exactly that."""
+        if self._streaming:
+            raise RuntimeError("one active stream per ChunkLoader")
+        self._streaming = True
+        q: queue.Queue = queue.Queue(maxsize=self.config.num_buffers)
+        stop = threading.Event()
+        stats = StreamStats()
+        self._inflight = [None] * self.config.num_buffers
+        self._release_q = queue.Queue()
+        self._released_idx = -1
+        reader = threading.Thread(
+            target=self._produce, args=(q, stop, start_chunk, stats),
+            daemon=True, name="photon-stream-reader")
+        wall0 = time.perf_counter()
+        reader.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = q.get()
+                stats.consumer_stall_s += time.perf_counter() - t0
+                if isinstance(item, _ReaderError):
+                    raise item.error
+                if isinstance(item, _EndOfPass):
+                    self._num_chunks = item.num_chunks
+                    break
+                yield item
+                # consumer came back without releasing: it consumed the
+                # chunk synchronously, so its own arrays are the fence
+                self.release(item, item.batch)
+        finally:
+            stop.set()
+            while reader.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                reader.join(timeout=0.05)
+            stats.wall_s = time.perf_counter() - wall0
+            self.last_stats = stats
+            self._streaming = False
+            try:
+                from photon_tpu.obs.metrics import registry
+                registry.counter("stream.chunks").inc(stats.chunks)
+                if stats.rows_dropped:
+                    registry.counter("stream.rows_dropped").inc(
+                        stats.rows_dropped)
+            except Exception:   # hygiene-ok — telemetry is best-effort
+                pass

@@ -40,6 +40,47 @@ class DataValidationError(ValueError):
         super().__init__(f"input data failed validation: {violations}")
 
 
+def _scalar_rules(y: np.ndarray, task: TaskType, offsets, weights):
+    """(name, ok-mask) pairs for the row-local scalar rules. Shared by the
+    resident frame validator and the per-chunk streaming mask so the two
+    paths cannot drift apart (surviving-row chunk assignment depends on
+    both applying byte-identical rules)."""
+    yield "finite labels", np.isfinite(y)
+    if task == TaskType.POISSON_REGRESSION:
+        yield "non-negative labels (Poisson)", y >= 0
+    if task.is_classification:
+        yield "binary labels", (y == 0.0) | (y == 1.0)
+    if offsets is not None:
+        yield "finite offsets", np.isfinite(np.asarray(offsets, float))
+    if weights is not None:
+        w = np.asarray(weights, float)
+        yield "finite weights", np.isfinite(w)
+        yield "positive weights", w > 0
+
+
+def invalid_chunk_mask(labels, task: TaskType, offsets=None, weights=None,
+                       feature_values=None) -> np.ndarray:
+    """Row-local drop mask for ONE streaming chunk (True = invalid).
+
+    Applies exactly the rules ``validate_dataframe(...,
+    drop_invalid_rows=True)`` applies in VALIDATE_FULL mode — every rule
+    here is row-local, so filtering chunk-by-chunk keeps the surviving
+    rows (and therefore their chunk assignment after survivor packing)
+    identical to filtering the fully-resident dataset up front.
+
+    ``feature_values`` is whatever per-row value slab is finite-checkable:
+    a dense ``[rows, dim]`` block or a padded-ELL ``[rows, max_nnz]``
+    values array (pad slots are zero, hence finite)."""
+    y = np.asarray(labels, float)
+    bad = np.zeros(y.shape[0], bool)
+    for _name, ok in _scalar_rules(y, task, offsets, weights):
+        bad |= ~ok
+    if feature_values is not None:
+        vals = np.asarray(feature_values, float)
+        bad |= ~np.isfinite(vals).all(axis=tuple(range(1, vals.ndim)))
+    return bad
+
+
 def _row_mask(df: GameDataFrame, validation: DataValidationType) -> np.ndarray:
     n = df.num_samples
     if validation == DataValidationType.VALIDATE_SAMPLE:
@@ -80,17 +121,8 @@ def validate_dataframe(
             np.logical_or(bad_rows, bad, out=bad_rows)
 
     y = np.asarray(df.response, float)
-    check("finite labels", np.isfinite(y))
-    if task == TaskType.POISSON_REGRESSION:
-        check("non-negative labels (Poisson)", y >= 0)
-    if task.is_classification:
-        check("binary labels", (y == 0.0) | (y == 1.0))
-    if df.offsets is not None:
-        check("finite offsets", np.isfinite(np.asarray(df.offsets, float)))
-    if df.weights is not None:
-        w = np.asarray(df.weights, float)
-        check("finite weights", np.isfinite(w))
-        check("positive weights", w > 0)
+    for name, ok in _scalar_rules(y, task, df.offsets, df.weights):
+        check(name, ok)
 
     checked_rows = np.flatnonzero(mask)
     for sid, shard in df.feature_shards.items():

@@ -157,6 +157,38 @@ class GLMObjective:
         scaled = Hyper(l2_weight=hyper.l2_weight / num_shards)
         return self.value_and_gradient(coef, batch, scaled)
 
+    # -- streamed (chunk-accumulated) evaluation ----------------------------
+
+    @staticmethod
+    def init_stream_carry(dim: int, dtype) -> Tuple[Array, Array]:
+        """Device-resident accumulator for a chunked objective pass:
+        (value_acc scalar, grad_acc [dim]), both zero."""
+        return (jnp.zeros((), dtype=dtype), jnp.zeros((dim,), dtype=dtype))
+
+    def chunk_value_and_gradient(
+        self, carry: Tuple[Array, Array], coef: Array, batch: DataBatch
+    ) -> Tuple[Array, Array]:
+        """One streamed chunk's contribution to the DATA term, folded into
+        the carry. Pad rows carry weight 0 and contribute exactly nothing,
+        so the padded tail chunk needs no separate mask. The L2 term is
+        deliberately absent — it is per-pass, not per-chunk — and is added
+        once by ``finalize_streamed``. Summing this over a pass's chunks
+        reproduces the resident data term up to FP summation order."""
+        v, g = aggregators.value_and_gradient(
+            self.loss, batch.features, batch.labels, batch.offsets,
+            batch.weights, coef, self.norm,
+        )
+        return carry[0] + v, carry[1] + g
+
+    def finalize_streamed(
+        self, carry: Tuple[Array, Array], coef: Array, hyper: Hyper
+    ) -> Tuple[Array, Array]:
+        """Close a chunked pass: accumulated data term + the L2 mixin,
+        applied exactly once (same mixin as ``value_and_gradient``)."""
+        v, g = carry
+        return (v + 0.5 * hyper.l2_weight * jnp.dot(coef, coef),
+                g + hyper.l2_weight * coef)
+
     def directional_problem(
         self, batch: DataBatch, hyper: Hyper
     ) -> DirectionalProblem:

@@ -9,7 +9,11 @@ from photon_tpu.optim.base import (  # noqa: F401
     SolverConfig,
     SolverResult,
 )
-from photon_tpu.optim import lbfgs, newton, owlqn, tron  # noqa: F401
+from photon_tpu.optim import lbfgs, newton, owlqn, streaming, tron  # noqa: F401
+from photon_tpu.optim.streaming import (  # noqa: F401
+    StreamedProblem,
+    minimize_streamed,
+)
 from photon_tpu.types import OptimizerType
 
 

@@ -91,6 +91,16 @@ class HierConfig:
     tolerance: float = 1e-8
     num_corrections: int = 10
     linesearch_max_iterations: int = 25
+    # >1: each round's LOCAL solve reads only a 1/inner_chunks slice of
+    # the shard's rows (round-robin over rounds; data term scaled by
+    # inner_chunks to stay an unbiased estimate of the shard objective),
+    # so one round streams a fraction of the local data through compute —
+    # the mini-batch inner-step mode for out-of-core shards. The
+    # correction anchor v, the packed psum (f, g) and the safeguard all
+    # still use the FULL shard, so acceptance decisions are exact and the
+    # communication structure (one staged DCN psum per round) is
+    # unchanged.
+    inner_chunks: int = 1
 
 
 class HierResult(NamedTuple):
@@ -165,16 +175,24 @@ def build_round_fn(objective: GLMObjective, mesh,
     Exposed separately so tests and the bench can pin the communication
     structure statically: ``mesh.count_axis_psums(round_fn, DCN_AXIS,
     ...) == 1`` no matter how large ``local_iterations`` is.
+
+    With ``config.inner_chunks > 1`` the returned function takes a
+    LEADING traced ``chunk_idx`` argument selecting which local slice the
+    round's inner solve reads (``round_fn(chunk_idx, c, c_prev, g_prev,
+    mu, hyper, batch)``); the default keeps the classic arity.
     """
     sample_axes = _sample_axes(mesh)
     p_shards, replicas = _mesh_factors(mesh, sample_axes)
+    inner = int(config.inner_chunks)
+    if inner < 1:
+        raise ValueError(f"inner_chunks must be >= 1, got {inner}")
     local_cfg = SolverConfig(
         max_iterations=config.local_iterations,
         tolerance=config.tolerance,
         num_corrections=config.num_corrections,
         linesearch_max_iterations=config.linesearch_max_iterations)
 
-    def round_body(c, c_prev, g_prev, mu, hyper, batch):
+    def round_body(chunk_idx, c, c_prev, g_prev, mu, hyper, batch):
         d = c.shape[0]
         f0_raw, g0_raw = objective.local_value_and_gradient(
             c, batch, hyper, p_shards)
@@ -184,19 +202,45 @@ def build_round_fn(objective: GLMObjective, mesh,
             c_prev, batch, hyper, p_shards)
         v = g_prev / p_shards - gk_prev
 
-        def local_vg(ci):
-            f, g = objective.local_value_and_gradient(
-                ci, batch, hyper, p_shards)
-            dc = ci - c
-            f = f + jnp.dot(v, ci) + 0.5 * mu * jnp.dot(dc, dc)
-            g = g + v + mu * dc
-            return f, g
+        if inner > 1:
+            n_local = batch.labels.shape[0]
+            if n_local % inner != 0:
+                raise ValueError(
+                    f"inner_chunks={inner} must divide the per-shard row "
+                    f"count {n_local} (shard_batch pads to the shard "
+                    f"grid, not the chunk grid)")
+            cl = n_local // inner
+            sub = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, chunk_idx * cl, cl, axis=0), batch)
 
-        # F~_k(c) / grad F~_k(c) from the raw pair — the prox term and
-        # its gradient vanish at the anchor
-        res = lbfgs.minimize(
-            local_vg, c, config=local_cfg,
-            init_fg=(f0_raw + jnp.dot(v, c), g0_raw + v))
+            def local_vg(ci):
+                # 1/inner of the rows at inner x weight: same expectation
+                # as the full-shard term, with L2 still at l2/p_shards
+                f, g = objective.local_value_and_gradient(
+                    ci, sub, hyper, p_shards * inner)
+                dc = ci - c
+                f = inner * f + jnp.dot(v, ci) + 0.5 * mu * jnp.dot(dc, dc)
+                g = inner * g + v + mu * dc
+                return f, g
+
+            # no init_fg: the chunk objective at the anchor is NOT the
+            # full-shard f0_raw — let the solver evaluate its own start
+            res = lbfgs.minimize(local_vg, c, config=local_cfg)
+        else:
+            def local_vg(ci):
+                f, g = objective.local_value_and_gradient(
+                    ci, batch, hyper, p_shards)
+                dc = ci - c
+                f = f + jnp.dot(v, ci) + 0.5 * mu * jnp.dot(dc, dc)
+                g = g + v + mu * dc
+                return f, g
+
+            # F~_k(c) / grad F~_k(c) from the raw pair — the prox term
+            # and its gradient vanish at the anchor
+            res = lbfgs.minimize(
+                local_vg, c, config=local_cfg,
+                init_fg=(f0_raw + jnp.dot(v, c), g0_raw + v))
         delta = res.coef - c
         packed = _staged_all_psum(
             jnp.concatenate([delta, g0_raw, f0_raw[None]]), mesh)
@@ -204,20 +248,25 @@ def build_round_fn(objective: GLMObjective, mesh,
                 packed[d:2 * d] / replicas,
                 packed[2 * d] / replicas)
 
-    def make(c, c_prev, g_prev, mu, hyper, batch):
+    def make(chunk_idx, c, c_prev, g_prev, mu, hyper, batch):
         specs = _batch_specs(batch, sample_axes)
         # check_rep=False: the rep checker has no rule for the inner
         # L-BFGS while_loop; the all-axis psum above establishes the
         # P() output replication it would otherwise verify
         return M.shard_map(round_body, mesh=mesh,
-                           in_specs=(P(), P(), P(), P(),
+                           in_specs=(P(), P(), P(), P(), P(),
                                      jax.tree.map(lambda _: P(), hyper),
                                      specs),
                            out_specs=(P(), P(), P()),
-                           check_rep=False)(c, c_prev, g_prev, mu,
-                                            hyper, batch)
+                           check_rep=False)(chunk_idx, c, c_prev, g_prev,
+                                            mu, hyper, batch)
 
-    return jax.jit(make)
+    jitted = jax.jit(make)
+    if inner > 1:
+        return jitted
+    # classic arity: chunk_idx is meaningless at inner_chunks=1
+    return jax.jit(lambda c, c_prev, g_prev, mu, hyper, batch: jitted(
+        jnp.asarray(0, jnp.int32), c, c_prev, g_prev, mu, hyper, batch))
 
 
 def build_global_vg(objective: GLMObjective, mesh):
@@ -301,10 +350,19 @@ def minimize_hier(objective: GLMObjective, batch: DataBatch, hyper: Hyper,
     history = [f_best]
     converged = g0_norm <= gtol
 
+    inner = int(config.inner_chunks)
     while rounds < config.rounds and not converged:
         with pallas_glm.disabled():
-            avg_delta, g_c, f_c = round_fn(
-                c, c_prev, g_prev, jnp.asarray(mu, dtype), hyper, sharded)
+            if inner > 1:
+                # round-robin chunk cursor: traced, so every round reuses
+                # the one compiled program
+                avg_delta, g_c, f_c = round_fn(
+                    jnp.asarray(rounds % inner, jnp.int32), c, c_prev,
+                    g_prev, jnp.asarray(mu, dtype), hyper, sharded)
+            else:
+                avg_delta, g_c, f_c = round_fn(
+                    c, c_prev, g_prev, jnp.asarray(mu, dtype), hyper,
+                    sharded)
         rounds += 1
         dcn += 1
         hits.inc()

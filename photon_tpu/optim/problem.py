@@ -356,6 +356,62 @@ class GlmOptimizationProblem:
         model = GeneralizedLinearModel(Coefficients(coef), self.task)
         return model, result
 
+    def run_streamed(
+        self,
+        loader,
+        initial: Optional[Array] = None,
+        dim: Optional[int] = None,
+        dtype=None,
+        regularization_weight: Optional[float] = None,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every_chunks: int = 0,
+    ) -> Tuple[GeneralizedLinearModel, SolverResult]:
+        """Out-of-core solve: same contract as ``run`` but the data is a
+        ``data.streaming.ChunkLoader`` instead of a resident batch — the
+        objective is accumulated chunk-by-chunk with double-buffered
+        host->device transfer, so the dataset never needs to fit in HBM.
+
+        Only first-order solvers stream (LBFGS; OWLQN when the
+        regularization has an L1 part): second-order solvers would need a
+        streamed pass per Hessian application. The mesh (if any) comes
+        from the loader. ``checkpoint_path`` enables the chunk-cursor
+        checkpoint for bitwise mid-epoch resume after preemption."""
+        from photon_tpu.optim import streaming
+
+        opt = self.config.optimizer
+        if opt.optimizer_type not in (OptimizerType.LBFGS,
+                                      OptimizerType.OWLQN):
+            raise ValueError(
+                f"streamed training supports LBFGS/OWLQN only, not "
+                f"{opt.optimizer_type} (second-order solvers need a full "
+                f"pass per Hessian application)")
+        norm = self.objective.norm
+        if dtype is None:
+            dtype = loader.dtype
+        d = int(dim if dim is not None else loader.source.dim)
+        if initial is None:
+            initial = jnp.zeros((d,), dtype)
+        elif not norm.is_identity:
+            initial = norm.model_to_transformed_space(
+                jnp.asarray(initial), self.intercept_index)
+        lam = (self.config.regularization_weight
+               if regularization_weight is None else regularization_weight)
+        problem = streaming.StreamedProblem(
+            self.objective, loader,
+            l2_weight=self.config.regularization.l2_weight(lam),
+            dim=d, dtype=dtype)
+        result = streaming.minimize_streamed(
+            problem, jnp.asarray(initial, dtype),
+            config=opt.solver_config(),
+            l1_weight=self.config.regularization.l1_weight(lam),
+            checkpoint_path=checkpoint_path,
+            checkpoint_every_chunks=checkpoint_every_chunks)
+        coef = result.coef
+        if not norm.is_identity:
+            coef = norm.transformed_space_to_model(coef, self.intercept_index)
+        model = GeneralizedLinearModel(Coefficients(coef), self.task)
+        return model, result
+
     # -- variances (reference: DistributedOptimizationProblem:82-100) -------
 
     @functools.cached_property

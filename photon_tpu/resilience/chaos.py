@@ -38,6 +38,21 @@ hook points consult it:
 - ``corrupt_cold_store(path, seed)`` — deterministic cold-file
   corruption helper: flips one payload byte (chosen by seed) so the
   cold store's crc32 footer check must refuse the file.
+- ``chunk_read_delay()`` — data/streaming's reader thread asks before
+  each raw chunk read; returns seconds to sleep for the first
+  ``slow_chunk_reads`` reads, simulating a slow disk / page-faulting
+  host source. The consumer must keep computing on already-staged
+  chunks while the reader lags (overlap, not stall).
+- ``chunk_read_error()`` — same reader thread, same hook point as
+  ``before_io`` but budgeted separately so a streaming test can fail
+  chunk reads without touching checkpoint I/O: raises ``ChaosIOError``
+  for the first ``chunk_read_errors`` reads, then succeeds (the
+  resilience/retry budget applies).
+- ``should_kill_stream(pass_idx, chunk_idx)`` — the streamed solver's
+  per-chunk checkpoint hook asks after accumulating each chunk; a hit
+  at the configured ``stream_kill_at`` writes the chunk-cursor
+  checkpoint and raises ``SimulatedKill`` (fires once), the mid-epoch
+  preemption the bitwise-resume test replays.
 - ``should_poison_publish_row()`` — nearline/publisher.py asks while
   building the final commit payload (AFTER the gate ladder has passed);
   a hit NaN-poisons one published row so the post-apply readback verify
@@ -108,6 +123,16 @@ class ChaosConfig:
     # payload AFTER the gate ladder passed — the post-apply readback
     # verify must catch it and roll the published rows back (fires once)
     publish_poison_row: bool = False
+    # streaming loader: seconds of artificial raw-chunk-read latency,
+    # applied to the first slow_chunk_reads reads (then off)
+    slow_chunk_read_s: float = 0.0
+    slow_chunk_reads: int = 0
+    # streaming loader: number of transient chunk-read errors to inject
+    # (ChaosIOError; the reader retries under the resilience/retry budget)
+    chunk_read_errors: int = 0
+    # streamed solver: (pass index, chunk index) after whose accumulation
+    # the consumer checkpoints its chunk cursor and dies (fires once)
+    stream_kill_at: Optional[Tuple[int, int]] = None
 
 
 class _State:
@@ -123,6 +148,9 @@ class _State:
         self.straggler_fired = False
         self.cold_read_delays_done = 0
         self.publish_poison_fired = False
+        self.chunk_read_delays_done = 0
+        self.chunk_read_errors_done = 0
+        self.stream_kill_fired = False
 
 
 _active: Optional[_State] = None
@@ -236,6 +264,55 @@ def cold_read_delay() -> float:
             return 0.0
         s.cold_read_delays_done += 1
     return s.config.cold_read_delay_s
+
+
+def chunk_read_delay() -> float:
+    """Seconds of injected raw-chunk-read latency for this read (0 when
+    inactive or the read budget is spent). Applied on the streaming
+    loader's reader thread only — a correctly overlapped consumer keeps
+    computing on already-staged chunks while the reader sleeps."""
+    s = _active
+    if s is None or s.config.slow_chunk_read_s <= 0:
+        return 0.0
+    with s.lock:
+        if s.chunk_read_delays_done >= s.config.slow_chunk_reads:
+            return 0.0
+        s.chunk_read_delays_done += 1
+    return s.config.slow_chunk_read_s
+
+
+def chunk_read_error() -> None:
+    """Raise ``ChaosIOError`` for the first ``chunk_read_errors`` raw
+    chunk reads, then succeed. Budgeted separately from ``before_io`` so
+    a streaming test can fail data reads without also failing the
+    checkpoint writes that share the retry machinery."""
+    s = _active
+    if s is None or s.config.chunk_read_errors <= 0:
+        return
+    with s.lock:
+        if s.chunk_read_errors_done >= s.config.chunk_read_errors:
+            return
+        s.chunk_read_errors_done += 1
+        n = s.chunk_read_errors_done
+    raise ChaosIOError(f"chaos: injected transient chunk-read error #{n}")
+
+
+def should_kill_stream(pass_idx: int, chunk_idx: int) -> bool:
+    """True exactly once when the streamed solver finishes accumulating
+    chunk ``chunk_idx`` of evaluation pass ``pass_idx`` and the installed
+    config names that point — the caller writes its chunk-cursor
+    checkpoint and raises ``SimulatedKill``, the mid-epoch preemption the
+    bitwise-resume test replays."""
+    s = _active
+    if s is None or s.config.stream_kill_at is None:
+        return False
+    with s.lock:
+        if s.stream_kill_fired:
+            return False
+        if s.config.stream_kill_at != (pass_idx, chunk_idx):
+            return False
+        s.stream_kill_fired = True
+    return True
 
 
 def corrupt_cold_store(path: str, seed: int = 0) -> int:

@@ -54,6 +54,21 @@ _PEAK_HBM_BW_BY_KIND = (
 _CPU_FALLBACK_BW = 5e10       # nominal DRAM figure; flags not-a-TPU
 _UNKNOWN_TPU_BW = 1228e9      # v4 figure for unrecognized TPU kinds
 
+# peak host->device (PCIe/ICI-attached host DMA) bandwidth per chip
+# (bytes/s), same matching. These normalize the streaming pipeline's
+# transfer-bandwidth gauge, so what matters is the ORDER — is the
+# pipeline within a small factor of the interconnect — not the digit.
+_PEAK_H2D_BW_BY_KIND = (
+    ("v6", 64e9),
+    ("v5p", 64e9),
+    ("v5", 32e9),
+    ("v4", 32e9),
+    ("v3", 16e9),
+    ("v2", 16e9),
+)
+_CPU_FALLBACK_H2D = 10e9      # host memcpy figure; flags not-a-TPU
+_UNKNOWN_TPU_H2D = 32e9       # v4 figure for unrecognized TPU kinds
+
 
 def peak_flops(device) -> tuple:
     """(peak_flops, label) for a jax device; CPU gets a nominal figure."""
@@ -79,6 +94,69 @@ def peak_hbm_bw(device) -> tuple:
     if getattr(device, "platform", "") in ("tpu", "axon"):
         return _UNKNOWN_TPU_BW, kind or "tpu-unknown(v4 assumed)"
     return _CPU_FALLBACK_BW, kind or "cpu"
+
+
+def peak_h2d_bw(device) -> tuple:
+    """(peak host->device bytes/s, label) for a jax device; CPU gets a
+    nominal figure so the gauge is computable (and obviously labelled as
+    not a TPU measurement)."""
+    kind = getattr(device, "device_kind", "") or ""
+    low = kind.lower()
+    for marker, bw in _PEAK_H2D_BW_BY_KIND:
+        if marker in low:
+            return bw, kind
+    if getattr(device, "platform", "") in ("tpu", "axon"):
+        return _UNKNOWN_TPU_H2D, kind or "tpu-unknown(v4 assumed)"
+    return _CPU_FALLBACK_H2D, kind or "cpu"
+
+
+def stream_overlap_utilization(reader_busy_s: float, consumer_stall_s: float,
+                               wall_s: float, bytes_h2d: int,
+                               device=None, phase: str = "stream") -> dict:
+    """Transfer-vs-compute overlap efficiency of a streamed pass.
+
+    The double-buffered pipeline's whole point is that chunk k+1's
+    read+pack+transfer happens WHILE chunk k computes. The reader thread
+    was busy ``reader_busy_s``; of that, the only part the consumer ever
+    saw was its own stalls waiting on the queue (``consumer_stall_s``) —
+    everything else was hidden behind compute:
+
+        hidden_s             = max(reader_busy_s - consumer_stall_s, 0)
+        overlap_efficiency   = hidden_s / reader_busy_s    (1.0 = fully
+                               hidden; 0.0 = fully serialized)
+
+    ``h2d_bw_util`` is the achieved host->device byte rate over the pass
+    against the chip's nominal transfer peak. Both land as gauges
+    (``perf.stream_overlap`` / ``perf.h2d_bw_util``) so every RunReport
+    snapshot carries them, and the returned dict goes into bench records.
+    """
+    import jax
+
+    from photon_tpu.obs.metrics import registry
+
+    if device is None:
+        device = jax.devices()[0]
+    peak_bw, kind = peak_h2d_bw(device)
+    wall_s = max(float(wall_s), 1e-12)
+    reader_busy_s = max(float(reader_busy_s), 0.0)
+    hidden_s = max(reader_busy_s - max(float(consumer_stall_s), 0.0), 0.0)
+    # a reader that was never meaningfully busy hid everything there was
+    overlap = hidden_s / reader_busy_s if reader_busy_s > 1e-9 else 1.0
+    h2d_util = bytes_h2d / wall_s / peak_bw
+    registry.gauge("perf.stream_overlap", phase=phase).set(overlap)
+    registry.gauge("perf.h2d_bw_util", phase=phase).set(h2d_util)
+    return {
+        "phase": phase,
+        "device_kind": kind,
+        "reader_busy_s": float(reader_busy_s),
+        "consumer_stall_s": float(consumer_stall_s),
+        "hidden_s": float(hidden_s),
+        "wall_s": float(wall_s),
+        "bytes_h2d": int(bytes_h2d),
+        "overlap_efficiency": float(overlap),
+        "h2d_bw_utilization": float(h2d_util),
+        "peak_h2d_bw": float(peak_bw),
+    }
 
 
 def _nnz_slots(features) -> int:

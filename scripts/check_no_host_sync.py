@@ -47,6 +47,14 @@ SCAN_DIRS = (
     # every in-flight micro-batch behind it
     os.path.join(REPO, "photon_tpu", "serving", "scorer.py"),
     os.path.join(REPO, "photon_tpu", "serving", "coeff_store.py"),
+    # streamed-training chunk loop: the objective partials (function/)
+    # and the double-buffered loader — a blocking transfer inside the
+    # chunk-accumulation loop serializes transfer behind compute and
+    # erases the pipeline's overlap (optim/streaming.py is covered by the
+    # optim/ walk; the loader's only block_until_ready is the reader
+    # thread's buffer-recycle fence, which is marked)
+    os.path.join(REPO, "photon_tpu", "function"),
+    os.path.join(REPO, "photon_tpu", "data", "streaming.py"),
 )
 MARKER = "host-sync-ok"
 
@@ -145,7 +153,8 @@ def main() -> int:
             print(f"  {v}")
         return 1
     print("ok: no host-sync primitives in photon_tpu/optim, "
-          "photon_tpu/game, or the serving hot path")
+          "photon_tpu/game, photon_tpu/function, the streaming chunk "
+          "loop, or the serving hot path")
     return 0
 
 
