@@ -3094,6 +3094,511 @@ def run_stream_bench(scale: float, quick: bool = False):
     return rec
 
 
+# --------------------------------------------------------------------------
+# fleet mode: --mode fleet -> BENCH_FLEET_r01.json
+# --------------------------------------------------------------------------
+
+#: fleet bench geometry shared by the parent and the per-shard child
+#: processes (the child rebuilds identical traffic from the same seed)
+_FLEET_SEED = 13
+_FLEET_NNZ = 16
+
+
+def _fleet_row_ids(rows):
+    """Row index array -> the bench's entity-id byte strings
+    (b'e000000042' style, the exact ids written into the cold store)."""
+    return np.char.add(b"e", np.char.zfill(
+        np.asarray(rows).astype("S9"), 9))
+
+
+def _fleet_stream(num_shards, per_shard, E):
+    """The deterministic global Zipf request stream for one shard count:
+    row indices + owning shard per request (canonical partitioner over
+    the REAL entity-id strings, exactly what the router hashes)."""
+    from photon_tpu.parallel.partition import entity_shards
+
+    rng = np.random.default_rng(_FLEET_SEED)
+    n_total = int(num_shards * per_shard * 1.35) + 64
+    rows = (rng.zipf(1.5, size=n_total) - 1) % E
+    owners = entity_shards(_fleet_row_ids(rows), num_shards)
+    return rows, owners
+
+
+def _fleet_shard_engine(store_path, d_global, hot_capacity, transfer_batch,
+                        theta=None):
+    """One fleet serving engine over one (shard) cold store. RE-only
+    (``theta=None``) is the deployed shard shape — fixed effects live at
+    the router; pass ``theta`` for the single-host full-model baseline."""
+    from photon_tpu.io.index_map import IndexMap, feature_key
+    from photon_tpu.io.model_io import (
+        ServingFixedEffect,
+        ServingGameModel,
+        ServingRandomEffect,
+    )
+    from photon_tpu.serving import (
+        CoeffStoreConfig,
+        DeviceResidentModel,
+        ServingConfig,
+        ServingEngine,
+    )
+    from photon_tpu.types import TaskType
+
+    names = [f"g{j}" for j in range(d_global)]
+    imap = IndexMap({feature_key(n, ""): i for i, n in enumerate(names)})
+    re = ServingRandomEffect("per_user", "userId", "g",
+                             cold_store_path=store_path)
+    cs = CoeffStoreConfig(hot_capacity=hot_capacity,
+                          transfer_batch=transfer_batch)
+    fixed = ([ServingFixedEffect("fixed", "g", theta)]
+             if theta is not None else [])
+    m = ServingGameModel(TaskType.LINEAR_REGRESSION, fixed, [re],
+                         {"g": imap}, {})
+    model = DeviceResidentModel(m, coeff_store=cs)
+    return ServingEngine(model, ServingConfig(
+        max_batch=64, max_wait_s=0.001, coeff_store=cs)), names
+
+
+def _fleet_measure_shard(engine, names, d_global, rows, feat_seed,
+                         n_warm, n_steady, n_probe):
+    """Warm + steady + probe one shard engine over ITS routed rows.
+    Returns qps / p99 / hit-rate / the three compile monitors' verdict —
+    the per-shard record both the in-process arm and the child processes
+    emit."""
+    from photon_tpu.obs.metrics import registry as _registry
+    from photon_tpu.serving import ScoreRequest
+    from photon_tpu.serving.scorer import get_scorer, serving_modes
+    from photon_tpu.utils import compile_cache
+
+    rng = np.random.default_rng(feat_seed)
+
+    def make_request(i, row):
+        cols = rng.choice(d_global, size=_FLEET_NNZ, replace=False)
+        return ScoreRequest(
+            f"q{i}", {"g": [(names[c], "", float(rng.normal()))
+                            for c in cols]},
+            {"userId": f"e{row:09d}"})
+
+    need = n_warm + n_steady + n_probe
+    rows = list(rows[:need])
+    if len(rows) < need:                    # tiny quick shapes: recycle
+        rows = (rows * (need // max(len(rows), 1) + 1))[:need]
+
+    for i in range(n_warm):
+        engine.submit(make_request(i, rows[i]))
+        if i % 256 == 255:
+            engine.pump()
+    engine.drain()
+    engine.model.drain_prefetch()
+    store_stats = lambda: next(iter(
+        engine.model.coeff_store_stats().values()))
+    st0 = store_stats()
+
+    programs = [get_scorer(engine.model, mode, b)
+                for mode in serving_modes(engine.model)
+                for b in engine.ladder.buckets]
+    jitted = [p if hasattr(p, "_cache_size")
+              else getattr(p, "__wrapped__", p) for p in programs]
+    jitted = [f for f in jitted if hasattr(f, "_cache_size")]
+    compiles0 = compile_cache.compile_counts()["steady_state"]
+    misses0 = _registry.counter("jitcache.misses").value
+    traces0 = [f._cache_size() for f in jitted]
+
+    t0 = time.perf_counter()
+    done = 0
+    for i in range(n_steady):
+        engine.submit(make_request(n_warm + i, rows[n_warm + i]))
+        done += len(engine.pump())
+        if i % 1024 == 1023:
+            engine.model.drain_prefetch()
+    done += len(engine.drain())
+    steady_s = time.perf_counter() - t0
+    engine.model.drain_prefetch()
+
+    zero_compiles = (
+        compile_cache.compile_counts()["steady_state"] == compiles0
+        and _registry.counter("jitcache.misses").value == misses0
+        and all(t1 <= t for t, t1 in zip(traces0,
+                                         [f._cache_size() for f in jitted])))
+    st = store_stats()
+    lookups = (st["hits"] - st0["hits"]) + (st["cold_misses"]
+                                            - st0["cold_misses"])
+    lat = []
+    for i in range(n_probe):
+        r = make_request(10_000_000 + i, rows[n_warm + n_steady + i])
+        t = time.perf_counter()
+        engine.serve([r])
+        lat.append(time.perf_counter() - t)
+    return {
+        "requests": done,
+        "steady_seconds": round(steady_s, 4),
+        "qps": round(done / max(steady_s, 1e-9), 1),
+        "p50_s": round(float(np.percentile(lat, 50)), 6),
+        "p99_s": round(float(np.percentile(lat, 99)), 6),
+        "hot_hit_rate": round((st["hits"] - st0["hits"])
+                              / max(lookups, 1), 4),
+        "zero_steady_state_compiles": bool(zero_compiles),
+    }
+
+
+def _fleet_shard_child():
+    """One fleet shard measured in its OWN process (``bench.py
+    --fleet-shard-child cfg.json``): build the RE-only engine over the
+    shard's split cold store, rebuild the deterministic global traffic,
+    serve the rows this shard owns, report the per-shard record on
+    stdout. The parent runs one of these per shard — process isolation
+    per the fleet deployment model; on this one-core host they are
+    time-sliced, so aggregate qps is the sum of per-shard rates."""
+    cfg_path = sys.argv[sys.argv.index("--fleet-shard-child") + 1]
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    sid = cfg["shard_id"]
+    rows, owners = _fleet_stream(cfg["num_shards"], cfg["per_shard"],
+                                 cfg["entities"])
+    engine, names = _fleet_shard_engine(
+        cfg["store_path"], cfg["d_global"], cfg["hot_capacity"],
+        cfg["transfer_batch"])
+    engine.warmup()
+    rec = _fleet_measure_shard(
+        engine, names, cfg["d_global"], rows[owners == sid],
+        feat_seed=_FLEET_SEED + 1000 + sid, n_warm=cfg["n_warm"],
+        n_steady=cfg["n_steady"], n_probe=cfg["n_probe"])
+    rec["shard_id"] = sid
+    engine.shutdown()
+    print("FLEET_SHARD_RESULT " + json.dumps(rec), flush=True)
+
+
+def run_fleet_bench(scale: float, quick: bool = False):
+    """Entity-sharded serving fleet benchmark (ISSUE 12): split a
+    100M-entity random-effect cold store across N per-shard stores by
+    the canonical partitioner, measure per-shard serving throughput for
+    shard counts {1, 2, 4, 8, 16}, and record the aggregate-qps scaling
+    curve against the single-host full-model baseline (target >=10x at
+    16 shards). The 16-shard arm runs one OS process per shard
+    (``--fleet-shard-child``); this host has one core, so shard
+    processes are time-sliced and aggregate qps is the sum of isolated
+    per-shard rates — the fleet deployment model is one shard per host,
+    and per-shard isolation is exactly what the sum assumes. A final
+    kill-one-shard segment drives the in-process `ShardedServingFleet`
+    router under ``chaos.shard_kill`` and records typed
+    SHARD_UNAVAILABLE degradation plus surviving-shard qps vs pre-kill.
+
+    ``quick`` is the tier-1 smoke shape: 2 shards, 20k entities, no
+    child processes, no artifact write."""
+    import shutil as _sh
+    import subprocess
+    import tempfile
+
+    import jax
+
+    from photon_tpu.io.cold_store import (
+        COLD_STORE_DIR,
+        cold_store_path,
+        write_cold_store,
+    )
+    from photon_tpu.io.fleet_store import (
+        build_fleet_dir,
+        read_fleet_manifest,
+        shard_store_path,
+    )
+    from photon_tpu.io.index_map import IndexMap, feature_key
+    from photon_tpu.io.model_io import (
+        ServingFixedEffect,
+        ServingGameModel,
+        ServingRandomEffect,
+    )
+    from photon_tpu.resilience import chaos
+    from photon_tpu.serving import (
+        CoeffStoreConfig,
+        DeviceResidentModel,
+        FallbackReason,
+        FleetConfig,
+        LocalShardClient,
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+        ShardedServingFleet,
+    )
+    from photon_tpu.types import TaskType
+
+    if quick:
+        E, K, d_global = 20_000, 2, 32
+        shard_counts = (1, 2)
+        child_counts = ()
+        hot_capacity, transfer_batch = 512, 64
+        n_warm, n_steady, n_probe = 250, 400, 30
+        kill_batches = 20
+    else:
+        E, K, d_global = int(100_000_000 * scale) or 1000, 2, 64
+        shard_counts = (1, 2, 4, 8, 16)
+        child_counts = (16,)
+        hot_capacity, transfer_batch = 65_536, 1024
+        n_warm, n_steady, n_probe = 2_500, 5_000, 60
+        kill_batches = 120
+    rng = np.random.default_rng(_FLEET_SEED)
+
+    # -- source cold store under a model-dir layout -----------------------
+    t0 = time.perf_counter()
+    ids = _fleet_row_ids(np.arange(E))
+    coef = rng.normal(size=(E, K)).astype(np.float32)
+    lo = rng.integers(0, d_global - 1, size=E)
+    hi = rng.integers(lo + 1, d_global)
+    proj = np.stack([lo, hi], axis=1).astype(np.int32)
+    theta = rng.normal(size=d_global).astype(np.float32)
+    tdir = tempfile.mkdtemp(prefix="fleet_bench_")
+    model_dir = os.path.join(tdir, "model")
+    os.makedirs(os.path.join(model_dir, COLD_STORE_DIR))
+    src_path = cold_store_path(model_dir, "per_user")
+    write_cold_store(src_path, "per_user", "userId", "g", coef, proj, ids)
+    del coef, proj, lo, hi
+    gen_s = time.perf_counter() - t0
+    cold_bytes = os.path.getsize(src_path)
+    log(f"fleet: {E} entities, source cold store "
+        f"{cold_bytes / 1e6:.0f}MB in {gen_s:.1f}s")
+
+    # -- split into per-shard stores + crc'd manifests --------------------
+    fleet_dirs, split_seconds, manifests = {}, {}, {}
+    for n in shard_counts:
+        if n == 1:
+            continue  # 1 shard == the unsplit store (crc%1 == 0 for all)
+        fdir = os.path.join(tdir, f"fleet{n}")
+        t0 = time.perf_counter()
+        build_fleet_dir(model_dir, fdir, n)
+        split_seconds[n] = round(time.perf_counter() - t0, 1)
+        manifests[n] = read_fleet_manifest(fdir)   # crc round-trip
+        fleet_dirs[n] = fdir
+        log(f"fleet: split into {n} shards in {split_seconds[n]}s, "
+            f"manifest v{manifests[n]['version']} verified")
+
+    def shard_store(n, s):
+        return src_path if n == 1 else shard_store_path(
+            fleet_dirs[n], s, "per_user")
+
+    # -- single-host full-model baseline (fixed + RE in one engine) -------
+    single, names = _fleet_shard_engine(src_path, d_global, hot_capacity,
+                                        transfer_batch, theta=theta)
+    single.warmup()
+    rows1, _ = _fleet_stream(1, n_warm + n_steady + n_probe, E)
+    single_rec = _fleet_measure_shard(
+        single, names, d_global, rows1, feat_seed=_FLEET_SEED + 99,
+        n_warm=n_warm, n_steady=n_steady, n_probe=n_probe)
+    single.shutdown()
+    log(f"fleet: single-host baseline {single_rec['qps']} qps, "
+        f"p99 {single_rec['p99_s'] * 1e3:.2f}ms")
+
+    # -- per-shard measurement across the shard-count curve ---------------
+    per_shard = int(n_warm + n_steady + n_probe)
+    curve = {}
+    for n in shard_counts:
+        rows, owners = _fleet_stream(n, per_shard, E)
+        shards = []
+        if n in child_counts:
+            # one OS process per shard: boot, warm, serve owned traffic
+            for s in range(n):
+                cfg = {"shard_id": s, "num_shards": n, "entities": E,
+                       "per_shard": per_shard, "d_global": d_global,
+                       "store_path": shard_store(n, s),
+                       "hot_capacity": hot_capacity,
+                       "transfer_batch": transfer_batch,
+                       "n_warm": n_warm, "n_steady": n_steady,
+                       "n_probe": n_probe}
+                cfg_path = os.path.join(tdir, f"shard_{n}_{s}.json")
+                with open(cfg_path, "w") as f:
+                    json.dump(cfg, f)
+                out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--fleet-shard-child", cfg_path],
+                    capture_output=True, text=True, timeout=900,
+                    env={**os.environ, "JAX_PLATFORMS":
+                          os.environ.get("JAX_PLATFORMS", "cpu")})
+                rec = None
+                for line in out.stdout.splitlines():
+                    if line.startswith("FLEET_SHARD_RESULT "):
+                        rec = json.loads(line.split(" ", 1)[1])
+                if rec is None:
+                    raise RuntimeError(
+                        f"fleet shard child {s}/{n} failed: "
+                        f"{out.stderr[-2000:]}")
+                shards.append(rec)
+                log(f"fleet: n={n} shard {s} (process) "
+                    f"{rec['qps']} qps")
+        else:
+            for s in range(n):
+                eng, _ = _fleet_shard_engine(
+                    shard_store(n, s), d_global, hot_capacity,
+                    transfer_batch)
+                eng.warmup()
+                rec = _fleet_measure_shard(
+                    eng, names, d_global, rows[owners == s],
+                    feat_seed=_FLEET_SEED + 1000 + s, n_warm=n_warm,
+                    n_steady=n_steady, n_probe=n_probe)
+                rec["shard_id"] = s
+                eng.shutdown()
+                shards.append(rec)
+        agg = round(sum(r["qps"] for r in shards), 1)
+        curve[n] = {
+            "aggregate_qps": agg,
+            "per_shard_qps": [r["qps"] for r in shards],
+            "per_shard_p99_s": [r["p99_s"] for r in shards],
+            "per_shard_hot_hit_rate": [r["hot_hit_rate"] for r in shards],
+            "zero_steady_state_compiles_all_shards":
+                all(r["zero_steady_state_compiles"] for r in shards),
+            "shard_processes": n in child_counts,
+        }
+        log(f"fleet: {n} shard(s) -> aggregate {agg} qps "
+            f"(x{agg / max(single_rec['qps'], 1e-9):.1f} single-host)")
+
+    max_n = shard_counts[-1]
+    speedup = curve[max_n]["aggregate_qps"] / max(single_rec["qps"], 1e-9)
+
+    # -- kill-one-shard segment through the fleet router ------------------
+    kill_n = 16 if 16 in fleet_dirs else max(fleet_dirs or {2: None})
+    imap = IndexMap({feature_key(f"g{j}", ""): j
+                     for j in range(d_global)})
+    cs = CoeffStoreConfig(hot_capacity=hot_capacity,
+                          transfer_batch=transfer_batch)
+    serving_cfg = ServingConfig(max_batch=64, max_wait_s=0.001,
+                                coeff_store=cs)
+    front = ServingEngine(
+        DeviceResidentModel(ServingGameModel(
+            TaskType.LINEAR_REGRESSION,
+            [ServingFixedEffect("fixed", "g", theta)], [],
+            {"g": imap}, {})),
+        ServingConfig(max_batch=64, max_wait_s=0.001))
+    clients = []
+    for s in range(kill_n):
+        m = ServingGameModel(
+            TaskType.LINEAR_REGRESSION, [],
+            [ServingRandomEffect("per_user", "userId", "g",
+                                 cold_store_path=shard_store(kill_n, s))],
+            {"g": imap}, {})
+        clients.append(LocalShardClient(s, ServingEngine(
+            DeviceResidentModel(m, coeff_store=cs), serving_cfg)))
+    fleet = ShardedServingFleet(front, clients, [("per_user", "userId")],
+                                FleetConfig(serving=serving_cfg))
+    fleet.warmup()
+
+    frng = np.random.default_rng(_FLEET_SEED + 7)
+    krows = (frng.zipf(1.5, size=2 * kill_batches * 64) - 1) % E
+
+    def fleet_batch(base):
+        reqs = []
+        for i in range(64):
+            cols = frng.choice(d_global, size=_FLEET_NNZ, replace=False)
+            row = krows[(base + i) % len(krows)]
+            reqs.append(ScoreRequest(
+                f"k{base + i}", {"g": [(names[c], "", float(frng.normal()))
+                                       for c in cols]},
+                {"userId": f"e{row:09d}"}))
+        return reqs
+
+    # Kill-check protocol: on this one-core host a killed shard FREES
+    # cpu, so capacity-limited survivors would speed up — an artifact.
+    # The fleet question is "do survivors keep serving the same offered
+    # load", so both segments replay IDENTICAL entity traffic at a fixed
+    # paced rate; the survivor ratio then isolates real degradation.
+    warm_t = []
+    for b in range(kill_batches):     # promotion pass: kill rows -> hot
+        t0 = time.perf_counter()
+        fleet.serve(fleet_batch(b * 64))
+        warm_t.append(time.perf_counter() - t0)
+    interval = 1.25 * float(np.median(warm_t[kill_batches // 2:]))
+    # Floor: keep each paced segment >= ~1.5s of wall so a single
+    # scheduler stall cannot move the wall-clock qps ratio.
+    interval = max(interval, 1.5 / kill_batches)
+
+    def kill_segment():
+        before = {c.shard_id: fleet._stats[c.shard_id].requests
+                  for c in fleet.clients}
+        degraded = 0
+        t_start = time.perf_counter()
+        t_next = t_start
+        for b in range(kill_batches):
+            for resp in fleet.serve(fleet_batch(b * 64)):
+                if resp.score is None:
+                    raise RuntimeError("fleet dropped a score during "
+                                       "the kill segment")
+                if any(f.reason == FallbackReason.SHARD_UNAVAILABLE
+                       for f in resp.fallbacks):
+                    degraded += 1
+            t_next += interval
+            now = time.perf_counter()
+            if now < t_next:
+                time.sleep(t_next - now)
+        seg_s = time.perf_counter() - t_start
+        qps = {c.shard_id:
+               (fleet._stats[c.shard_id].requests - before[c.shard_id])
+               / max(seg_s, 1e-9) for c in fleet.clients}
+        return qps, degraded, seg_s
+
+    pre_qps, pre_degraded, pre_s = kill_segment()
+    victim = kill_n // 2
+    with chaos.active(chaos.ChaosConfig(shard_kill_id=victim)):
+        post_qps, post_degraded, post_s = kill_segment()
+    survivors = [s for s in pre_qps if s != victim and pre_qps[s] > 0]
+    ratios = [post_qps[s] / pre_qps[s] for s in survivors]
+    survivors_ok = bool(ratios) and all(abs(r - 1.0) <= 0.10
+                                        for r in ratios)
+    kill_stats = fleet.stats()
+    fleet.shutdown()
+    log(f"fleet: kill shard {victim}/{kill_n}: {post_degraded} typed "
+        f"SHARD_UNAVAILABLE, survivor qps ratios "
+        f"{[round(r, 3) for r in ratios][:6]}..., within 10%: "
+        f"{survivors_ok}")
+
+    rec = {
+        "metric": "fleet_aggregate_qps_speedup",
+        "value": round(speedup, 2),
+        "unit": "x_single_host",
+        "speedup_target": 10.0,
+        "entities": E,
+        "slot_width": K,
+        "cold_store_bytes": cold_bytes,
+        "shard_counts": list(shard_counts),
+        "single_host": single_rec,
+        "scaling_curve": {str(n): curve[n] for n in shard_counts},
+        "split_seconds": {str(n): split_seconds[n] for n in split_seconds},
+        "partitioner": "crc32-utf8-mod",
+        "manifest_verified": all(
+            m["num_shards"] == n for n, m in manifests.items()),
+        "hot_capacity_per_shard": hot_capacity,
+        "measurement_note": (
+            "one-core host: shard processes are time-sliced; each shard "
+            "is measured in isolation over the traffic it owns and "
+            "aggregate qps is the sum, matching the one-shard-per-host "
+            "deployment model"),
+        "kill_one_shard": {
+            "num_shards": kill_n,
+            "victim": victim,
+            "typed_shard_unavailable": post_degraded,
+            "pre_kill_degraded": pre_degraded,
+            "pre_kill_segment_s": round(pre_s, 3),
+            "post_kill_segment_s": round(post_s, 3),
+            "survivor_qps_ratio_min": round(min(ratios), 4) if ratios
+                else None,
+            "survivor_qps_ratio_max": round(max(ratios), 4) if ratios
+                else None,
+            "survivors_within_10pct": survivors_ok,
+            "router_unavailable_counter": kill_stats["merged"]["counters"]
+                ["fleet.shard.unavailable"],
+        },
+        "generation_seconds": round(gen_s, 3),
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "tpu_unavailable": _STATE["tpu_unavailable"],
+        "quick": quick,
+    }
+    _sh.rmtree(tdir, ignore_errors=True)
+    if not quick:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_FLEET_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"fleet: aggregate speedup x{speedup:.1f} at {max_n} shards "
+        f"(target >=10), kill-one-shard survivors within 10%: "
+        f"{survivors_ok}")
+    return rec
+
+
 # Order = on-chip capture priority (each config emits its JSON line the
 # moment it completes, so when the flaky relay dies mid-run the most
 # decision-relevant numbers are already on disk): the NEWTON flagship,
@@ -3119,6 +3624,9 @@ def main():
     if "--hier-child" in sys.argv:
         _hier_child()
         return
+    if "--fleet-shard-child" in sys.argv:
+        _fleet_shard_child()
+        return
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float,
                     default=float(os.environ.get("BENCH_SCALE", "1.0")))
@@ -3126,7 +3634,7 @@ def main():
                     help="comma-separated subset of config names")
     ap.add_argument("--mode", default=os.environ.get("BENCH_MODE", "train"),
                     choices=("train", "serving", "game_cd", "coldtier",
-                             "nearline", "hier", "fused", "stream"),
+                             "nearline", "hier", "fused", "stream", "fleet"),
                     help="train = the solver configs (default); serving = "
                          "the online-serving bench -> BENCH_SERVING_r01.json; "
                          "game_cd = parallel-vs-sequential CD sweeps "
@@ -3140,9 +3648,11 @@ def main():
                          "sparse/serving/int8 coverage "
                          "-> BENCH_FUSED_r01.json; stream = out-of-core "
                          "streamed vs resident training "
-                         "-> BENCH_STREAM_r01.json")
+                         "-> BENCH_STREAM_r01.json; fleet = entity-sharded "
+                         "serving fleet aggregate-qps scaling "
+                         "-> BENCH_FLEET_r01.json")
     ap.add_argument("--quick", action="store_true",
-                    help="game_cd/coldtier/nearline/hier/fused/stream: "
+                    help="game_cd/coldtier/nearline/hier/fused/stream/fleet: "
                          "tiny tier-1 smoke shape (no artifact write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
@@ -3202,6 +3712,21 @@ def main():
             emit({"metric": "serving_throughput_qps", "value": 0.0,
                   "unit": "requests/s", "error": repr(e)})
         _DONE.set()     # serving mode: the record above IS the summary
+        return
+
+    if args.mode == "fleet":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/fleet"):
+                emit(run_fleet_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"fleet bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "fleet_aggregate_qps_speedup", "value": 0.0,
+                  "unit": "x_single_host", "error": repr(e)})
+        _DONE.set()     # fleet mode: the record above IS the summary
         return
 
     if args.mode == "coldtier":

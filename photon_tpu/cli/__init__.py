@@ -6,6 +6,7 @@ Entry points:
   python -m photon_tpu.cli.legacy         legacy single-GLM driver (Driver)
   python -m photon_tpu.cli.feature_index  feature index build (FeatureIndexingDriver)
   python -m photon_tpu.cli.serve          online serving (JSONL stdin -> stdout)
+  python -m photon_tpu.cli.fleet_serve    entity-sharded fleet router (JSONL -> routed shards)
   python -m photon_tpu.cli.nearline       nearline delta training (event log -> live tables)
 """
 

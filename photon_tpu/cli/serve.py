@@ -18,6 +18,7 @@ Control lines (operator plane, same stream)::
 
     {"control": "swap", "model_dir": "/path/to/candidate", "label": "v2"}
     {"control": "drain"}
+    {"control": "stats"}     # live stats + metrics snapshot (fleet merge)
 
 A control line emits one ``{"control": ..., ...}`` response line instead
 of a score. Response line schema otherwise: ``ScoreResponse.to_json()``
@@ -38,11 +39,18 @@ Usage::
     python -m photon_tpu.cli.serve --model-input-directory /path/to/model \
         [--max-batch 64] [--max-wait-ms 2] [--stats-output stats.json] \
         < requests.jsonl > scores.jsonl
+
+Fleet shard mode: with ``--fleet-manifest FLEET_DIR --shard-id K`` the
+process instead serves ONE shard of an entity-sharded fleet
+(``io/fleet_store``): a random-effects-only engine over the shard's
+split cold stores, fixed effects left to the router
+(``cli/fleet_serve``), which fans requests out to these processes.
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import logging
 import os
@@ -63,9 +71,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="photon_tpu.serve",
         description="Serve a trained GAME model over JSONL stdin/stdout")
-    p.add_argument("--model-input-directory", required=True)
+    p.add_argument("--model-input-directory", default=None,
+                   help="trained model dir (required unless serving a "
+                        "fleet shard via --fleet-manifest)")
     p.add_argument("--coordinates", nargs="*", default=None,
                    help="subset of coordinate ids to load (default: all)")
+    p.add_argument("--fleet-manifest", default=None, metavar="FLEET_DIR",
+                   help="entity-sharded fleet dir (io/fleet_store); with "
+                        "--shard-id, serve ONE shard's random-effect "
+                        "rows (the unit a fleet router fans out to)")
+    p.add_argument("--shard-id", type=int, default=None,
+                   help="which fleet shard this process owns")
+    p.add_argument("--hot-capacity", type=int, default=None,
+                   help="two-tier hot rows per coordinate (fleet shard "
+                        "mode; default: whole shard store resident)")
     p.add_argument("--max-batch", type=int, default=64,
                    help="top of the power-of-two bucket ladder")
     p.add_argument("--max-wait-ms", type=float, default=2.0,
@@ -144,9 +163,24 @@ def build_engine(args: argparse.Namespace):
                                   else float("inf")),
             require_manifest=args.swap_require_manifest),
         drain_budget_s=args.drain_budget_s)
-    engine = ServingEngine.from_model_dir(
-        args.model_input_directory, config=config,
-        coordinates_to_load=args.coordinates)
+    if args.fleet_manifest is not None:
+        if args.shard_id is None:
+            raise SystemExit("--fleet-manifest requires --shard-id")
+        from photon_tpu.serving import CoeffStoreConfig
+        from photon_tpu.serving.fleet import build_shard_engine
+        if args.hot_capacity is not None:
+            config = dataclasses.replace(config, coeff_store=CoeffStoreConfig(
+                hot_capacity=args.hot_capacity))
+        engine = build_shard_engine(args.fleet_manifest, args.shard_id,
+                                    serving=config,
+                                    model_dir=args.model_input_directory)
+    elif args.model_input_directory is None:
+        raise SystemExit("--model-input-directory is required "
+                         "(or --fleet-manifest with --shard-id)")
+    else:
+        engine = ServingEngine.from_model_dir(
+            args.model_input_directory, config=config,
+            coordinates_to_load=args.coordinates)
     if not args.no_warmup:
         info = engine.warmup()
         logger.info("warmed %d programs over buckets %s in %.2fs",
@@ -191,6 +225,12 @@ def _handle_control(engine, obj: dict) -> dict:
     if cmd == "drain":
         engine.begin_drain("operator drain control line")
         return {"control": "drain", "ok": True}
+    if cmd == "stats":
+        # live stats + metrics snapshot — the shape a fleet router
+        # merges across shard processes via obs.metrics.merge_snapshots
+        from photon_tpu.obs.metrics import registry
+        return {"control": "stats", "ok": True,
+                "stats": engine.stats(), "metrics": registry.snapshot()}
     return {"control": cmd, "ok": False, "error": f"unknown control {cmd!r}"}
 
 

@@ -568,6 +568,19 @@ class ColdStore:
     def entity_id(self, row: int) -> str:
         return self._id_bytes(row).decode("utf-8")
 
+    def entity_ids_array(self) -> np.ndarray:
+        """All entity ids as a numpy bytes array in STORAGE-row order
+        (row ``i`` of ``coef``/``proj`` belongs to ``ids[i]``). Fixed-width
+        id tables come back as a zero-copy ``S{width}`` view over the
+        mmapped blob; variable-width tables materialize one bytes object
+        per row. The fleet splitter's bulk-partition input."""
+        if self._id_width:
+            blob = np.asarray(
+                self._id_blob[:self.num_entities * self._id_width])
+            return blob.view(f"S{self._id_width}")
+        return np.asarray([self._id_bytes(r)
+                           for r in range(self.num_entities)], dtype=bytes)
+
     def entity_row(self, entity_id: str) -> Optional[int]:
         """Row index of ``entity_id`` (binary search over the sorted id
         table), or None when the entity is not in the model — the caller's

@@ -1,0 +1,224 @@
+"""Shard-aware cold-store layout for the entity-sharded serving fleet.
+
+One model's per-coordinate cold-tier files (`io/cold_store.py`) split
+into N per-shard stores by the canonical entity partitioner
+(`parallel/partition.entity_shard` — the same hash training placement
+and request routing use), under a crc32-protected, versioned fleet
+manifest:
+
+    fleet_dir/
+      fleet-manifest.json          (schema + version + crc32, below)
+      shard_00000/per_user.coldstore
+      shard_00001/per_user.coldstore
+      ...
+
+Fleet manifest format (versioned like ``swap-manifest.json``, crc'd like
+``nearline-manifest.json``):
+
+    {
+      "schema": "photon_tpu.fleet.manifest.v1",
+      "version": 1,                      # bumped on re-split / re-publish
+      "num_shards": 16,
+      "partitioner": "crc32-utf8-mod",   # parallel/partition.entity_shard
+      "model_dir": "/abs/path",          # fixed effects + index maps live
+      "coordinates": {cid: {"random_effect_type", "feature_shard_id",
+                            "slot_width", "total_entities", "updatable"}},
+      "shards": [{"shard_id": 0,
+                  "stores": {cid: {"path": "shard_00000/cid.coldstore",
+                                   "entities": 6250000,
+                                   "bytes_at_split": 52428800}}}, ...],
+      "crc": 1234567890                  # crc32 of the sorted-json doc
+    }
+
+The manifest's ``crc`` covers the manifest document itself (a torn or
+tampered manifest fails ``read_fleet_manifest`` with a typed error —
+the ``manifest_torn_write`` chaos injector drives that path). Store
+payload integrity is the store's own embedded checksum (v1 footer / v2
+chunk table, ``ColdStore.verify``): per-store bytes here are recorded
+at split time and go stale by design once nearline row publishes mutate
+an updatable shard store in place.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.io.cold_store import COLD_STORE_SUFFIX, ColdStore, \
+    write_cold_store
+from photon_tpu.parallel.partition import entity_shards, validate_num_shards
+from photon_tpu.resilience import io as rio
+
+FLEET_MANIFEST_FILE = "fleet-manifest.json"
+FLEET_MANIFEST_SCHEMA = "photon_tpu.fleet.manifest.v1"
+#: the one partitioner this layout is defined over; a manifest naming
+#: anything else is refused (routing would disagree with file layout)
+PARTITIONER = "crc32-utf8-mod"
+
+__all__ = [
+    "FLEET_MANIFEST_FILE", "FLEET_MANIFEST_SCHEMA", "PARTITIONER",
+    "FleetManifestError", "shard_dir", "shard_store_path",
+    "split_cold_store", "build_fleet_dir",
+    "write_fleet_manifest", "read_fleet_manifest",
+]
+
+
+class FleetManifestError(RuntimeError):
+    """Fleet manifest missing, torn, schema-mismatched, or crc-corrupt."""
+
+
+def shard_dir(fleet_dir: str, shard_id: int) -> str:
+    return os.path.join(fleet_dir, f"shard_{shard_id:05d}")
+
+
+def shard_store_path(fleet_dir: str, shard_id: int,
+                     coordinate_id: str) -> str:
+    return os.path.join(shard_dir(fleet_dir, shard_id),
+                        coordinate_id + COLD_STORE_SUFFIX)
+
+
+def split_cold_store(src_path: str, fleet_dir: str, num_shards: int, *,
+                     updatable: bool = True,
+                     chunk_rows: int = 262144) -> List[Dict[str, object]]:
+    """Split one coordinate's cold store into ``num_shards`` per-shard
+    stores under ``fleet_dir`` by the canonical entity hash. Returns one
+    ``{"shard_id", "path", "entities", "bytes_at_split"}`` record per
+    shard (empty shards still get a valid zero-row store, so every shard
+    process can open its file unconditionally).
+
+    ``updatable=True`` writes v2 stores so the nearline publisher can
+    row-update and append in place per shard."""
+    n = validate_num_shards(num_shards)
+    src = ColdStore(src_path)
+    ids = src.entity_ids_array()
+    owners = entity_shards(ids, n) if src.num_entities else \
+        np.zeros(0, np.int32)
+    records: List[Dict[str, object]] = []
+    for s in range(n):
+        sel = np.nonzero(owners == s)[0]
+        out = shard_store_path(fleet_dir, s, src.coordinate_id)
+        # fancy-index straight off the source mmap in bounded chunks so a
+        # 100M-row split never holds two full copies
+        coef = np.empty((len(sel), src.slot_width), np.float32)
+        proj = np.empty((len(sel), src.slot_width), np.int32)
+        for lo in range(0, len(sel), chunk_rows):
+            rows = sel[lo:lo + chunk_rows]
+            coef[lo:lo + len(rows)] = src.coef[rows]
+            proj[lo:lo + len(rows)] = src.proj[rows]
+        write_cold_store(out, src.coordinate_id, src.random_effect_type,
+                         src.feature_shard_id, coef, proj, ids[sel],
+                         chunk_rows=chunk_rows, updatable=updatable)
+        records.append({
+            "shard_id": s,
+            "path": os.path.relpath(out, fleet_dir),
+            "entities": int(len(sel)),
+            "bytes_at_split": int(os.path.getsize(out)),
+        })
+    return records
+
+
+def build_fleet_dir(model_dir: str, fleet_dir: str, num_shards: int, *,
+                    coordinates: Optional[Sequence[str]] = None,
+                    updatable: bool = True,
+                    version: int = 1) -> dict:
+    """Split every cold-backed random-effect coordinate of ``model_dir``
+    into ``num_shards`` per-shard stores under ``fleet_dir`` and write
+    the fleet manifest. Returns the manifest document.
+
+    Only coordinates with a cold-store file are split (100M-entity
+    serving implies cold-backed coordinates); pass ``coordinates`` to
+    restrict the set."""
+    from photon_tpu.io.cold_store import COLD_STORE_DIR, cold_store_path
+    n = validate_num_shards(num_shards)
+    cold_root = os.path.join(model_dir, COLD_STORE_DIR)
+    if coordinates is None:
+        coordinates = sorted(
+            name[:-len(COLD_STORE_SUFFIX)]
+            for name in (os.listdir(cold_root)
+                         if os.path.isdir(cold_root) else ())
+            if name.endswith(COLD_STORE_SUFFIX))
+    if not coordinates:
+        raise ValueError(f"no cold-backed coordinates under {model_dir!r} "
+                         "to split")
+    coord_meta: Dict[str, dict] = {}
+    shard_stores: List[Dict[str, dict]] = [dict() for _ in range(n)]
+    for cid in coordinates:
+        src_path = cold_store_path(model_dir, cid)
+        src = ColdStore(src_path)
+        coord_meta[cid] = {
+            "random_effect_type": src.random_effect_type,
+            "feature_shard_id": src.feature_shard_id,
+            "slot_width": src.slot_width,
+            "total_entities": src.num_entities,
+            "updatable": bool(updatable),
+        }
+        for rec in split_cold_store(src_path, fleet_dir, n,
+                                    updatable=updatable):
+            shard_stores[rec["shard_id"]][cid] = {
+                "path": rec["path"],
+                "entities": rec["entities"],
+                "bytes_at_split": rec["bytes_at_split"],
+            }
+    doc = {
+        "schema": FLEET_MANIFEST_SCHEMA,
+        "version": int(version),
+        "num_shards": n,
+        "partitioner": PARTITIONER,
+        "model_dir": os.path.abspath(model_dir),
+        "coordinates": coord_meta,
+        "shards": [{"shard_id": s, "stores": shard_stores[s]}
+                   for s in range(n)],
+    }
+    write_fleet_manifest(fleet_dir, doc)
+    return doc
+
+
+def write_fleet_manifest(fleet_dir: str, doc: dict) -> str:
+    """Atomically publish ``fleet_dir/fleet-manifest.json`` with the
+    nearline-manifest crc discipline: ``crc`` = crc32 of the sorted-json
+    document without the crc field."""
+    path = os.path.join(fleet_dir, FLEET_MANIFEST_FILE)
+    body = {k: v for k, v in doc.items() if k != "crc"}
+    blob = json.dumps(body, sort_keys=True).encode("utf-8")
+    out = dict(body)
+    out["crc"] = zlib.crc32(blob) & 0xFFFFFFFF
+    rio.atomic_write_bytes(path,
+                           json.dumps(out, sort_keys=True).encode("utf-8"),
+                           op="fleet_manifest")
+    return path
+
+
+def read_fleet_manifest(fleet_dir: str) -> dict:
+    """Read + verify the fleet manifest; raises ``FleetManifestError``
+    on a missing, torn, schema-unknown, crc-mismatched, or
+    wrong-partitioner document (a router must never fall back to
+    guessing shard ownership)."""
+    path = os.path.join(fleet_dir, FLEET_MANIFEST_FILE)
+    if not os.path.exists(path):
+        raise FleetManifestError(f"no fleet manifest at {path!r}")
+    try:
+        doc = json.loads(rio.read_bytes(path, op="fleet_manifest"))
+    except (OSError, ValueError) as e:
+        raise FleetManifestError(
+            f"unreadable fleet manifest {path!r}: {e}") from e
+    if doc.get("schema") != FLEET_MANIFEST_SCHEMA:
+        raise FleetManifestError(
+            f"fleet manifest {path!r}: unknown schema {doc.get('schema')!r}")
+    crc = doc.pop("crc", None)
+    blob = json.dumps(doc, sort_keys=True).encode("utf-8")
+    if crc != zlib.crc32(blob) & 0xFFFFFFFF:
+        raise FleetManifestError(f"fleet manifest {path!r}: crc mismatch")
+    if doc.get("partitioner") != PARTITIONER:
+        raise FleetManifestError(
+            f"fleet manifest {path!r}: partitioner "
+            f"{doc.get('partitioner')!r} != {PARTITIONER!r} — routing "
+            "would disagree with file layout")
+    if not isinstance(doc.get("num_shards"), int) or doc["num_shards"] < 1:
+        raise FleetManifestError(
+            f"fleet manifest {path!r}: bad num_shards "
+            f"{doc.get('num_shards')!r}")
+    return doc

@@ -27,6 +27,8 @@ from photon_tpu.nearline.pipeline import NearlineConfig, NearlinePipeline
 from photon_tpu.nearline.publisher import (
     DeltaPublisher,
     DeltaPublishResult,
+    FleetDeltaPublisher,
+    FleetPublishResult,
     NearlinePublishConfig,
 )
 
@@ -34,6 +36,8 @@ __all__ = [
     "DeltaPublisher",
     "DeltaPublishResult",
     "DeltaTrainConfig",
+    "FleetDeltaPublisher",
+    "FleetPublishResult",
     "DeltaTrainer",
     "EventLogReader",
     "EventLogWriter",

@@ -74,6 +74,7 @@ from photon_tpu.io.cold_store import (
     upgrade_cold_store,
 )
 from photon_tpu.nearline.delta_trainer import (
+    CoordinateDelta,
     DeltaTrainResult,
     _parse_features,
     _row_margin,
@@ -1040,3 +1041,139 @@ class DeltaPublisher:
             return self.rollback_last(
                 "breaker tripped in post-publish probation")
         return False
+
+
+# -- entity-sharded fleet fan-out ---------------------------------------------
+
+
+@dataclasses.dataclass
+class FleetPublishResult:
+    """Outcome of one fleet publish round: all-or-nothing across shards."""
+
+    accepted: bool
+    label: str
+    #: shard id -> that shard's DeltaPublishResult (only shards that own
+    #: rows in the delta appear; untouched shards are never called)
+    shards: Dict[int, DeltaPublishResult] = dataclasses.field(
+        default_factory=dict)
+    reason: str = ""
+    #: shards whose already-committed rows were bitwise-restored because a
+    #: later shard's gates rejected the round
+    rolled_back_shards: List[int] = dataclasses.field(default_factory=list)
+    rows_updated: int = 0
+    rows_appended: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "accepted": self.accepted,
+            "label": self.label,
+            "reason": self.reason,
+            "rolled_back_shards": list(self.rolled_back_shards),
+            "rows_updated": self.rows_updated,
+            "rows_appended": self.rows_appended,
+            "shards": {str(s): r.to_json() for s, r in self.shards.items()},
+        }
+
+
+class FleetDeltaPublisher:
+    """Routes row publishes to owning shards of an entity-sharded fleet.
+
+    One `DeltaPublisher` per shard engine, each with its own state dir
+    (``fleet_dir/shard_XXXXX/nearline`` — per-shard versioned manifest,
+    same exactly-once handshake as single-host: the SHARED watermark
+    lands in every touched shard's manifest before the reader checkpoint
+    may advance). Each delta row goes to exactly the shard the canonical
+    partitioner (`parallel/partition.entity_shard`) owns it on — the
+    same hash that split the cold stores and that routes serve traffic —
+    so untouched shards are never called and their stores stay
+    byte-identical.
+
+    The fleet round is all-or-nothing: shards publish in shard-id order,
+    and if any shard's gate ladder rejects, every shard that already
+    committed this round is bitwise-restored via its own
+    ``rollback_last`` before the rejection is returned.
+    """
+
+    def __init__(self, fleet, fleet_dir: str,
+                 config: Optional[NearlinePublishConfig] = None):
+        from photon_tpu.io.fleet_store import shard_dir
+
+        self.fleet = fleet
+        self.num_shards = fleet.num_shards
+        self.publishers: Dict[int, DeltaPublisher] = {
+            c.shard_id: DeltaPublisher(
+                c.engine,
+                state_dir=os.path.join(shard_dir(fleet_dir, c.shard_id),
+                                       "nearline"),
+                config=config)
+            for c in fleet.clients}
+        self._lock = threading.Lock()
+
+    def route_rows(self, delta) -> Dict[int, Dict[str, CoordinateDelta]]:
+        """Split a delta's rows by owning shard -> per-shard
+        ``{cid: CoordinateDelta}`` subsets (event_ts subset to match).
+        Pure partitioner application — exposed so tests can pin
+        publish routing == serve routing == file layout."""
+        from photon_tpu.parallel.partition import entity_shard
+
+        coords = (delta.coordinates
+                  if isinstance(delta, DeltaTrainResult) else delta)
+        out: Dict[int, Dict[str, CoordinateDelta]] = {}
+        for cid, cd in coords.items():
+            by_shard: Dict[int, Dict[str, Tuple[np.ndarray, np.ndarray]]] = {}
+            for eid, row in cd.rows.items():
+                by_shard.setdefault(
+                    entity_shard(eid, self.num_shards), {})[eid] = row
+            for s, rows in by_shard.items():
+                out.setdefault(s, {})[cid] = CoordinateDelta(
+                    coordinate_id=cd.coordinate_id,
+                    random_effect_type=cd.random_effect_type,
+                    feature_shard_id=cd.feature_shard_id,
+                    rows=rows,
+                    event_ts={e: cd.event_ts[e] for e in rows
+                              if e in cd.event_ts},
+                    num_events=cd.num_events)
+        return out
+
+    def publish(self, delta, label: str,
+                watermark: Optional[dict] = None) -> FleetPublishResult:
+        """One all-or-nothing fleet publish round. ``delta`` is a
+        `DeltaTrainResult` or ``{cid: CoordinateDelta}``; ``watermark``
+        is the shared reader position recorded in every touched shard's
+        manifest."""
+        with self._lock:
+            routed = self.route_rows(delta)
+            result = FleetPublishResult(accepted=True, label=label)
+            committed: List[int] = []
+            for s in sorted(routed):
+                res = self.publishers[s].publish(routed[s], label,
+                                                 watermark)
+                result.shards[s] = res
+                if not res.accepted:
+                    result.accepted = False
+                    result.reason = (f"shard {s} rejected: {res.reason}"
+                                     if res.reason else f"shard {s} rejected")
+                    for c in committed:
+                        if self.publishers[c].rollback_last(
+                                f"fleet round {label!r} aborted by "
+                                f"shard {s}"):
+                            result.rolled_back_shards.append(c)
+                    _metrics.counter("nearline.fleet.rejected").inc()
+                    return result
+                committed.append(s)
+                result.rows_updated += res.rows_updated
+                result.rows_appended += res.rows_appended
+            _metrics.counter("nearline.fleet.accepted").inc()
+            return result
+
+    def rollback_last(self, why: str = "operator rollback") -> List[int]:
+        """Fan a bitwise rollback of the most recent accepted round out
+        to every shard; returns the shard ids that had one to undo."""
+        with self._lock:
+            return [s for s, p in sorted(self.publishers.items())
+                    if p.rollback_last(why)]
+
+    def watermarks(self) -> Dict[int, Optional[dict]]:
+        """Per-shard durable watermark (from each shard's manifest)."""
+        return {s: (p.last_manifest or {}).get("watermark")
+                for s, p in sorted(self.publishers.items())}

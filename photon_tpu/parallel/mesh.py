@@ -395,6 +395,23 @@ def pad_entities(ds, multiple: int, num_flat_samples: Optional[int] = None):
     )
 
 
+def entity_axis_assignment(entity_ids: Sequence, mesh: Mesh,
+                           axis: Optional[str] = None) -> np.ndarray:
+    """Device-slot assignment for named entities along the entity axis,
+    via the canonical partitioner (`parallel/partition.entity_shard`) —
+    the SAME hash the cold-store splitter and serving-fleet router use,
+    so train-time placement and serve-time routing provably agree.
+
+    `shard_entity_blocks` itself places whatever block order the caller
+    built; callers that want fleet-aligned placement order their entity
+    rows by this assignment first (the serving fleet depends only on the
+    hash, not on any one training layout)."""
+    from photon_tpu.parallel.partition import entity_shards
+    if axis is None:
+        axis = ENTITY_AXIS if ENTITY_AXIS in mesh.axis_names else DATA_AXIS
+    return entity_shards(entity_ids, axis_size(mesh, axis))
+
+
 def shard_entity_blocks(ds, mesh: Mesh, axis: Optional[str] = None,
                         num_flat_samples: Optional[int] = None):
     """Pad + place a RandomEffectDataset with entities (and passive rows)
@@ -403,7 +420,10 @@ def shard_entity_blocks(ds, mesh: Mesh, axis: Optional[str] = None,
 
     Default axis: the mesh's "entity" axis when it has one, else "data"
     (entity solves are independent, so reusing the data-axis devices is
-    valid and the common single-axis-mesh case)."""
+    valid and the common single-axis-mesh case). For placement that lines
+    up with the serving fleet's shard ownership, order entity rows by
+    `entity_axis_assignment` (the canonical `parallel/partition` hash)
+    before calling this."""
     if axis is None:
         axis = ENTITY_AXIS if ENTITY_AXIS in mesh.axis_names else DATA_AXIS
     ds = pad_entities(ds, axis_size(mesh, axis), num_flat_samples)

@@ -1,0 +1,141 @@
+"""Canonical entity -> shard partitioner shared by training placement,
+cold-store file layout, and serving-fleet request routing.
+
+One function is the whole contract: ``entity_shard(entity_id, num_shards)``.
+Training-time entity placement (`parallel/mesh.shard_entity_blocks`), the
+per-shard cold-store split (`io/fleet_store.split_cold_store`), the fleet
+request router (`serving/fleet.ShardedServingFleet`), and the nearline
+publish fan-out (`nearline/publisher.publish_fleet`) all import it from
+here, so a row written by the trainer, laid out by the splitter, and
+published by the nearline pipeline provably lands on the shard the router
+queries.
+
+The hash is ``zlib.crc32`` over the entity id's utf-8 bytes — the same
+checksum primitive the cold-store format and every manifest in the repo
+already use, stable across processes/platforms/Python versions (unlike
+``hash()``), and cheap to vectorize. Entity ids are strings everywhere at
+the serving boundary (`ScoreRequest.entity_ids`, cold-store id tables);
+non-string ids (e.g. negative ints from raw training frames) partition by
+their ``str()`` form so both sides agree without a schema change.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Iterable, List, Sequence
+
+import numpy as np
+
+__all__ = [
+    "crc32_ids",
+    "entity_shard",
+    "entity_shards",
+    "partition_ids",
+    "validate_num_shards",
+]
+
+
+def validate_num_shards(num_shards: int) -> int:
+    if not isinstance(num_shards, (int, np.integer)) or num_shards < 1:
+        raise ValueError(f"num_shards must be a positive int, got {num_shards!r}")
+    return int(num_shards)
+
+
+def _id_bytes(entity_id) -> bytes:
+    if isinstance(entity_id, bytes):
+        return entity_id
+    if not isinstance(entity_id, str):
+        entity_id = str(entity_id)
+    return entity_id.encode("utf-8")
+
+
+def entity_shard(entity_id, num_shards: int) -> int:
+    """The canonical entity->shard map: crc32(utf-8 id) mod num_shards.
+
+    Accepts str (the serving/cold-store form), bytes (already-encoded id
+    tables), or anything else via ``str()`` (e.g. int ids in training
+    frames). With ``num_shards == 1`` every id maps to shard 0.
+    """
+    n = validate_num_shards(num_shards)
+    return (zlib.crc32(_id_bytes(entity_id)) & 0xFFFFFFFF) % n
+
+
+_CRC_TABLE: np.ndarray = None
+
+
+def _crc_table() -> np.ndarray:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        t = np.arange(256, dtype=np.uint32)
+        for _ in range(8):
+            t = np.where(t & 1, (t >> 1) ^ np.uint32(0xEDB88320),
+                         t >> 1).astype(np.uint32)
+        _CRC_TABLE = t
+    return _CRC_TABLE
+
+
+def crc32_ids(ids: np.ndarray) -> np.ndarray:
+    """Vectorized ``zlib.crc32`` over a 1-D numpy byte/str id array ->
+    uint32 array, bit-identical to per-element ``zlib.crc32`` (the
+    pinning test asserts this). Byte-column-at-a-time table CRC, so a
+    100M-entity id table partitions in seconds instead of the minutes a
+    Python loop takes — the path the cold-store splitter and bulk
+    placement use."""
+    arr = np.asarray(ids)
+    if arr.dtype.kind == "U":
+        arr = np.char.encode(arr, "utf-8")
+    if arr.dtype.kind != "S" or arr.ndim != 1:
+        raise TypeError(f"crc32_ids needs a 1-D S/U array, got "
+                        f"{arr.dtype} ndim={arr.ndim}")
+    width = arr.dtype.itemsize
+    n = arr.shape[0]
+    if n == 0 or width == 0:
+        return np.zeros(n, dtype=np.uint32)
+    mat = np.ascontiguousarray(arr).view(np.uint8).reshape(n, width)
+    # numpy S items drop trailing NULs on access, so per-element
+    # zlib.crc32 sees np.char.str_len bytes — mirror that exactly
+    lengths = np.char.str_len(arr)
+    table = _crc_table()
+    crc = np.full(n, 0xFFFFFFFF, dtype=np.uint32)
+    for j in range(width):
+        active = lengths > j
+        nxt = table[(crc ^ mat[:, j]) & np.uint32(0xFF)] ^ (crc >> np.uint32(8))
+        crc = np.where(active, nxt, crc)
+    return crc ^ np.uint32(0xFFFFFFFF)
+
+
+def entity_shards(entity_ids: Iterable, num_shards: int) -> np.ndarray:
+    """Vectorized ``entity_shard`` over a sequence of ids -> int32 array.
+
+    Numpy byte/str arrays take the column-parallel CRC path; anything
+    else (lists of ints, object arrays) falls back to the per-element
+    hash — both are bit-identical to ``entity_shard``."""
+    n = validate_num_shards(num_shards)
+    if isinstance(entity_ids, np.ndarray):
+        arr = entity_ids
+    else:
+        entity_ids = list(entity_ids)
+        arr = np.asarray(entity_ids) if entity_ids else \
+            np.zeros(0, dtype="S1")
+    if arr.ndim == 1 and arr.dtype.kind in ("S", "U"):
+        return (crc32_ids(arr) % np.uint32(n)).astype(np.int32)
+    return np.fromiter(
+        ((zlib.crc32(_id_bytes(e)) & 0xFFFFFFFF) % n for e in entity_ids),
+        dtype=np.int32)
+
+
+def partition_ids(entity_ids: Sequence, num_shards: int) -> List[List[int]]:
+    """Group ``entity_ids`` by owning shard -> per-shard index lists.
+
+    Returns ``num_shards`` lists; list ``s`` holds the positions (into the
+    input sequence) of every id owned by shard ``s``, in input order —
+    the shape the cold-store splitter and publish fan-out both need.
+    """
+    n = validate_num_shards(num_shards)
+    out: List[List[int]] = [[] for _ in range(n)]
+    if n == 1:
+        out[0] = list(range(len(entity_ids)))
+        return out
+    for i, s in enumerate(entity_shards(entity_ids, n)):
+        out[int(s)].append(i)
+    return out

@@ -64,6 +64,19 @@ hook points consult it:
   produces under failure: a half-appended final record, a re-delivered
   shard, and out-of-order delivery. The event reader must stop before
   the torn tail, dedup replayed sequence numbers, and re-sort the rest.
+- ``shard_killed(shard_id)`` — serving/fleet.py's shard clients ask
+  before every routed call; the configured shard answers nothing (a
+  dead process / unreachable host). The router must degrade that
+  shard's random effects with typed ``SHARD_UNAVAILABLE`` — never a
+  hot-path exception — while the surviving shards keep serving.
+- ``shard_response_delay(shard_id)`` — same hook point; the configured
+  shard's first ``shard_slow_requests`` calls sleep
+  ``shard_slow_s`` before serving, driving the router's hedged
+  fan-out (the hedge must win while the primary attempt lags).
+- ``manifest_torn_write(fleet_dir)`` — deterministic fleet-manifest
+  tear: truncates ``fleet-manifest.json`` to half its bytes (a kill
+  mid-publish). ``read_fleet_manifest``'s crc gate must refuse the
+  torn document; a router must never boot on guessed shard ownership.
 
 Everything is counter-based off the installed config — two runs with the
 same config and workload inject identically. ``seed`` feeds the optional
@@ -133,6 +146,15 @@ class ChaosConfig:
     # streamed solver: (pass index, chunk index) after whose accumulation
     # the consumer checkpoints its chunk cursor and dies (fires once)
     stream_kill_at: Optional[Tuple[int, int]] = None
+    # serving fleet: shard id whose clients answer nothing (a dead
+    # process); stays dead for the config's lifetime — kill, not flake
+    shard_kill_id: Optional[int] = None
+    # serving fleet: shard id whose first shard_slow_requests routed
+    # calls sleep shard_slow_s before serving (then back to speed) —
+    # the router's hedged fan-out must win the race while it lags
+    shard_slow_id: Optional[int] = None
+    shard_slow_s: float = 0.0
+    shard_slow_requests: int = 0
 
 
 class _State:
@@ -151,6 +173,7 @@ class _State:
         self.chunk_read_delays_done = 0
         self.chunk_read_errors_done = 0
         self.stream_kill_fired = False
+        self.shard_slow_done = 0
 
 
 _active: Optional[_State] = None
@@ -444,6 +467,49 @@ def shuffle_shard_records(shard_path: str, seed: int = 0) -> int:
     with open(shard_path, "wb") as f:
         f.write(shuffled)
     return moved
+
+
+def shard_killed(shard_id: int) -> bool:
+    """True while the installed config names ``shard_id`` as killed.
+    Unlike the fire-once injectors this is a STATE, not an event: a dead
+    shard stays dead for the config's lifetime, so every routed call to
+    it must come back as typed ``SHARD_UNAVAILABLE`` degradation."""
+    s = _active
+    return s is not None and s.config.shard_kill_id == shard_id
+
+
+def shard_response_delay(shard_id: int) -> float:
+    """Seconds this shard's routed call should sleep before serving (0
+    when inactive / a different shard / the request budget is spent).
+    Real wall time on the caller's fan-out thread — the router's hedged
+    second attempt must overtake the lagging primary."""
+    s = _active
+    if (s is None or s.config.shard_slow_id != shard_id
+            or s.config.shard_slow_s <= 0):
+        return 0.0
+    with s.lock:
+        if s.shard_slow_done >= s.config.shard_slow_requests:
+            return 0.0
+        s.shard_slow_done += 1
+    return s.config.shard_slow_s
+
+
+def manifest_torn_write(fleet_dir: str) -> int:
+    """Tear the fleet manifest: truncate ``fleet-manifest.json`` to half
+    its bytes — the shape a kill between tmp-write and rename (or a
+    partial copy) leaves. Returns the number of bytes removed.
+    ``io/fleet_store.read_fleet_manifest``'s crc gate must refuse the
+    torn document with a typed ``FleetManifestError``; a router must
+    never boot on guessed shard ownership."""
+    import os
+
+    path = os.path.join(fleet_dir, "fleet-manifest.json")
+    size = os.path.getsize(path)
+    if size < 2:
+        raise ValueError(f"fleet manifest too small to tear: {path!r}")
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+    return size - size // 2
 
 
 def at_publish(op: str) -> None:

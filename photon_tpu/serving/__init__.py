@@ -36,6 +36,11 @@ from photon_tpu.serving.batching import (
 from photon_tpu.serving.breaker import CircuitBreaker
 from photon_tpu.serving.coeff_store import TwoTierCoeffStore
 from photon_tpu.serving.engine import LATENCY_BUCKETS, ServingEngine
+from photon_tpu.serving.fleet import (
+    FleetConfig,
+    LocalShardClient,
+    ShardedServingFleet,
+)
 from photon_tpu.serving.model_state import DeviceResidentModel
 from photon_tpu.serving.scorer import MODES, get_scorer, warmup_scorers
 from photon_tpu.serving.swap import (
@@ -67,6 +72,9 @@ __all__ = [
     "DeviceResidentModel",
     "Fallback",
     "FallbackReason",
+    "FleetConfig",
+    "LocalShardClient",
+    "ShardedServingFleet",
     "LATENCY_BUCKETS",
     "MODES",
     "MicroBatcher",

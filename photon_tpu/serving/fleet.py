@@ -1,0 +1,572 @@
+"""Entity-sharded serving fleet: fixed effects local, random effects routed.
+
+The GLMix score is additive — ``scorer.py`` computes
+
+    total = ((offset + fixed_0 + ... + fixed_F) + re_0) + re_1 + ...
+
+as a left-to-right float32 chain — so the fleet decomposes it exactly:
+
+* a FRONT engine owns the (small, replicated) fixed effects and scores
+  ``offset + fixed`` locally for every request;
+* each of N SHARD engines owns the random-effect rows of the entities
+  the canonical partitioner (`parallel/partition.entity_shard` — the
+  same hash training placement and the cold-store splitter use) assigns
+  it, serving them from its own cold store / `TwoTierCoeffStore` hot
+  tier behind its own circuit breaker;
+* the router turns each request into a hop chain: the running total so
+  far rides as the next hop's ``offset``, so the owning shard's engine
+  computes ``(running + re_j) + re_k`` with exactly the additions the
+  single-host program would have issued. With every routed coordinate
+  on one shard (always true for single-random-effect models, the GLMix
+  serving shape), the fleet score is BITWISE equal to the single-host
+  engine's — the parity tests pin this. Only a request whose coordinate
+  ownership interleaves across shards in model order reassociates the
+  chain (ulp-level, deterministic).
+
+Degradation is data, never a hot-path exception: a shard that is down
+(`chaos.shard_killed`, a dead client), past its deadline, or refusing
+(breaker open, draining, shedding) contributes nothing and the response
+carries a typed ``SHARD_UNAVAILABLE`` fallback per unavailable shard —
+the score degrades to the fixed margin plus every shard that DID
+answer. Slow shards are hedged: a hop that has not returned within
+``FleetConfig.hedge_timeout_s`` gets a second attempt, first answer
+wins (`chaos.shard_response_delay` drives the race in tests).
+
+Per-shard observability (qps, p50/p99, hot-tier hit rate, breaker
+state, unavailable/hedge counts) is kept at the router and merged into
+one fleet view via the existing ``obs/metrics.merge_snapshots`` — the
+same aggregation the multi-process RunReport path uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor, TimeoutError as _FutTimeout
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_tpu.obs.metrics import merge_snapshots, registry as _metrics
+from photon_tpu.resilience import chaos
+from photon_tpu.serving.engine import LATENCY_BUCKETS, ServingEngine
+from photon_tpu.serving.model_state import DeviceResidentModel
+from photon_tpu.serving.types import (
+    Fallback,
+    FallbackReason,
+    ScoreRequest,
+    ScoreResponse,
+    ServingConfig,
+)
+
+__all__ = [
+    "FleetConfig",
+    "LocalShardClient",
+    "ShardedServingFleet",
+    "build_front_engine",
+    "build_shard_engine",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Router knobs. Engine-level behavior (ladder, breaker, SLO,
+    two-tier store) stays in the per-engine ``ServingConfig``s."""
+
+    #: per-hop wall ceiling for a routed shard call when the request
+    #: carries no deadline of its own; None = wait for the shard
+    shard_timeout_s: Optional[float] = None
+    #: resubmit a hop that has not answered within this window (first
+    #: answer wins); None disables hedging
+    hedge_timeout_s: Optional[float] = None
+    #: shard engines' config (each shard gets its own engine instance)
+    serving: ServingConfig = dataclasses.field(default_factory=ServingConfig)
+    #: front (fixed-effect) engine config; None = same as ``serving``
+    #: minus the coeff store (fixed effects are always resident)
+    front_serving: Optional[ServingConfig] = None
+    #: sliding per-shard window for qps / latency quantiles
+    stats_window: int = 4096
+
+    def __post_init__(self):
+        if self.shard_timeout_s is not None and self.shard_timeout_s <= 0:
+            raise ValueError("shard_timeout_s must be positive")
+        if self.hedge_timeout_s is not None and self.hedge_timeout_s <= 0:
+            raise ValueError("hedge_timeout_s must be positive")
+        if self.stats_window < 2:
+            raise ValueError("stats_window must be >= 2")
+
+
+class LocalShardClient:
+    """In-process shard: a `ServingEngine` over one shard's stores.
+
+    The client boundary is where a real fleet would put the RPC; chaos'
+    ``shard_killed`` / ``shard_response_delay`` hook here so the router
+    sees exactly what a dead or lagging remote would produce. ``serve``
+    returns None for "no answer" — the router's typed-degradation
+    signal; it NEVER raises on the request path."""
+
+    def __init__(self, shard_id: int, engine: ServingEngine):
+        self.shard_id = int(shard_id)
+        self.engine = engine
+        self.alive = True
+        self._lock = threading.Lock()
+
+    def serve(self, requests: Sequence[ScoreRequest]
+              ) -> Optional[List[ScoreResponse]]:
+        if not self.alive or chaos.shard_killed(self.shard_id):
+            return None
+        delay = chaos.shard_response_delay(self.shard_id)
+        if delay > 0:
+            time.sleep(delay)
+        with self._lock:
+            if not self.alive:   # killed while this attempt queued
+                return None
+            try:
+                return self.engine.serve(requests)
+            except Exception:    # a crashed shard is an unavailable
+                return None      # shard, not a router exception
+
+    def warmup(self) -> dict:
+        with self._lock:
+            return self.engine.warmup()
+
+    def kill(self) -> None:
+        self.alive = False
+
+    def revive(self) -> None:
+        self.alive = True
+
+    def breaker_state(self) -> str:
+        return self.engine.breaker.state()
+
+    def hot_hit_rate(self) -> Optional[float]:
+        cs = self.engine.model.coeff_store_stats()
+        if not cs:
+            return None
+        rates = [s["hit_rate"] for s in cs.values()
+                 if s.get("hit_rate") is not None]
+        return float(np.mean(rates)) if rates else None
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self.engine.shutdown(drain_budget_s=0.0, reason="fleet shutdown")
+
+
+class _ShardStats:
+    """Router-side per-shard window: qps, latency quantiles, counts, and
+    a LATENCY_BUCKETS histogram (snapshot-shaped for merge_snapshots)."""
+
+    def __init__(self, window: int):
+        self.lock = threading.Lock()
+        self.requests = 0
+        self.unavailable = 0
+        self.hedges = 0
+        self.lat = deque(maxlen=window)
+        self.times = deque(maxlen=window)
+        self.bucket_counts = [0] * (len(LATENCY_BUCKETS) + 1)
+        self.lat_sum = 0.0
+
+    def record(self, seconds: float, n_requests: int) -> None:
+        with self.lock:
+            self.requests += n_requests
+            now = time.monotonic()
+            for _ in range(n_requests):
+                self.lat.append(seconds)
+                self.times.append(now)
+            self.bucket_counts[int(np.searchsorted(
+                LATENCY_BUCKETS, seconds))] += n_requests
+            self.lat_sum += seconds * n_requests
+
+    def view(self) -> dict:
+        with self.lock:
+            lat = list(self.lat)
+            times = list(self.times)
+            out = {"requests": self.requests,
+                   "unavailable": self.unavailable,
+                   "hedges": self.hedges}
+        if lat:
+            out["p50_s"] = float(np.percentile(lat, 50))
+            out["p99_s"] = float(np.percentile(lat, 99))
+        span = times[-1] - times[0] if len(times) > 1 else 0.0
+        out["qps"] = round(len(times) / span, 1) if span > 0 else 0.0
+        return out
+
+    def snapshot(self) -> dict:
+        """One shard's metrics in ``registry.snapshot()`` shape — the
+        unit ``merge_snapshots`` aggregates into the fleet view (and
+        the same shape a remote shard process would ship)."""
+        with self.lock:
+            counts = list(self.bucket_counts)
+            total = sum(counts)
+            return {
+                "counters": {"fleet.shard.requests": self.requests,
+                             "fleet.shard.unavailable": self.unavailable,
+                             "fleet.shard.hedges": self.hedges},
+                "gauges": {},
+                "histograms": {"fleet.shard.latency_seconds": {
+                    "buckets": list(LATENCY_BUCKETS),
+                    "counts": counts[:-1] + [counts[-1]],
+                    "sum": self.lat_sum, "count": total}},
+            }
+
+
+#: one hop of a request's routing chain
+_Hop = Tuple[int, Dict[str, str]]      # (shard_id, {re_type: entity_id})
+
+
+def _load_base(manifest: dict, model_dir: Optional[str] = None):
+    """(base model, manifest-covered random effects in MODEL order).
+    Model order fixes the float accumulation chain, so every consumer —
+    front, shards, router — derives it from the same load."""
+    from photon_tpu.io.model_io import load_for_serving
+
+    src_dir = model_dir or manifest["model_dir"]
+    base = load_for_serving(src_dir)
+    coord_meta = manifest["coordinates"]
+    ordered = [re for re in base.random if re.coordinate_id in coord_meta]
+    missing = set(coord_meta) - {re.coordinate_id for re in ordered}
+    if missing:
+        raise ValueError(f"manifest coordinates {sorted(missing)} not in "
+                         f"model {src_dir!r}")
+    return base, ordered
+
+
+def build_front_engine(manifest: dict, config: FleetConfig,
+                       model_dir: Optional[str] = None,
+                       base=None) -> ServingEngine:
+    """Fixed-effects-only engine — the replicated front every router
+    instance scores locally before fanning random effects out."""
+    from photon_tpu.io.model_io import ServingGameModel
+
+    if base is None:
+        base, _ = _load_base(manifest, model_dir)
+    front_cfg = config.front_serving or dataclasses.replace(
+        config.serving, coeff_store=None)
+    front_model = ServingGameModel(base.task, list(base.fixed), [],
+                                   base.index_maps, base.metadata)
+    return ServingEngine(
+        DeviceResidentModel(front_model, feature_pad=front_cfg.feature_pad),
+        front_cfg)
+
+
+def build_shard_engine(fleet_dir: str, shard_id: int,
+                       serving: Optional[ServingConfig] = None,
+                       manifest: Optional[dict] = None,
+                       model_dir: Optional[str] = None,
+                       base=None) -> ServingEngine:
+    """Random-effects-only engine over ONE shard's split cold stores —
+    the unit a shard host runs (``cli/serve --fleet-manifest --shard-id``
+    boots exactly this)."""
+    from photon_tpu.io.fleet_store import (read_fleet_manifest,
+                                           shard_store_path)
+    from photon_tpu.io.model_io import ServingGameModel, ServingRandomEffect
+
+    if manifest is None:
+        manifest = read_fleet_manifest(fleet_dir)
+    if not any(sh["shard_id"] == shard_id for sh in manifest["shards"]):
+        raise ValueError(f"shard {shard_id} not in fleet manifest "
+                         f"(num_shards={manifest['num_shards']})")
+    if base is None:
+        base, _ = _load_base(manifest, model_dir)
+    _, ordered = (base, [re for re in base.random
+                         if re.coordinate_id in manifest["coordinates"]])
+    serving = serving or ServingConfig()
+    res = [ServingRandomEffect(
+               re.coordinate_id, re.random_effect_type,
+               re.feature_shard_id,
+               cold_store_path=shard_store_path(fleet_dir, shard_id,
+                                                re.coordinate_id))
+           for re in ordered]
+    m = ServingGameModel(base.task, [], res, base.index_maps, base.metadata)
+    return ServingEngine(
+        DeviceResidentModel(m, feature_pad=serving.feature_pad,
+                            coeff_store=serving.coeff_store),
+        serving)
+
+
+class ShardedServingFleet:
+    """Front-end router over a front (fixed-effect) engine plus N shard
+    clients. Synchronous ``serve`` mirrors `ServingEngine.serve` —
+    responses in request order, every degradation typed."""
+
+    def __init__(self, front: ServingEngine,
+                 clients: Sequence[LocalShardClient],
+                 coordinates: Sequence[Tuple[str, str]],
+                 config: Optional[FleetConfig] = None):
+        """``coordinates`` is the model-order list of
+        (coordinate_id, random_effect_type) the fleet routes — the order
+        fixes the float accumulation chain, so it must match the
+        single-host model's ``random`` order."""
+        self.front = front
+        self.clients = list(clients)
+        self.num_shards = len(self.clients)
+        if self.num_shards < 1:
+            raise ValueError("fleet needs at least one shard")
+        self.coordinates = list(coordinates)
+        self.config = config or FleetConfig()
+        self._stats = {c.shard_id: _ShardStats(self.config.stats_window)
+                       for c in self.clients}
+        self._by_id = {c.shard_id: c for c in self.clients}
+        # supervisors (<= shards) + two attempts each can be in flight
+        self._pool = ThreadPoolExecutor(
+            max_workers=2 * self.num_shards + 4,
+            thread_name_prefix="fleet")
+        self._closed = False
+
+    # ------------------------------------------------------------ build
+
+    @classmethod
+    def from_fleet_dir(cls, fleet_dir: str,
+                       config: Optional[FleetConfig] = None,
+                       model_dir: Optional[str] = None,
+                       ) -> "ShardedServingFleet":
+        """Build the whole fleet from a split directory
+        (`io/fleet_store.build_fleet_dir`): front engine from the source
+        model's fixed effects, one shard engine per manifest shard over
+        its per-shard cold stores. Refuses a torn/corrupt manifest
+        (``FleetManifestError``) — routing never boots on guesses."""
+        from photon_tpu.io.fleet_store import read_fleet_manifest
+
+        config = config or FleetConfig()
+        manifest = read_fleet_manifest(fleet_dir)
+        base, ordered = _load_base(manifest, model_dir)
+        front = build_front_engine(manifest, config, base=base)
+        clients = [
+            LocalShardClient(sh["shard_id"], build_shard_engine(
+                fleet_dir, sh["shard_id"], config.serving,
+                manifest=manifest, base=base))
+            for sh in manifest["shards"]]
+        coords = [(re.coordinate_id, re.random_effect_type)
+                  for re in ordered]
+        return cls(front, clients, coords, config)
+
+    # ---------------------------------------------------------- routing
+
+    def route(self, request: ScoreRequest) -> List[_Hop]:
+        """The request's hop chain: routed coordinates grouped by owning
+        shard, groups ordered by first coordinate in model order (the
+        float-chain order). Pure function of the canonical hash —
+        exposed so tests can pin routing == training placement."""
+        from photon_tpu.parallel.partition import entity_shard
+        owners: List[Tuple[int, str, str]] = []  # (coord idx, re_type, eid)
+        for i, (_cid, re_type) in enumerate(self.coordinates):
+            eid = request.entity_ids.get(re_type)
+            if eid is not None:
+                owners.append((i, re_type, eid))
+        hops: List[_Hop] = []
+        seen: Dict[int, int] = {}
+        for i, re_type, eid in owners:
+            shard = entity_shard(eid, self.num_shards)
+            if shard in seen:
+                hops[seen[shard]][1][re_type] = eid
+            else:
+                seen[shard] = len(hops)
+                hops.append((shard, {re_type: eid}))
+        return hops
+
+    # ---------------------------------------------------------- serving
+
+    def warmup(self) -> dict:
+        infos = [self.front.warmup()] + [c.warmup() for c in self.clients]
+        return {
+            "programs": sum(i["programs"] for i in infos),
+            "seconds": round(sum(i["seconds"] for i in infos), 3),
+            "front_programs": infos[0]["programs"],
+            "per_shard_programs": [i["programs"] for i in infos[1:]],
+        }
+
+    def score(self, request: ScoreRequest) -> ScoreResponse:
+        return self.serve([request])[0]
+
+    def serve(self, requests: Sequence[ScoreRequest]
+              ) -> List[ScoreResponse]:
+        cfg = self.config
+        t_in = time.monotonic()
+        deadlines = [t_in + r.timeout_s if r.timeout_s is not None else None
+                     for r in requests]
+        # fixed effects local: ids stripped (the front model has no
+        # random effects; its refusal ladder still applies)
+        front_resps = self.front.serve([
+            ScoreRequest(r.uid, r.features, {}, r.offset, r.timeout_s)
+            for r in requests])
+        _metrics.counter("fleet.requests").inc(len(requests))
+
+        totals: List[Optional[np.float32]] = []
+        fallbacks: List[List[Fallback]] = []
+        chains: List[List[_Hop]] = []
+        for r, fr in zip(requests, front_resps):
+            fallbacks.append(list(fr.fallbacks))
+            if fr.score is None:          # typed refusal — no routing
+                totals.append(None)
+                chains.append([])
+            else:
+                totals.append(np.float32(fr.score))
+                chains.append(self.route(r))
+
+        depth = 0
+        while True:
+            # (shard -> [(req index, ids)]) for this hop depth
+            groups: Dict[int, List[Tuple[int, Dict[str, str]]]] = {}
+            for i, chain in enumerate(chains):
+                if depth < len(chain) and totals[i] is not None:
+                    shard, ids = chain[depth]
+                    groups.setdefault(shard, []).append((i, ids))
+            if not groups:
+                break
+            futs = {}
+            for shard, members in groups.items():
+                subreqs, idxs, budget = [], [], None
+                now = time.monotonic()
+                for i, ids in members:
+                    remaining = None if deadlines[i] is None \
+                        else deadlines[i] - now
+                    if remaining is not None:
+                        budget = remaining if budget is None \
+                            else min(budget, remaining)
+                    subreqs.append(ScoreRequest(
+                        requests[i].uid, requests[i].features, ids,
+                        offset=float(totals[i]), timeout_s=remaining))
+                    idxs.append(i)
+                if budget is None:
+                    budget = cfg.shard_timeout_s
+                futs[shard] = (idxs, self._pool.submit(
+                    self._supervised_call, self._by_id[shard],
+                    subreqs, budget))
+            for shard, (idxs, fut) in futs.items():
+                resps = fut.result()   # supervisor never raises
+                st = self._stats[shard]
+                if resps is None:
+                    with st.lock:
+                        st.unavailable += len(idxs)
+                    _metrics.counter("fleet.shard_unavailable",
+                                     shard=str(shard)).inc(len(idxs))
+                    for i in idxs:
+                        fallbacks[i].append(Fallback(
+                            FallbackReason.SHARD_UNAVAILABLE, None,
+                            f"shard {shard} gave no answer"))
+                    continue
+                for i, resp in zip(idxs, resps):
+                    fallbacks[i].extend(resp.fallbacks)
+                    if resp.score is None:
+                        # shard answered with a typed refusal (breaker
+                        # open, shedding, deadline): its margins are
+                        # unavailable, the chain total stands
+                        st_reasons = {f.reason for f in resp.fallbacks}
+                        if FallbackReason.DEADLINE_EXCEEDED not in \
+                                st_reasons:
+                            fallbacks[i].append(Fallback(
+                                FallbackReason.SHARD_UNAVAILABLE, None,
+                                f"shard {shard} refused"))
+                        with st.lock:
+                            st.unavailable += 1
+                        _metrics.counter("fleet.shard_unavailable",
+                                         shard=str(shard)).inc()
+                    else:
+                        totals[i] = np.float32(resp.score)
+            depth += 1
+
+        out: List[ScoreResponse] = []
+        for r, fr, total, fbs in zip(requests, front_resps, totals,
+                                     fallbacks):
+            if total is None:
+                out.append(ScoreResponse(r.uid, None, True, tuple(fbs)))
+            else:
+                out.append(ScoreResponse(r.uid, float(total),
+                                         fr.degraded or bool(fbs),
+                                         tuple(fbs)))
+        return out
+
+    def _supervised_call(self, client: LocalShardClient,
+                         subreqs: List[ScoreRequest],
+                         budget: Optional[float]
+                         ) -> Optional[List[ScoreResponse]]:
+        """One hop with hedging: primary attempt, a second attempt if the
+        primary lags past ``hedge_timeout_s``, first answer wins; None
+        past the budget. Records the hop latency per shard."""
+        cfg = self.config
+        st = self._stats[client.shard_id]
+        t0 = time.monotonic()
+        fut1 = self._pool.submit(client.serve, subreqs)
+        hedge = cfg.hedge_timeout_s
+        first_wait = budget
+        if hedge is not None and (budget is None or hedge < budget):
+            first_wait = hedge
+        try:
+            resps = fut1.result(timeout=first_wait)
+            st.record(time.monotonic() - t0, len(subreqs))
+            return resps
+        except _FutTimeout:
+            pass
+        except Exception:
+            return None
+        if hedge is None or (budget is not None
+                             and time.monotonic() - t0 >= budget):
+            return None
+        # hedge: second attempt races the lagging primary
+        with st.lock:
+            st.hedges += 1
+        _metrics.counter("fleet.hedges",
+                         shard=str(client.shard_id)).inc()
+        fut2 = self._pool.submit(client.serve, subreqs)
+        remaining = None if budget is None \
+            else max(budget - (time.monotonic() - t0), 0.0)
+        end = None if remaining is None else time.monotonic() + remaining
+        while True:
+            for fut in (fut1, fut2):
+                if fut.done():
+                    try:
+                        resps = fut.result()
+                    except Exception:
+                        resps = None
+                    if resps is not None:
+                        st.record(time.monotonic() - t0, len(subreqs))
+                        return resps
+            if fut1.done() and fut2.done():
+                return None
+            if end is not None and time.monotonic() >= end:
+                return None
+            time.sleep(0.0005)
+
+    # -------------------------------------------------------------- ops
+
+    def kill_shard(self, shard_id: int) -> None:
+        self._by_id[shard_id].kill()
+
+    def revive_shard(self, shard_id: int) -> None:
+        self._by_id[shard_id].revive()
+
+    def stats(self) -> dict:
+        """Per-shard view + the merged fleet view. ``merged`` is
+        ``merge_snapshots`` over the per-shard snapshot dicts — the
+        exact aggregation a multi-process fleet ships to its router."""
+        per_shard = {}
+        snaps = []
+        for c in self.clients:
+            st = self._stats[c.shard_id]
+            view = st.view()
+            view["alive"] = c.alive and not chaos.shard_killed(c.shard_id)
+            view["breaker_state"] = c.breaker_state()
+            hr = c.hot_hit_rate()
+            if hr is not None:
+                view["hot_hit_rate"] = round(hr, 4)
+            per_shard[c.shard_id] = view
+            snaps.append(st.snapshot())
+        merged = merge_snapshots(snaps)
+        return {
+            "num_shards": self.num_shards,
+            "coordinates": [cid for cid, _ in self.coordinates],
+            "per_shard": per_shard,
+            "merged": merged,
+            "front_breaker_state": self.front.breaker.state(),
+        }
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.front.shutdown(drain_budget_s=0.0, reason="fleet shutdown")
+        for c in self.clients:
+            c.shutdown()
+        self._pool.shutdown(wait=False)

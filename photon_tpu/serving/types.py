@@ -56,6 +56,12 @@ class FallbackReason(str, enum.Enum):
     #: rows so the next request finds them hot. Never a synchronous
     #: host->device stall on the scoring path.
     COLD_MISS = "cold_miss"
+    #: entity-sharded fleet: the shard owning this request's random-effect
+    #: rows is down, past its deadline, or refusing (breaker open /
+    #: draining) — the fleet returns the fixed-effect margin plus the
+    #: margins of every shard that did answer, with this typed flag per
+    #: unavailable shard. Never a hot-path exception at the router.
+    SHARD_UNAVAILABLE = "shard_unavailable"
 
 
 @dataclasses.dataclass(frozen=True)

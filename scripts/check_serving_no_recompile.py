@@ -552,6 +552,151 @@ def int8_arm(baseline, registry, compile_cache) -> list:
     return failures
 
 
+def fleet_arm(baseline, registry, compile_cache) -> list:
+    """Entity-sharded fleet: the same zero-compile contract must hold
+    per shard UNDER ROUTED TRAFFIC. The fixed-effect front engine and
+    every shard's RE-only engine warm their own (mode x bucket) ladders;
+    after that, routed requests (hot rows, cold-miss promotions through
+    each shard's two-tier store, unknown entities) and a per-shard
+    nearline publish through the fleet publisher must not move any of
+    the three compile monitors on ANY engine in the fleet. The delta
+    trainer's solves and each shard's first publish (scatter staging)
+    compile on first use by design, so one warm train+publish round runs
+    before the monitors are baselined — same shape as the measured
+    round."""
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from photon_tpu.io.fleet_store import build_fleet_dir
+    from photon_tpu.nearline import FleetDeltaPublisher
+    from photon_tpu.nearline.delta_trainer import DeltaTrainer
+    from photon_tpu.serving import (
+        CoeffStoreConfig,
+        FleetConfig,
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+        ShardedServingFleet,
+    )
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="fleet_ck_") as td:
+        import os as _os
+        mdir, fdir = _os.path.join(td, "model"), _os.path.join(td, "fleet")
+        names = build_model_dir(7, mdir)
+        build_fleet_dir(mdir, fdir, 2)
+        fleet = ShardedServingFleet.from_fleet_dir(
+            fdir, FleetConfig(serving=ServingConfig(
+                max_batch=8, max_wait_s=0.0,
+                coeff_store=CoeffStoreConfig(hot_capacity=4,
+                                             transfer_batch=2))))
+        fleet.warmup()
+
+        rng = np.random.default_rng(29)
+
+        def req(uid, n_feats, user):
+            feats = [(str(names[j]), "", float(rng.normal()))
+                     for j in rng.choice(len(names), size=n_feats,
+                                         replace=False)]
+            return ScoreRequest(uid, {"shardA": feats},
+                                {"userId": user} if user else {})
+
+        def event(user, ts):
+            feats = [[str(names[j]), "", float(rng.normal())]
+                     for j in rng.choice(len(names), size=5,
+                                         replace=False)]
+            return {"ts": ts, "response": float(rng.normal()),
+                    "features": {"shardA": feats},
+                    "entities": {"userId": user}}
+
+        def drive(tag):
+            served = 0
+            # every batch size through the router: hot users u0..u4
+            # (split across both shards by the partitioner) + unknown
+            # entities (shard-side typed fallback, never an exception)
+            for round_ in range(2):
+                for n in range(1, fleet.front.ladder.max_batch + 1):
+                    reqs = [req(f"{tag}{round_}-{n}-{i}",
+                                int(rng.integers(0, len(names))),
+                                f"u{i % 5}" if i % 3 else "cold-entity")
+                            for i in range(n)]
+                    for resp in fleet.serve(reqs):
+                        if resp.score is None:
+                            failures.append(
+                                f"fleet dropped a score for {resp.uid}")
+                    served += n
+                for c in fleet.clients:      # cold-miss promotions land
+                    c.engine.model.drain_prefetch()
+            return served
+
+        def publish_round(label, t0):
+            events = [event(f"u{i % 5}", t0 + i) for i in range(10)]
+            delta = trainer.train(events)
+            res = publisher.publish(delta, label)
+            return res
+
+        # warm window: trainer solves + each shard's first publish
+        # (scatter staging) + first routed cold-misses all compile here
+        trainer_engine = ServingEngine.from_model_dir(
+            mdir, config=ServingConfig(max_batch=8, max_wait_s=0.0))
+        trainer_engine.warmup()
+        trainer = DeltaTrainer(trainer_engine, model_dir=mdir)
+        publisher = FleetDeltaPublisher(fleet, fdir)
+        drive("w")
+        warm = publish_round("w1", _time.time())
+        if not warm.accepted:
+            fleet.shutdown()
+            trainer_engine.shutdown()
+            return [f"fleet warm publish rejected: {warm.reason}"]
+        if len(warm.shards) < 2:
+            failures.append(
+                f"fleet warm publish touched {len(warm.shards)} shard(s), "
+                f"expected the partitioner to spread u0..u4 over 2")
+
+        # baseline the three monitors over EVERY engine in the fleet
+        base = compile_cache.compile_counts()
+        misses0 = registry.counter("jitcache.misses").value
+        jitted = _jitted_programs(fleet.front.model, fleet.front.ladder)
+        for c in fleet.clients:
+            jitted += _jitted_programs(c.engine.model, c.engine.ladder)
+        traces0 = [f._cache_size() for f in jitted]
+
+        served = drive("m")
+        live = publish_round("m1", _time.time() + 100)
+        if not live.accepted:
+            failures.append(f"fleet live publish rejected: {live.reason}")
+        served += drive("p")                 # score the published rows
+
+        after = compile_cache.compile_counts()
+        misses1 = registry.counter("jitcache.misses").value
+        traces1 = [f._cache_size() for f in jitted]
+        if after["steady_state"] != base["steady_state"]:
+            failures.append(
+                f"fleet steady-state compiles moved: "
+                f"{base['steady_state']} -> {after['steady_state']}")
+        if misses1 != misses0:
+            failures.append(f"fleet jitcache.misses moved: "
+                            f"{misses0} -> {misses1}")
+        for i, (t0, t1) in enumerate(zip(traces0, traces1)):
+            if t1 > t0:
+                failures.append(f"fleet program {i} re-traced: "
+                                f"_cache_size {t0} -> {t1}")
+        stats = fleet.stats()
+        fleet.shutdown()
+        trainer_engine.shutdown()
+        if not failures:
+            per_shard = {s: v["requests"]
+                         for s, v in stats["per_shard"].items()}
+            print(f"ok: fleet arm served {served} routed over "
+                  f"{stats['num_shards']} shards {per_shard}, live "
+                  f"publish to shards {sorted(live.shards)} "
+                  f"(rows_updated={live.rows_updated}), "
+                  f"steady-state compiles=0")
+    return failures
+
+
 def main() -> int:
     from photon_tpu.obs.metrics import registry
     from photon_tpu.serving.scorer import serving_modes
@@ -659,6 +804,15 @@ def main() -> int:
     if i8_failures:
         print("FAIL: int8 serving compiled:")
         for f in i8_failures:
+            print("  " + f)
+        return 1
+
+    # -- entity-sharded fleet arm: routed traffic + per-shard publishes,
+    # every engine in the fleet stays compile-free
+    fl_failures = fleet_arm(baseline, registry, compile_cache)
+    if fl_failures:
+        print("FAIL: fleet serving compiled:")
+        for f in fl_failures:
             print("  " + f)
         return 1
     print(f"ok: {served} responses over buckets {list(engine.ladder.buckets)}"

@@ -724,3 +724,192 @@ def test_int8_tables_track_publishes_and_rollback():
             assert np.asarray(rs.scales).tobytes() == s_before
         finally:
             engine.shutdown()
+
+
+# -- fleet publish fan-out (FleetDeltaPublisher) -----------------------------
+#
+# The entity-sharded fleet's nearline path: one DeltaPublisher per shard
+# engine, rows routed to their crc-owner only. Contract under test:
+# publish-to-owning-shard is bitwise-identical to publishing the same
+# delta into a single whole-model engine, shards that own none of the
+# rows stay BYTE-identical on disk, per-shard watermarks are durable,
+# and a rejection anywhere rolls every already-committed shard back.
+
+
+def _sha256(path):
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _mk_fleet_pair(td, num_shards=4):
+    """(fleet, fleet_dir, single whole-model engine, names) over the
+    same saved model — the parity pair every fleet-publish test uses."""
+    from photon_tpu.io.fleet_store import build_fleet_dir
+    from photon_tpu.serving import FleetConfig, ShardedServingFleet
+
+    mdir, fdir = os.path.join(td, "m"), os.path.join(td, "f")
+    names = _build_model_dir(7, mdir)
+    build_fleet_dir(mdir, fdir, num_shards)
+    serving = ServingConfig(
+        max_batch=4, max_wait_s=0.0,
+        slo=SLOConfig(shed_queue_depth=60, reject_queue_depth=100),
+        coeff_store=CoeffStoreConfig(hot_capacity=8, transfer_batch=2))
+    fleet = ShardedServingFleet.from_fleet_dir(
+        fdir, FleetConfig(serving=serving))
+    fleet.warmup()
+    single = _mk_engine(mdir, two_tier=True)
+    return fleet, fdir, mdir, single, names
+
+
+def _fleet_drive(fleet, rng, names, users, n=12):
+    for lo in range(0, n, 4):
+        fleet.serve([_mkreq(rng, f"fd{lo}-{i}", names,
+                            users[(lo + i) % len(users)])
+                     for i in range(min(4, n - lo))])
+    for c in fleet.clients:
+        c.engine.model.drain_prefetch()
+
+
+def test_fleet_publish_owning_shard_bitwise_untouched_shards_byte_identical():
+    from photon_tpu.io.fleet_store import shard_store_path
+    from photon_tpu.nearline import FleetDeltaPublisher
+    from photon_tpu.parallel.partition import entity_shard
+
+    with tempfile.TemporaryDirectory(prefix="fleet_pub_") as td:
+        fleet, fdir, mdir, single, names = _mk_fleet_pair(td, 4)
+        try:
+            users = [f"u{e}" for e in range(5)]
+            rng = np.random.default_rng(8)
+            _fleet_drive(fleet, rng, names, users)
+            _drive(single, rng, names, users)
+            # promote every user on both sides so the parity serves
+            # below are hot-path, not cold-tier fallbacks
+            for u in users:
+                fleet.serve([_mkreq(rng, f"warm-f-{u}", names, u)])
+                single.serve([_mkreq(rng, f"warm-s-{u}", names, u)])
+            for c in fleet.clients:
+                c.engine.model.drain_prefetch()
+            single.model.drain_prefetch()
+
+            # delta for u1 + u4: owners are shards 2 and 1 under the
+            # pinned crc hash; shards 0 and 3 must stay byte-identical
+            touched_users = ["u1", "u4"]
+            owners = {entity_shard(u, 4) for u in touched_users}
+            assert owners == {2, 1}
+            ts = time.time()
+            events = [_mkevent(rng, names, u, ts + i)
+                      for i, u in enumerate(touched_users * 3)]
+            trainer = DeltaTrainer(single, model_dir=mdir)
+            delta = trainer.train(events)
+
+            shas = {s: _sha256(shard_store_path(fdir, s, "per-user"))
+                    for s in range(4)}
+            pre = {u: fleet.serve([_mkreq(rng, f"pre-{u}", names, u)])[0]
+                   for u in touched_users}
+            assert all(not r.degraded for r in pre.values())
+
+            pub = FleetDeltaPublisher(fleet, fdir)
+            res = pub.publish(delta, "d1", watermark={"pos": 17})
+            assert res.accepted, res.reason
+            assert set(res.shards) == owners
+            assert res.rows_updated == 2
+
+            # rows landed ONLY in the owning shards' files
+            for s in range(4):
+                now = _sha256(shard_store_path(fdir, s, "per-user"))
+                if s in owners:
+                    assert now != shas[s], f"shard {s} should have rows"
+                else:
+                    assert now == shas[s], f"shard {s} was touched"
+            wm = pub.watermarks()
+            for s in owners:
+                assert wm[s] == {"pos": 17}
+
+            # bitwise parity: the same delta through a single-host
+            # publisher gives byte-equal scores for the touched users
+            sp = DeltaPublisher(single, model_dir=mdir)
+            assert sp.publish(delta, "d1").accepted
+            for u in touched_users:
+                rf = fleet.serve([_mkreq(rng, f"pf-{u}", names, u)])[0]
+                rs = single.serve([_mkreq(rng, f"pf-{u}", names, u)])[0]
+                # identical uid+rng draw order: same features both sides
+                assert not rf.degraded and not rs.degraded
+            rng_f, rng_s = (np.random.default_rng(77) for _ in range(2))
+            for u in touched_users:
+                rf = fleet.serve([_mkreq(rng_f, f"pp-{u}", names, u)])[0]
+                rs = single.serve([_mkreq(rng_s, f"pp-{u}", names, u)])[0]
+                assert np.float32(rf.score).tobytes() \
+                    == np.float32(rs.score).tobytes(), u
+
+            # bitwise rollback per shard: files AND scores return
+            assert pub.rollback_last("test") == sorted(owners)
+            for s in range(4):
+                assert _sha256(shard_store_path(fdir, s, "per-user")) \
+                    == shas[s]
+            rng_a, rng_b = (np.random.default_rng(91) for _ in range(2))
+            post = {u: fleet.serve([_mkreq(rng_a, f"rb-{u}", names, u)])[0]
+                    for u in touched_users}
+            # a fresh fleet over the rolled-back files scores identically
+            # (the rollback healed both the live tables and the disk)
+            from photon_tpu.serving import FleetConfig, ShardedServingFleet
+            fleet2 = ShardedServingFleet.from_fleet_dir(
+                fdir, FleetConfig(serving=ServingConfig(
+                    max_batch=4, max_wait_s=0.0,
+                    coeff_store=CoeffStoreConfig(hot_capacity=8,
+                                                 transfer_batch=2))))
+            fleet2.warmup()
+            try:
+                _fleet_drive(fleet2, np.random.default_rng(8), names,
+                             touched_users)
+                for u in touched_users:
+                    r2 = fleet2.serve(
+                        [_mkreq(rng_b, f"rb-{u}", names, u)])[0]
+                    assert np.float32(post[u].score).tobytes() \
+                        == np.float32(r2.score).tobytes(), u
+            finally:
+                fleet2.shutdown()
+        finally:
+            fleet.shutdown()
+            single.shutdown()
+
+
+def test_fleet_publish_rejection_rolls_back_every_shard():
+    from photon_tpu.io.fleet_store import shard_store_path
+    from photon_tpu.nearline import FleetDeltaPublisher
+
+    with tempfile.TemporaryDirectory(prefix="fleet_rej_") as td:
+        fleet, fdir, mdir, single, names = _mk_fleet_pair(td, 4)
+        try:
+            users = [f"u{e}" for e in range(5)]
+            rng = np.random.default_rng(9)
+            _fleet_drive(fleet, rng, names, users)
+            _drive(single, rng, names, users)
+            ts = time.time()
+            events = [_mkevent(rng, names, u, ts + i)
+                      for i, u in enumerate(["u1", "u4"] * 3)]
+            delta = DeltaTrainer(single, model_dir=mdir).train(events)
+            shas = {s: _sha256(shard_store_path(fdir, s, "per-user"))
+                    for s in range(4)}
+
+            # poison the FIRST shard publish's commit payload: the
+            # readback gate refuses it, and the fleet round must land
+            # on NO shard — all four files stay byte-identical
+            pub = FleetDeltaPublisher(fleet, fdir)
+            with chaos.active(chaos.ChaosConfig(publish_poison_row=True)):
+                res = pub.publish(delta, "bad")
+            assert not res.accepted
+            for s in range(4):
+                assert _sha256(shard_store_path(fdir, s, "per-user")) \
+                    == shas[s], f"shard {s} diverged after rejection"
+
+            # the same publisher recovers: a clean retry lands
+            res2 = pub.publish(delta, "good")
+            assert res2.accepted, res2.reason
+        finally:
+            fleet.shutdown()
+            single.shutdown()
