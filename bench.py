@@ -1917,6 +1917,250 @@ def run_serving_bench(scale: float):
 
 
 # --------------------------------------------------------------------------
+# tenant mode: --mode tenant -> BENCH_TENANT_r01.json
+
+
+def run_tenant_bench(scale: float, quick: bool = False):
+    """Multi-tenant serving benchmark (ISSUE 13). Three segments:
+
+    1. warmup curve N in {1,2,4,8}: same-shape tenants behind one
+       compiled ladder — compile count and warmup wall vs N (asserts
+       the 8-tenant ladder compiles <= 1.1x the 1-tenant program
+       count: tenants 2..N are jitcache hits);
+    2. per-tenant qps/p99 with 4 tenants sharing the host vs a
+       dedicated single-tenant baseline on the same traffic;
+    3. restart cold-start-to-first-score: tracing warmup (cold) vs
+       AOT program-bundle load (warm) after a simulated process
+       restart (jitcache cleared).
+    """
+    import tempfile
+
+    import jax
+
+    from photon_tpu.io.index_map import IndexMapBuilder, feature_key
+    from photon_tpu.io.model_io import (
+        ServingFixedEffect,
+        ServingGameModel,
+        ServingRandomEffect,
+    )
+    from photon_tpu.obs.metrics import registry as _metrics
+    from photon_tpu.serving import (
+        DeviceResidentModel,
+        MultiTenantEngine,
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+        export_program_bundle,
+        load_program_bundle,
+    )
+    from photon_tpu.serving.programs import bundle_dir_for
+    from photon_tpu.types import TaskType
+    from photon_tpu.utils import compile_cache, jitcache
+
+    if quick:
+        d_global, n_users, k_user = 32, 50, 4
+        n_requests, max_batch = 128, 8
+    else:
+        d_global, n_users, k_user = 256, int(2_000 * scale) or 64, 8
+        n_requests, max_batch = int(2_000 * scale) or 64, 64
+    nnz = min(16, d_global // 2)
+    rng = np.random.default_rng(5)
+
+    b = IndexMapBuilder()
+    names = [f"g{j}" for j in range(d_global)]
+    for nm in names:
+        b.put(feature_key(nm, ""))
+    imap = b.build()
+
+    def make_model(seed):
+        r = np.random.default_rng(seed)
+        proj = np.stack([np.sort(r.choice(d_global, size=k_user,
+                                          replace=False))
+                         for _ in range(n_users)]).astype(np.int32)
+        return ServingGameModel(
+            TaskType.LOGISTIC_REGRESSION,
+            [ServingFixedEffect("fixed", "global",
+                                r.normal(size=d_global).astype(np.float32))],
+            [ServingRandomEffect(
+                "per_user", "userId", "global",
+                r.normal(size=(n_users, k_user)).astype(np.float32), proj,
+                {f"u{e}": e for e in range(n_users)})],
+            {"global": imap}, {})
+
+    config = ServingConfig(max_batch=max_batch, max_wait_s=0.001)
+
+    def _misses():
+        return _metrics.counter("jitcache.misses").value
+
+    def make_request(i, tenant=None):
+        cols = rng.choice(d_global, size=nnz, replace=False)
+        user = f"u{int(rng.integers(0, n_users))}" if i % 10 else "cold"
+        return ScoreRequest(
+            f"q{i}", {"global": [(names[c], "", float(rng.normal()))
+                                 for c in cols]},
+            {"userId": user}, tenant=tenant)
+
+    # -- segment 1: warmup compile/wall curve over N same-shape tenants
+    curve = []
+    for n_tenants in (1, 2, 4, 8):
+        jitcache.clear()
+        c0 = dict(compile_cache.compile_counts())
+        m0 = _misses()
+        t0 = time.perf_counter()
+        mte = MultiTenantEngine(config=config)
+        for t in range(n_tenants):
+            mte.add_tenant(f"t{t}", DeviceResidentModel(make_model(t)))
+        wall = time.perf_counter() - t0
+        c1 = compile_cache.compile_counts()
+        curve.append({
+            "tenants": n_tenants,
+            "warmup_wall_s": round(wall, 3),
+            "programs_compiled": int(c1["warmup"] - c0["warmup"]),
+            "programs_traced": int(_misses() - m0),
+        })
+        mte.shutdown(drain_budget_s=0.0)
+    one, eight = curve[0]["programs_compiled"], curve[-1]["programs_compiled"]
+    shared_ladder_ok = one > 0 and eight * 10 <= one * 11   # <= 1.1x
+    assert shared_ladder_ok, (
+        f"8-tenant warmup compiled {eight} programs, expected <= 1.1x the "
+        f"single-tenant {one} (shape-keyed program sharing is broken)")
+    log(f"tenant: warmup curve {[(c['tenants'], c['programs_compiled']) for c in curve]} "
+        f"(8 tenants compile {eight}/{one} = {eight / one:.2f}x of 1)")
+
+    # -- segment 2: per-tenant qps/p99 vs dedicated single-tenant baseline
+    jitcache.clear()
+    dedicated = ServingEngine(DeviceResidentModel(make_model(0)), config)
+    dedicated.warmup()
+    requests = [make_request(i) for i in range(n_requests)]
+    t0 = time.perf_counter()
+    done = 0
+    for r in requests:
+        dedicated.submit(r)
+        done += len(dedicated.pump())
+    done += len(dedicated.drain())
+    base_elapsed = time.perf_counter() - t0
+    base_qps = done / base_elapsed
+    base_p99 = dedicated.stats()["latency_seconds"].get(
+        "total", {}).get("p99")
+
+    n_host = 4
+    mte = MultiTenantEngine(config=config)
+    for t in range(n_host):
+        mte.add_tenant(f"t{t}", DeviceResidentModel(make_model(t)))
+    tenant_reqs = [make_request(i, tenant=f"t{i % n_host}")
+                   for i in range(n_requests)]
+    # per-tenant latency measured client-side (submit -> response wall):
+    # the engine-side stage histograms are process-global, so tenant
+    # attribution has to come from the tagged responses themselves
+    t0 = time.perf_counter()
+    done_mt = 0
+    submit_at, lat_by_tenant = {}, {f"t{t}": [] for t in range(n_host)}
+
+    def _take(resps):
+        n = 0
+        for resp in resps:
+            n += 1
+            if resp.tenant in lat_by_tenant and resp.uid in submit_at:
+                lat_by_tenant[resp.tenant].append(
+                    time.perf_counter() - submit_at[resp.uid])
+        return n
+
+    for r in tenant_reqs:
+        submit_at[r.uid] = time.perf_counter()
+        rejected = mte.submit(r)
+        done_mt += _take([rejected] if rejected is not None else [])
+        done_mt += _take(mte.pump())
+    done_mt += _take(mte.drain())
+    mt_elapsed = time.perf_counter() - t0
+    per_tenant = {}
+    for name in sorted(lat_by_tenant):
+        lats = lat_by_tenant[name]
+        per_tenant[name] = {
+            "requests": len(lats),
+            "qps": round(len(lats) / mt_elapsed, 1),
+            "p99_s": (round(float(np.percentile(lats, 99)), 6)
+                      if lats else None),
+        }
+    mt_qps = done_mt / mt_elapsed
+    log(f"tenant: {n_host}-tenant host {mt_qps:.0f} qps aggregate vs "
+        f"dedicated {base_qps:.0f} qps")
+
+    # -- segment 3: restart cold-start-to-first-score, cold vs warm
+    def first_score_wall(warm_dir=None):
+        """Simulated replica restart: empty program cache, then
+        (optionally) bundle load + warmup + one scored request."""
+        jitcache.clear()
+        model = DeviceResidentModel(make_model(0))
+        t0 = time.perf_counter()
+        loaded = 0
+        if warm_dir is not None:
+            got = load_program_bundle(model, _buckets, warm_dir)
+            loaded = got["loaded"]
+            assert got["refused"] is None, got
+        eng = ServingEngine(model, config)
+        eng.warmup()
+        warm_done = time.perf_counter()
+        resp = eng.serve([make_request(0)])[0]
+        assert resp.score is not None
+        total = time.perf_counter() - t0
+        return {"to_first_score_s": round(total, 3),
+                "warmup_s": round(warm_done - t0, 3),
+                "bundled_programs": loaded}
+
+    _buckets = dedicated.ladder.buckets
+    with tempfile.TemporaryDirectory(prefix="tenant_bench_") as td:
+        bdir = bundle_dir_for(td, dedicated.model)
+        exported = export_program_bundle(dedicated.model, _buckets, bdir)
+        cold = first_score_wall()
+        warm = first_score_wall(warm_dir=bdir)
+    c_after = compile_cache.compile_counts()
+    log(f"tenant: cold start {cold['to_first_score_s']}s vs warm "
+        f"(AOT bundle) {warm['to_first_score_s']}s to first score")
+
+    rec = {
+        "metric": "tenant_warmup_compile_ratio_8x_vs_1x",
+        "value": round(eight / one, 3),
+        "unit": "x_single_tenant_programs",
+        "shared_ladder_ok": shared_ladder_ok,
+        "warmup_curve": curve,
+        "single_tenant_baseline": {
+            "qps": round(base_qps, 1),
+            "p99_s": base_p99,
+            "requests": done,
+        },
+        "multi_tenant": {
+            "tenants": n_host,
+            "aggregate_qps": round(mt_qps, 1),
+            "per_tenant": per_tenant,
+            "requests": done_mt,
+        },
+        "restart": {
+            "cold_tracing": cold,
+            "warm_program_bundle": warm,
+            "bundle_exported_programs": exported["exported"],
+            "speedup_x": round(cold["to_first_score_s"]
+                               / max(warm["to_first_score_s"], 1e-9), 2),
+        },
+        "model": {"d_global": d_global, "n_users": n_users,
+                  "k_user": k_user, "nnz_per_request": nnz,
+                  "max_batch": max_batch},
+        "compile_counts": c_after,
+        "quick": quick,
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "tpu_unavailable": _STATE["tpu_unavailable"],
+    }
+    if not quick:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_TENANT_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"tenant: compile ratio {rec['value']}x, restart speedup "
+        f"{rec['restart']['speedup_x']}x")
+    return rec
+
+
+# --------------------------------------------------------------------------
 # coldtier mode: --mode coldtier -> BENCH_COLDTIER_r01.json
 # --------------------------------------------------------------------------
 
@@ -3634,7 +3878,8 @@ def main():
                     help="comma-separated subset of config names")
     ap.add_argument("--mode", default=os.environ.get("BENCH_MODE", "train"),
                     choices=("train", "serving", "game_cd", "coldtier",
-                             "nearline", "hier", "fused", "stream", "fleet"),
+                             "nearline", "hier", "fused", "stream", "fleet",
+                             "tenant"),
                     help="train = the solver configs (default); serving = "
                          "the online-serving bench -> BENCH_SERVING_r01.json; "
                          "game_cd = parallel-vs-sequential CD sweeps "
@@ -3650,10 +3895,13 @@ def main():
                          "streamed vs resident training "
                          "-> BENCH_STREAM_r01.json; fleet = entity-sharded "
                          "serving fleet aggregate-qps scaling "
-                         "-> BENCH_FLEET_r01.json")
+                         "-> BENCH_FLEET_r01.json; tenant = multi-tenant "
+                         "shared-ladder warmup curve + AOT cold start "
+                         "-> BENCH_TENANT_r01.json")
     ap.add_argument("--quick", action="store_true",
-                    help="game_cd/coldtier/nearline/hier/fused/stream/fleet: "
-                         "tiny tier-1 smoke shape (no artifact write)")
+                    help="game_cd/coldtier/nearline/hier/fused/stream/"
+                         "fleet/tenant: tiny tier-1 smoke shape (no "
+                         "artifact write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -3727,6 +3975,22 @@ def main():
             emit({"metric": "fleet_aggregate_qps_speedup", "value": 0.0,
                   "unit": "x_single_host", "error": repr(e)})
         _DONE.set()     # fleet mode: the record above IS the summary
+        return
+
+    if args.mode == "tenant":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/tenant"):
+                emit(run_tenant_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"tenant bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "tenant_warmup_compile_ratio_8x_vs_1x",
+                  "value": 0.0, "unit": "x_single_tenant_programs",
+                  "error": repr(e)})
+        _DONE.set()     # tenant mode: the record above IS the summary
         return
 
     if args.mode == "coldtier":

@@ -116,6 +116,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "deviate more than this (default: finite-only)")
     p.add_argument("--swap-require-manifest", action="store_true",
                    help="refuse swap candidates without swap-manifest.json")
+    p.add_argument("--tenant", action="append", default=None,
+                   metavar="NAME=MODEL_DIR",
+                   help="host NAME's model from MODEL_DIR as one tenant "
+                        "behind a shared compiled ladder (repeatable; "
+                        "requests route by their \"tenant\" field; the "
+                        "first tenant is the default route)")
+    p.add_argument("--tenant-admission-budget", type=int, default=None,
+                   help="per-tenant queued-depth cap; beyond it a tenant "
+                        "gets typed TENANT_BUDGET_EXCEEDED refusals "
+                        "while its neighbors are unaffected")
+    p.add_argument("--program-cache", default=None, metavar="DIR",
+                   help="AOT program-bundle directory (serving/programs): "
+                        "load before warmup for a zero-trace zero-compile "
+                        "cold start; export after warmup when nothing "
+                        "loadable was found")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip ladder pre-compilation (debugging only; "
                         "steady-state requests will compile)")
@@ -163,6 +178,10 @@ def build_engine(args: argparse.Namespace):
                                   else float("inf")),
             require_manifest=args.swap_require_manifest),
         drain_budget_s=args.drain_budget_s)
+    if args.tenant:
+        if args.fleet_manifest is not None:
+            raise SystemExit("--tenant and --fleet-manifest are exclusive")
+        return _build_multi_tenant(args, config)
     if args.fleet_manifest is not None:
         if args.shard_id is None:
             raise SystemExit("--fleet-manifest requires --shard-id")
@@ -181,10 +200,61 @@ def build_engine(args: argparse.Namespace):
         engine = ServingEngine.from_model_dir(
             args.model_input_directory, config=config,
             coordinates_to_load=args.coordinates)
+    loaded = 0
+    if args.program_cache:
+        from photon_tpu.serving import load_program_bundle
+        from photon_tpu.serving.programs import bundle_dir_for
+        bdir = bundle_dir_for(args.program_cache, engine.model)
+        got = load_program_bundle(engine.model, engine.ladder.buckets, bdir)
+        loaded = got["loaded"]
+        logger.info("program cache: %s",
+                    f"seeded {loaded} programs from {bdir}" if loaded
+                    else f"refused ({got['refused']}) — tracing warmup")
     if not args.no_warmup:
         info = engine.warmup()
         logger.info("warmed %d programs over buckets %s in %.2fs",
                     info["programs"], info["buckets"], info["seconds"])
+        if args.program_cache and not loaded:
+            from photon_tpu.serving import export_program_bundle
+            out = export_program_bundle(engine.model, engine.ladder.buckets,
+                                        bdir)
+            logger.info("program cache: exported %d programs to %s",
+                        out["exported"], out["dir"])
+    return engine
+
+
+def _build_multi_tenant(args: argparse.Namespace, config):
+    """``--tenant NAME=DIR`` (repeated) -> a MultiTenantEngine: N models,
+    one compiled bucket ladder, per-tenant isolation. With
+    ``--program-cache`` the shared ladder loads from (or seeds) the AOT
+    bundle, so a restarted replica warms N tenants with zero compiles."""
+    from photon_tpu.serving import MultiTenantEngine
+
+    engine = MultiTenantEngine(config=config)
+    for spec in args.tenant:
+        name, sep, model_dir = spec.partition("=")
+        if not sep or not name or not model_dir:
+            raise SystemExit(f"--tenant expects NAME=MODEL_DIR, got {spec!r}")
+        engine.add_tenant_from_dir(
+            name, model_dir, admission_budget=args.tenant_admission_budget,
+            warm=False)
+    loads = {}
+    if args.program_cache:
+        loads = engine.load_program_bundles(args.program_cache)
+        for name, got in loads.items():
+            logger.info("program cache [%s]: %s", name,
+                        f"seeded {got['loaded']}" if got["loaded"]
+                        else f"refused ({got['refused']})")
+    if not args.no_warmup:
+        info = engine.warmup()
+        logger.info("warmed %d tenants: %d programs, compile counts %s",
+                    len(info["tenants"]), info["programs"],
+                    info["compile_counts"])
+        if args.program_cache and not any(
+                got.get("loaded", 0) for got in loads.values()):
+            out = engine.export_program_bundles(args.program_cache)
+            logger.info("program cache: exported %s",
+                        {k: v["exported"] for k, v in out.items()})
     return engine
 
 
@@ -208,20 +278,72 @@ def _start_reader(stdin) -> "queue.Queue":
 
 
 def _handle_control(engine, obj: dict) -> dict:
-    """Operator control line -> one response dict."""
+    """Operator control line -> one response dict. With a multi-tenant
+    engine, ``swap`` takes an optional ``tenant`` (default tenant
+    otherwise) and the canary verbs manage a tenant's A/B arm."""
     from photon_tpu.serving import swap_from_dir
 
     cmd = obj.get("control")
+    tenants = getattr(engine, "tenants", None)  # MultiTenantEngine?
+
+    def _named_tenant():
+        name = obj.get("tenant") or engine.default_tenant
+        return name, tenants.get(name)
+
     if cmd == "swap":
         model_dir = obj.get("model_dir")
         if not model_dir:
             return {"control": "swap", "ok": False,
                     "error": "missing model_dir"}
-        result = swap_from_dir(engine, str(model_dir),
+        target = engine
+        if tenants is not None:
+            name, st = _named_tenant()
+            if st is None:
+                return {"control": "swap", "ok": False,
+                        "error": f"unknown tenant {name!r}"}
+            target = st.engine
+        result = swap_from_dir(target, str(model_dir),
                                label=obj.get("label"))
         out = {"control": "swap", "ok": result.accepted}
         out.update(result.to_json())
         return out
+    if cmd == "canary":
+        if tenants is None:
+            return {"control": "canary", "ok": False,
+                    "error": "canary requires multi-tenant mode (--tenant)"}
+        model_dir = obj.get("model_dir")
+        if not model_dir:
+            return {"control": "canary", "ok": False,
+                    "error": "missing model_dir"}
+        name, st = _named_tenant()
+        if st is None:
+            return {"control": "canary", "ok": False,
+                    "error": f"unknown tenant {name!r}"}
+        from photon_tpu.io.model_io import load_for_serving
+        try:
+            result = engine.start_canary(
+                name, load_for_serving(str(model_dir)),
+                obj.get("label") or "canary",
+                float(obj.get("fraction", 0.05)))
+        except (ValueError, RuntimeError, OSError) as e:
+            return {"control": "canary", "ok": False, "error": repr(e)}
+        out = {"control": "canary", "ok": result.accepted, "tenant": name}
+        out.update(result.to_json())
+        return out
+    if cmd in ("promote_canary", "abort_canary"):
+        if tenants is None:
+            return {"control": cmd, "ok": False,
+                    "error": f"{cmd} requires multi-tenant mode (--tenant)"}
+        name, st = _named_tenant()
+        if st is None:
+            return {"control": cmd, "ok": False,
+                    "error": f"unknown tenant {name!r}"}
+        try:
+            info = (engine.promote_canary(name) if cmd == "promote_canary"
+                    else engine.abort_canary(name))
+        except RuntimeError as e:
+            return {"control": cmd, "ok": False, "error": repr(e)}
+        return {"control": cmd, "ok": True, "tenant": name, **info}
     if cmd == "drain":
         engine.begin_drain("operator drain control line")
         return {"control": "drain", "ok": True}

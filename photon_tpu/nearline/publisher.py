@@ -612,13 +612,14 @@ class DeltaPublisher:
                     bucket = engine.ladder.bucket_for(len(chunk))
                     with model.transfer_lock:
                         args, _fb, _c = model.assemble(chunk, bucket)
+                        thetas = model.current_thetas()
                         tables = list(model.current_tables())
                         live = np.asarray(get_scorer(model, "full", bucket)(
-                            *args, tuple(tables)))[:len(chunk)]
+                            *args, thetas, tuple(tables)))[:len(chunk)]
                         for p in plans:
                             tables[cid_pos[p.cid]] = staged[p.cid][0]
                         cand = np.asarray(get_scorer(model, "full", bucket)(
-                            *args, tuple(tables)))[:len(chunk)]
+                            *args, thetas, tuple(tables)))[:len(chunk)]
                     for j, r in enumerate(chunk):
                         want = self._expected_delta(r, plans, hot_slots)
                         devs.append(abs(float(cand[j] - live[j]) - want))

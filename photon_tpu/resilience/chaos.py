@@ -155,6 +155,14 @@ class ChaosConfig:
     shard_slow_id: Optional[int] = None
     shard_slow_s: float = 0.0
     shard_slow_requests: int = 0
+    # multi-tenant serving: the named tenant turns into a noisy neighbor
+    # — every real submit for it fans out into tenant_hot_loop_burst
+    # extra flood requests (duplicates of the same payload), up to
+    # tenant_hot_loop_total injected floods. The tenant's own admission
+    # budget must absorb the flood; other tenants' tails stay bounded.
+    tenant_hot_loop: Optional[str] = None
+    tenant_hot_loop_burst: int = 0
+    tenant_hot_loop_total: int = 0
 
 
 class _State:
@@ -174,6 +182,7 @@ class _State:
         self.chunk_read_errors_done = 0
         self.stream_kill_fired = False
         self.shard_slow_done = 0
+        self.tenant_floods_done = 0
 
 
 _active: Optional[_State] = None
@@ -526,3 +535,47 @@ def at_publish(op: str) -> None:
         s.kill_fired = True
     raise SimulatedKill(f"chaos: killed publish of {op!r} between "
                         f"tmp-write and rename")
+
+
+def tenant_flood_burst(tenant: str) -> int:
+    """Multi-tenant noisy neighbor: how many flood duplicates to inject
+    for this submit of ``tenant``. Zero for every other tenant and once
+    the configured flood total is spent — the injector stresses one
+    tenant's admission path, deterministically, without a load
+    generator."""
+    s = _active
+    if s is None or s.config.tenant_hot_loop != tenant \
+            or s.config.tenant_hot_loop_burst <= 0:
+        return 0
+    with s.lock:
+        left = s.config.tenant_hot_loop_total - s.tenant_floods_done
+        n = max(0, min(s.config.tenant_hot_loop_burst, left))
+        s.tenant_floods_done += n
+    return n
+
+
+def program_cache_corrupt(bundle_dir: str, seed: int = 0) -> str:
+    """Deterministically bit-flip one byte of one serialized program in
+    an AOT program bundle (file and offset chosen by crc32(seed)) — the
+    silent-media-corruption signature, aimed at the executable payloads
+    the loader would map into the process. The bundle loader's crc gate
+    must refuse the WHOLE bundle and fall back to tracing warmup: a
+    corrupt executable may never produce a score. Returns the corrupted
+    file's path."""
+    import json as _json
+    import os
+
+    with open(os.path.join(bundle_dir, "bundle-manifest.json")) as f:
+        names = sorted(_json.load(f)["programs"])
+    if not names:
+        raise ValueError(f"no programs in bundle {bundle_dir!r}")
+    name = names[zlib.crc32(str(seed).encode()) % len(names)]
+    path = os.path.join(bundle_dir, name)
+    size = os.path.getsize(path)
+    offset = zlib.crc32(f"{seed}-offset".encode()) % size
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return path

@@ -26,6 +26,14 @@ change:
     gate ladder (crc manifest, finiteness, shadow parity, zero
     steady-state compiles) and publishes atomically between
     micro-batches, with automatic rollback on a post-swap breaker trip.
+
+Multi-tenant serving (ISSUE 13) makes the compiled programs a shared
+resource: scorer executables are keyed by the model's SHAPE signature
+(parameters are arguments), so a :class:`MultiTenantEngine` hosts N
+same-shape tenants behind one compiled ladder with per-tenant admission
+budgets, breakers, and canary/A-B splitting — and serving/programs.py
+AOT-exports the warmed ladder to a crc32-verified bundle a restarted
+replica loads for a zero-trace, zero-compile cold start.
 """
 
 from photon_tpu.serving.batching import (
@@ -42,7 +50,12 @@ from photon_tpu.serving.fleet import (
     ShardedServingFleet,
 )
 from photon_tpu.serving.model_state import DeviceResidentModel
+from photon_tpu.serving.programs import (
+    export_program_bundle,
+    load_program_bundle,
+)
 from photon_tpu.serving.scorer import MODES, get_scorer, warmup_scorers
+from photon_tpu.serving.tenants import MultiTenantEngine
 from photon_tpu.serving.swap import (
     SwapResult,
     swap_from_dir,
@@ -78,6 +91,7 @@ __all__ = [
     "LATENCY_BUCKETS",
     "MODES",
     "MicroBatcher",
+    "MultiTenantEngine",
     "QueueClosedError",
     "ScoreRequest",
     "ScoreResponse",
@@ -87,7 +101,9 @@ __all__ = [
     "SwapConfig",
     "SwapResult",
     "TwoTierCoeffStore",
+    "export_program_bundle",
     "get_scorer",
+    "load_program_bundle",
     "serving_report_section",
     "swap_from_dir",
     "swap_staged",

@@ -74,9 +74,15 @@ class ServingEngine:
 
     def __init__(self, model: DeviceResidentModel,
                  config: Optional[ServingConfig] = None,
-                 clock=None):
+                 clock=None, obs_labels: Optional[dict] = None):
         self.model = model
         self.config = config or ServingConfig()
+        # metric labels distinguishing this engine in a multi-engine
+        # process (tenant=... in a MultiTenantEngine, shard=... in a
+        # fleet) — without them every engine overwrites the same plain
+        # warmup gauges; with them the per-engine values survive
+        # ``obs.merge_snapshots`` as distinct labeled keys
+        self.obs_labels = dict(obs_labels or {})
         self.ladder = BucketLadder(self.config.max_batch,
                                    self.config.min_bucket)
         self.batcher = MicroBatcher(
@@ -117,7 +123,8 @@ class ServingEngine:
     def from_model_dir(cls, model_dir: str,
                        config: Optional[ServingConfig] = None,
                        mesh=None, clock=None,
-                       coordinates_to_load=None) -> "ServingEngine":
+                       coordinates_to_load=None,
+                       obs_labels: Optional[dict] = None) -> "ServingEngine":
         from photon_tpu.io.model_io import load_for_serving
 
         serving_model = load_for_serving(
@@ -131,7 +138,7 @@ class ServingEngine:
                                                     if config else 0),
                                     int8=(config.int8_serving
                                           if config else False))
-        return cls(model, config=config, clock=clock)
+        return cls(model, config=config, clock=clock, obs_labels=obs_labels)
 
     def _prefetch_lookahead(self, request: ScoreRequest) -> None:
         """MicroBatcher ``on_admit`` hook: resolve the request's entities
@@ -167,8 +174,10 @@ class ServingEngine:
                                                self.ladder.buckets)
         self._warmup_seconds = time.perf_counter() - t0
         self._warmed = True
-        _metrics.gauge("serving.warmup_seconds").set(self._warmup_seconds)
-        _metrics.gauge("serving.warmup_programs").set(self._warmup_programs)
+        _metrics.gauge("serving.warmup_seconds",
+                       **self.obs_labels).set(self._warmup_seconds)
+        _metrics.gauge("serving.warmup_programs",
+                       **self.obs_labels).set(self._warmup_programs)
         return {"programs": self._warmup_programs,
                 "buckets": list(self.ladder.buckets),
                 "modes": list(serving_modes(self.model)),
@@ -302,7 +311,8 @@ class ServingEngine:
                 if delay > 0:
                     time.sleep(delay)
                 raw = get_scorer(model, mode, bucket)(
-                    *args, tables_for_mode(model, mode))
+                    *args, model.current_thetas(),
+                    tables_for_mode(model, mode))
             except Exception as e:  # device/dispatch fault: typed, counted
                 scorer_ok = False
                 record_failure("serving_scorer_error", error=repr(e),

@@ -248,7 +248,7 @@ def build_front_engine(manifest: dict, config: FleetConfig,
                                    base.index_maps, base.metadata)
     return ServingEngine(
         DeviceResidentModel(front_model, feature_pad=front_cfg.feature_pad),
-        front_cfg)
+        front_cfg, obs_labels={"shard": "front"})
 
 
 def build_shard_engine(fleet_dir: str, shard_id: int,
@@ -283,7 +283,7 @@ def build_shard_engine(fleet_dir: str, shard_id: int,
     return ServingEngine(
         DeviceResidentModel(m, feature_pad=serving.feature_pad,
                             coeff_store=serving.coeff_store),
-        serving)
+        serving, obs_labels={"shard": str(shard_id)})
 
 
 class ShardedServingFleet:

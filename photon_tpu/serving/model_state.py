@@ -122,6 +122,7 @@ class DeviceResidentModel:
         self.dtype = dtype or jnp.float32
         self.token = f"servmodel-{next(_model_counter)}"
         self.mesh = mesh
+        self._shape_sig: Optional[tuple] = None
         #: int8 serving arm requested: full-resident coordinates carry a
         #: (coef_q, scales) mirror and "full_int8" programs are warmed
         self.int8_enabled = bool(int8)
@@ -226,6 +227,59 @@ class DeviceResidentModel:
         return tuple(rs.store.table if rs.store is not None
                      else (rs.coef_q, rs.scales)
                      for rs in self.random)
+
+    def current_thetas(self) -> tuple:
+        """The fixed-effect coefficient vectors the scorer takes as
+        arguments — one device array per fixed coordinate, in coordinate
+        order. Passing them as arguments (not closures) is what lets N
+        same-shape tenants dispatch ONE compiled program: same
+        shape/dtype arguments re-dispatch with zero retraces, exactly
+        the random-effect tables' calling convention."""
+        return tuple(f.theta for f in self.fixed)
+
+    def shape_signature(self) -> tuple:
+        """Canonical shape signature: everything a scorer trace depends
+        on EXCEPT the parameter values — feature-shard pads, fixed
+        coordinate positions and theta shapes/dtypes, random-effect
+        table shapes (two-tier hot capacity or full-resident rows),
+        int8 mirrors, compute dtype, and mesh layout. Two models with
+        equal signatures produce bitwise-identical traces, so compiled
+        (mode, bucket) programs are keyed by this signature instead of
+        ``model.token`` and shared across tenants. Stable for a model's
+        lifetime: two-tier transfers swap table *objects* at fixed
+        shape, and nearline appends spend pre-reserved rows."""
+        if self._shape_sig is not None:
+            return self._shape_sig
+
+        def _dt(x) -> str:
+            return np.dtype(getattr(x, "dtype", x)).name
+
+        mesh_tok = None
+        if self.mesh is not None:
+            mesh_tok = (tuple(str(a) for a in self.mesh.axis_names),
+                        tuple(int(s) for s in self.mesh.devices.shape),
+                        tuple(int(d.id) for d in self.mesh.devices.flat))
+        shard_pos = {sid: i for i, sid in enumerate(self.shard_order)}
+        fixed_sig = tuple(
+            (shard_pos[f.feature_shard_id],
+             tuple(int(s) for s in f.theta.shape), _dt(f.theta))
+            for f in self.fixed)
+        rand_sig = []
+        for rs in self.random:
+            table = rs.store.table if rs.store is not None else rs.coef
+            entry = (shard_pos[rs.feature_shard_id], int(rs.slot_width),
+                     tuple(int(s) for s in table.shape), _dt(table),
+                     rs.store is not None)
+            if rs.coef_q is not None:
+                entry += (tuple(int(s) for s in rs.coef_q.shape),
+                          _dt(rs.coef_q),
+                          tuple(int(s) for s in rs.scales.shape))
+            rand_sig.append(entry)
+        self._shape_sig = (
+            "servshape", _dt(self.dtype), int(self.int8_enabled), mesh_tok,
+            tuple(int(self.shard_pad[sid]) for sid in self.shard_order),
+            fixed_sig, tuple(rand_sig))
+        return self._shape_sig
 
     def prefetch_request(self, request: ScoreRequest,
                          skip: frozenset = frozenset()) -> None:

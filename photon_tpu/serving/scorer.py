@@ -1,15 +1,20 @@
 """Compiled batch scorers for the serving engine.
 
-One jitted program per (model, mode, bucket): the program closes over
-the device-resident FIXED-effect arrays (static for a model's lifetime,
-baked into the executable) but takes the random-effect gather tables as
-explicit arguments. Tables must be arguments, not closures, because the
-two-tier coefficient store (serving/coeff_store.py) replaces a
-coordinate's hot table object on every cold->hot transfer (the donated
-scatter produces a new array); same-shape/dtype arguments re-dispatch
-the cached executable with zero retraces, where a closure would either
-go stale or force a steady-state recompile. Fully-resident coordinates
-pass the same table every call — one calling convention for both tiers.
+One jitted program per (shape-signature, mode, bucket): EVERY model
+parameter — the fixed-effect theta vectors and the random-effect gather
+tables alike — is a program *argument*, never a closure. Tables had to
+be arguments from the start, because the two-tier coefficient store
+(serving/coeff_store.py) replaces a coordinate's hot table object on
+every cold->hot transfer (the donated scatter produces a new array);
+same-shape/dtype arguments re-dispatch the cached executable with zero
+retraces, where a closure would either go stale or force a steady-state
+recompile. The fixed-effect thetas now ride the same donation-safe
+calling convention, which removes the last model-specific bake-in: the
+jitcache key is ``model.shape_signature()`` (feature pads, theta
+shapes, RE table shapes, dtypes, int8, mesh) instead of
+``model.token``, so N same-shape tenants share ONE compiled bucket
+ladder — tenant #2..N warm at near-zero compile cost, and a failed-over
+replica can reuse an AOT-exported program bundle (serving/programs.py).
 
 The math is the offline ``game/scoring.GameScorer`` expressions verbatim
 — fixed effects as a gathered dot over padded (index, value) pairs,
@@ -67,12 +72,16 @@ def serving_modes(model: DeviceResidentModel) -> Tuple[str, ...]:
     return MODES
 
 
-def _fused_fixed_margin(model: DeviceResidentModel, thetas, fixed_pos):
+def _fused_fixed_margin(mesh_local: bool, dtype, theta_dims, theta_dtypes,
+                        fixed_pos, k_total: int):
     """Build-time routing for the fixed-effect term: returns a
-    ``fn(fixed_idx, fixed_val, offsets) -> [B]`` using the fused Pallas
-    gather+margin kernel when the env flag asks for it and the shapes
-    qualify, else None (XLA expressions). Counted per compiled program
-    into ``kernels.pallas_hits`` / ``kernels.xla_fallbacks`` with
+    ``fn(fixed_idx, fixed_val, offsets, thetas) -> [B]`` using the fused
+    Pallas gather+margin kernel when the env flag asks for it and the
+    shapes qualify, else None (XLA expressions). Routing runs on static
+    shape facts only (so the decision is a pure function of the scorer's
+    shape key); the theta concatenation happens inside the trace, since
+    thetas are now program arguments. Counted per compiled program into
+    ``kernels.pallas_hits`` / ``kernels.xla_fallbacks`` with
     ``path="serving"`` — same telemetry contract as the training
     kernels (ops/aggregators.py)."""
     if os.environ.get("PHOTON_TPU_PALLAS_SERVING") != "1":
@@ -83,13 +92,10 @@ def _fused_fixed_margin(model: DeviceResidentModel, thetas, fixed_pos):
     from photon_tpu.ops.aggregators import (_kernel_counter,
                                             _warn_kernel_refused)
 
-    k_total = sum(int(model.shard_pad[model.shard_order[p]])
-                  for p in fixed_pos)
-    dims = [int(t.shape[0]) for t in thetas]
-    ok = (model.mesh is None and model.dtype == jnp.float32
-          and len(thetas) > 0
-          and all(t.dtype == jnp.float32 for t in thetas)
-          and sum(dims) <= pallas_glm._MAX_SPARSE_DIM
+    ok = (mesh_local and dtype == jnp.float32
+          and len(theta_dims) > 0
+          and all(dt == "float32" for dt in theta_dtypes)
+          and sum(theta_dims) <= pallas_glm._MAX_SPARSE_DIM
           and k_total >= 1
           and not pallas_glm._TRACE_DISABLED.get())
     if not ok:
@@ -98,12 +104,13 @@ def _fused_fixed_margin(model: DeviceResidentModel, thetas, fixed_pos):
             _warn_kernel_refused("serving")
         return None
     _kernel_counter("pallas_hits", "serving")
-    theta_all = jnp.concatenate([t.astype(jnp.float32) for t in thetas])
     col_off = [0]
-    for d in dims[:-1]:
+    for d in theta_dims[:-1]:
         col_off.append(col_off[-1] + d)
 
-    def fn(fixed_idx, fixed_val, offsets):
+    def fn(fixed_idx, fixed_val, offsets, thetas):
+        theta_all = jnp.concatenate(
+            [t.astype(jnp.float32) for t in thetas])
         idx = jnp.concatenate(
             [fixed_idx[p] + col_off[j] for j, p in enumerate(fixed_pos)],
             axis=1)
@@ -114,37 +121,53 @@ def _fused_fixed_margin(model: DeviceResidentModel, thetas, fixed_pos):
     return fn
 
 
-def get_scorer(model: DeviceResidentModel, mode: str,
-               bucket: int) -> Callable:
-    """Compiled scorer for one (model, mode, bucket); cached process-wide.
+def program_key(model: DeviceResidentModel, mode: str,
+                bucket: int) -> tuple:
+    """The jitcache key one (mode, bucket) scorer program lives under —
+    shape-generic: equal for any model with the same
+    ``shape_signature()``, so same-shape tenants resolve to one compiled
+    program. The Pallas env flag is part of the key because it is read
+    at build time and changes the traced computation."""
+    return ("serving_scorer", mode, int(bucket), model.shape_signature(),
+            os.environ.get("PHOTON_TPU_PALLAS_SERVING") == "1")
 
-    Call as ``fn(*args, re_tables)`` where ``args`` is the assemble
-    output and ``re_tables`` is ``model.current_tables()`` — or
-    ``model.current_tables_int8()`` for the "full_int8" mode — read
-    inside the same ``model.transfer_lock`` hold as the assemble (the
-    two-tier store's consistency contract).
-    """
+
+def build_scorer_fn(model: DeviceResidentModel, mode: str,
+                    bucket: int) -> Callable:
+    """Build a FRESH jitted scorer for (mode, bucket) — uncached. Normal
+    callers want ``get_scorer`` (the process-wide shape-keyed cache);
+    this entry exists for the AOT bundle exporter, which needs a
+    lowerable jit function even when the cache slot holds a deserialized
+    executable (a ``Compiled`` cannot be re-lowered or re-serialized)."""
     if mode not in serving_modes(model):
         raise ValueError(f"unknown serving mode {mode!r}")
-    key = ("serving_scorer", model.token, mode, int(bucket))
+
+    # static shape facts only — the builder must NOT capture the model
+    # (a closure would pin every retired tenant's device arrays into the
+    # process-wide cache for the program's lifetime)
+    dtype = model.dtype
+    mesh_local = model.mesh is None
+    shard_pos = {sid: i for i, sid in enumerate(model.shard_order)}
+    fixed_pos = tuple(shard_pos[f.feature_shard_id] for f in model.fixed)
+    theta_dims = tuple(int(f.theta.shape[0]) for f in model.fixed)
+    theta_dtypes = tuple(str(f.theta.dtype) for f in model.fixed)
+    k_total = sum(int(model.shard_pad[model.shard_order[p]])
+                  for p in fixed_pos)
 
     def builder():
         import jax
         import jax.numpy as jnp
 
-        dtype = model.dtype
-        shard_pos = {sid: i for i, sid in enumerate(model.shard_order)}
-        thetas = tuple(f.theta for f in model.fixed)
-        fixed_pos = tuple(shard_pos[f.feature_shard_id] for f in model.fixed)
         with_random = mode != "fixed_only"
-        fused_fixed = _fused_fixed_margin(model, thetas, fixed_pos)
+        fused_fixed = _fused_fixed_margin(
+            mesh_local, dtype, theta_dims, theta_dtypes, fixed_pos, k_total)
 
         @jax.jit
         def fn(fixed_idx, fixed_val, re_sidx, re_sval, re_ent, offsets,
-               re_tables):
+               thetas, re_tables):
             if fused_fixed is not None:
-                total = fused_fixed(fixed_idx, fixed_val, offsets) \
-                    .astype(dtype)
+                total = fused_fixed(fixed_idx, fixed_val, offsets,
+                                    thetas).astype(dtype)
             else:
                 total = offsets.astype(dtype)
                 for theta, pos in zip(thetas, fixed_pos):
@@ -178,7 +201,25 @@ def get_scorer(model: DeviceResidentModel, mode: str,
 
         return fn
 
-    return jitcache.get_or_build(key, builder)
+    return builder()
+
+
+def get_scorer(model: DeviceResidentModel, mode: str,
+               bucket: int) -> Callable:
+    """Compiled scorer for one (shape-signature, mode, bucket); cached
+    process-wide and shared by every same-shape model.
+
+    Call as ``fn(*args, thetas, re_tables)`` where ``args`` is the
+    assemble output, ``thetas`` is ``model.current_thetas()`` and
+    ``re_tables`` is ``model.current_tables()`` — or
+    ``model.current_tables_int8()`` for the "full_int8" mode — read
+    inside the same ``model.transfer_lock`` hold as the assemble (the
+    two-tier store's consistency contract). ``dispatch`` wraps the
+    whole convention.
+    """
+    key = program_key(model, mode, bucket)
+    return jitcache.get_or_build(
+        key, lambda: build_scorer_fn(model, mode, bucket))
 
 
 def tables_for_mode(model: DeviceResidentModel, mode: str) -> tuple:
@@ -190,10 +231,21 @@ def tables_for_mode(model: DeviceResidentModel, mode: str) -> tuple:
     return model.current_tables()
 
 
+def dispatch(model: DeviceResidentModel, mode: str, bucket: int, args):
+    """One scorer call with the model's current parameter arguments
+    appended — the full calling convention in one place. Caller holds
+    ``model.transfer_lock`` around assemble + this call (two-tier
+    consistency)."""
+    return get_scorer(model, mode, bucket)(
+        *args, model.current_thetas(), tables_for_mode(model, mode))
+
+
 def warmup_scorers(model: DeviceResidentModel,
                    buckets: Sequence[int]) -> int:
     """Compile-and-dispatch every (mode, bucket) program under the warmup
-    phase flag. Returns the number of programs warmed."""
+    phase flag. Returns the number of programs warmed (dispatched) — for
+    tenant #2..N of a shape, each dispatch is a jitcache hit and warms
+    at zero compile cost."""
     warmed = 0
     modes = serving_modes(model)
 
@@ -201,8 +253,7 @@ def warmup_scorers(model: DeviceResidentModel,
         nonlocal warmed
         args = model.dummy_args(bucket)
         for mode in modes:
-            tables = tables_for_mode(model, mode)
-            out = get_scorer(model, mode, bucket)(*args, tables)
+            out = dispatch(model, mode, bucket, args)
             out.block_until_ready()  # host-sync-ok: warmup only
             warmed += 1
 

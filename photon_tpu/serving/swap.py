@@ -74,9 +74,25 @@ class SwapResult:
     #: shadow stats: requests compared, max abs deviation
     shadow_requests: int = 0
     shadow_max_deviation: Optional[float] = None
+    #: ``publish=False`` (canary validation): the gate-passed, warmed
+    #: DeviceResidentModel — held by the caller (a canary arm), never
+    #: installed as the live model. None everywhere else, and excluded
+    #: from to_json (it is device state, not a result record).
+    staged_model: Optional[object] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     def to_json(self) -> dict:
-        return dataclasses.asdict(self)
+        # no dataclasses.asdict: it would deep-copy staged_model (device
+        # arrays, lock-holding stores) — serialize the record fields only
+        return {
+            "accepted": self.accepted,
+            "label": self.label,
+            "version": self.version,
+            "gates": dict(self.gates),
+            "reason": self.reason,
+            "shadow_requests": self.shadow_requests,
+            "shadow_max_deviation": self.shadow_max_deviation,
+        }
 
 
 # -- integrity manifest ------------------------------------------------------
@@ -174,7 +190,8 @@ def _shadow_scores(model: DeviceResidentModel, requests: List,
         with model.transfer_lock:
             args, _fallbacks, _counters = model.assemble(chunk, bucket)
             raw = get_scorer(model, mode, bucket)(
-                *args, tables_for_mode(model, mode))
+                *args, model.current_thetas(),
+                tables_for_mode(model, mode))
         out.append(np.asarray(raw)[:len(chunk)])
     return np.concatenate(out) if out else np.zeros(0, np.float32)
 
@@ -202,10 +219,17 @@ def _reject(engine: ServingEngine, label: str, gates: Dict[str, str],
 
 
 def swap_staged(engine: ServingEngine, serving_model, label: str,
-                mesh=None) -> SwapResult:
+                mesh=None, publish: bool = True) -> SwapResult:
     """Run the in-memory half of the gate ladder (finite -> staging ->
     shadow -> compiles) over an already-loaded ServingGameModel, and
-    publish on success. ``swap_from_dir`` is the on-disk front half."""
+    publish on success. ``swap_from_dir`` is the on-disk front half.
+
+    ``publish=False`` runs the identical ladder but stops short of
+    installing the candidate: the returned result carries the warmed,
+    gate-passed model in ``staged_model`` instead. This is the canary
+    entry point (serving/tenants.py) — a canary arm must clear every
+    gate a full swap would, it just receives a traffic fraction rather
+    than the whole stream."""
     cfg = engine.config.swap
     gates: Dict[str, str] = {}
     _metrics.counter("serving.swap_attempts").inc()
@@ -339,6 +363,17 @@ def swap_staged(engine: ServingEngine, serving_model, label: str,
                        f"staging/shadow", shadow_requests=shadow_n,
                        shadow_max_deviation=max_dev)
     gates["compiles"] = "pass"
+
+    if not publish:
+        engine.swap_history.append({
+            "outcome": "validated", "label": label, "gates": dict(gates),
+            "version": engine.model_version, "shadow_requests": shadow_n,
+            "shadow_max_deviation": max_dev,
+        })
+        return SwapResult(True, label, engine.model_version, gates,
+                          shadow_requests=shadow_n,
+                          shadow_max_deviation=max_dev,
+                          staged_model=staged)
 
     published = engine.publish_model(staged, label)
     engine.swap_history.append({

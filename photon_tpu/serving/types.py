@@ -62,6 +62,14 @@ class FallbackReason(str, enum.Enum):
     #: margins of every shard that did answer, with this typed flag per
     #: unavailable shard. Never a hot-path exception at the router.
     SHARD_UNAVAILABLE = "shard_unavailable"
+    #: multi-tenant engine: the request named a tenant this process does
+    #: not host (or named none where no default is configured) — refused
+    #: at routing, before any tenant's admission queue is touched
+    UNKNOWN_TENANT = "unknown_tenant"
+    #: multi-tenant engine: the tenant's own admission budget (its
+    #: per-tenant requests-per-pump cap) is exhausted — THIS tenant's
+    #: flood is bounded here so it cannot inflate its neighbors' tails
+    TENANT_BUDGET_EXCEEDED = "tenant_budget_exceeded"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -98,6 +106,11 @@ class ScoreRequest:
     #: to ``DeadlineConfig.default_timeout_s`` (which may also be None =
     #: no deadline).
     timeout_s: Optional[float] = None
+    #: multi-tenant routing: which hosted model scores this request
+    #: (``"tenant"`` in the JSONL protocol). None on a MultiTenantEngine
+    #: routes to its default tenant when one is configured; a
+    #: single-tenant ServingEngine ignores the field.
+    tenant: Optional[str] = None
 
     @staticmethod
     def from_json(obj: dict) -> "ScoreRequest":
@@ -111,7 +124,9 @@ class ScoreRequest:
                         for k, v in (obj.get("ids") or {}).items()},
             offset=float(obj.get("offset", 0.0)),
             timeout_s=(float(obj["timeout_ms"]) / 1000.0
-                       if obj.get("timeout_ms") is not None else None))
+                       if obj.get("timeout_ms") is not None else None),
+            tenant=(str(obj["tenant"])
+                    if obj.get("tenant") is not None else None))
 
 
 @dataclasses.dataclass
@@ -123,14 +138,25 @@ class ScoreResponse:
     score: Optional[float]
     degraded: bool = False
     fallbacks: Tuple[Fallback, ...] = ()
+    #: multi-tenant attribution, set by MultiTenantEngine on the way out:
+    #: which tenant scored it, and which traffic arm ("live"/"canary")
+    #: its model came from. None from a single-tenant engine and omitted
+    #: from the JSONL response.
+    tenant: Optional[str] = None
+    arm: Optional[str] = None
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "uid": self.uid,
             "score": self.score,
             "degraded": self.degraded,
             "fallbacks": [f.to_json() for f in self.fallbacks],
         }
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        if self.arm is not None:
+            out["arm"] = self.arm
+        return out
 
 
 @dataclasses.dataclass(frozen=True)

@@ -117,6 +117,26 @@ def get_or_build(key: tuple, builder: Callable[[], Callable]) -> Callable:
     return fn
 
 
+def seed(key: tuple, fn: Callable) -> bool:
+    """Insert an externally-built program (an AOT-deserialized executable
+    from a serving program bundle) WITHOUT counting a miss or a build —
+    the whole point of seeding is that no trace and no compile happened
+    in this process. Returns False (and leaves the cache untouched) when
+    the key is already populated; ``get_or_build`` then serves the
+    existing program. Seeded entries are plain jitcache hits from the
+    caller's perspective, so the three serving compile monitors
+    (phase counters, ``jitcache.misses``, per-program retrace counts)
+    all read zero on a warm-start."""
+    with _LOCK:
+        if key in _CACHE:
+            return False
+        _CACHE[key] = fn
+        _LOGICAL_BUILDS.setdefault(_logical_key(key), 1)
+        _metrics.gauge("jitcache.size").set(len(_CACHE))
+    _metrics.counter("jitcache.seeded").inc()
+    return True
+
+
 def cache_size() -> int:
     with _LOCK:
         return len(_CACHE)

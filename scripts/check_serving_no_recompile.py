@@ -697,6 +697,198 @@ def fleet_arm(baseline, registry, compile_cache) -> list:
     return failures
 
 
+def tenant_arm(baseline, registry, compile_cache) -> list:
+    """Multi-tenant contract: N same-shape tenants behind ONE compiled
+    ladder. After tenant #1 warms, adding tenants 2..N must not move ANY
+    compile monitor (their warmups are pure jitcache hits), and mixed
+    routed traffic — per-tenant batches, unknown tenants, a mid-run
+    per-tenant swap — must keep all three monitors frozen: the swapped
+    candidate has the same shapes, so even its staging warmup is
+    hit-only."""
+    import numpy as np
+
+    from photon_tpu.serving import MultiTenantEngine, ScoreRequest
+    from photon_tpu.serving import ServingConfig, SLOConfig
+    from photon_tpu.serving.swap import swap_staged
+
+    failures = []
+    config = ServingConfig(max_batch=8, max_wait_s=0.0,
+                           slo=SLOConfig(shed_queue_depth=6,
+                                         reject_queue_depth=100))
+    mte = MultiTenantEngine(config=config)
+    first, names = build_serving_model(7)
+    from photon_tpu.serving import DeviceResidentModel
+    mte.add_tenant("t0", DeviceResidentModel(first))
+
+    # monitors baseline AFTER the first tenant: tenants 2..N must warm
+    # at zero compile cost — the whole point of shape-keyed programs
+    base = compile_cache.compile_counts()
+    misses0 = registry.counter("jitcache.misses").value
+    for i, seed in enumerate((23, 31, 47), start=1):
+        model, _ = build_serving_model(seed)
+        mte.add_tenant(f"t{i}", DeviceResidentModel(model))
+    if registry.counter("jitcache.misses").value != misses0:
+        failures.append(
+            f"tenants 2..4 traced new programs: jitcache.misses "
+            f"{misses0} -> {registry.counter('jitcache.misses').value}")
+    mid = compile_cache.compile_counts()
+    if mid["warmup"] != base["warmup"]:
+        failures.append(f"tenants 2..4 compiled: warmup counter "
+                        f"{base['warmup']} -> {mid['warmup']}")
+
+    jitted = _jitted_programs(mte.tenants["t0"].engine.model,
+                              mte.tenants["t0"].engine.ladder)
+    traces0 = [f._cache_size() for f in jitted]
+    rng = np.random.default_rng(41)
+
+    def req(uid, n_feats, user, tenant):
+        feats = [(str(names[j]), "", float(rng.normal()))
+                 for j in rng.choice(len(names), size=n_feats,
+                                     replace=False)]
+        return ScoreRequest(uid, {"shardA": feats},
+                            {"userId": user} if user else {},
+                            tenant=tenant)
+
+    served = 0
+    tenant_names = list(mte.tenants)
+    for n in range(1, config.max_batch + 1):
+        reqs = [req(f"m{n}-{i}", int(rng.integers(0, len(names))),
+                    f"u{i % 7}" if i % 3 else "cold-entity",
+                    tenant_names[i % len(tenant_names)])
+                for i in range(n)]
+        served += len(mte.serve(reqs))
+    # unknown tenant: typed refusal, no dispatch, no compile
+    r = mte.submit(req("x0", 4, "u0", "no-such-tenant"))
+    if r is None or not r.fallbacks or \
+            r.fallbacks[0].reason.value != "unknown_tenant":
+        failures.append(f"unknown tenant not refused typed: {r}")
+
+    # mid-run per-tenant swap: same shapes -> even the staging warmup is
+    # jitcache-hit-only; NO monitor may move
+    model_v2, _ = build_serving_model(59)
+    result = swap_staged(mte.tenants["t1"].engine, model_v2, "t1-v2")
+    if not result.accepted:
+        failures.append(f"tenant swap rejected: {result.reason}")
+    for n in range(1, config.max_batch + 1):
+        reqs = [req(f"p{n}-{i}", int(rng.integers(0, len(names))),
+                    f"u{i % 7}", tenant_names[i % len(tenant_names)])
+                for i in range(n)]
+        served += len(mte.serve(reqs))
+
+    after = compile_cache.compile_counts()
+    misses1 = registry.counter("jitcache.misses").value
+    traces1 = [f._cache_size() for f in jitted]
+    # base, not the run-start baseline: earlier arms' delta trainers move
+    # the steady-state counter by design (same re-baseline as int8 arm)
+    if after["steady_state"] != base["steady_state"]:
+        failures.append(f"tenant steady-state compiles moved: "
+                        f"{base['steady_state']} -> "
+                        f"{after['steady_state']}")
+    if misses1 != misses0:
+        failures.append(f"tenant jitcache.misses moved: "
+                        f"{misses0} -> {misses1}")
+    if after["warmup"] != base["warmup"]:
+        failures.append(f"tenant warmup compiles moved after baseline: "
+                        f"{base['warmup']} -> {after['warmup']} (swap "
+                        f"staging should be hit-only for same shapes)")
+    for i, (t0, t1) in enumerate(zip(traces0, traces1)):
+        if t1 > t0:
+            failures.append(f"tenant program {i} re-traced: "
+                            f"_cache_size {t0} -> {t1}")
+    if not failures:
+        print(f"ok: tenant arm served {served} across "
+              f"{len(tenant_names)} tenants on one ladder "
+              f"(tenants 2..4 + same-shape swap: zero new programs), "
+              f"steady-state compiles=0")
+    return failures
+
+
+def program_cache_arm(registry, compile_cache) -> list:
+    """Restart-from-program-cache: export the warmed ladder as an AOT
+    bundle, clear the jit cache (a process restart's cache state), load
+    the bundle, and warm again — the warmup must perform ZERO traces and
+    ZERO compiles (all three monitors; the per-program trace monitor is
+    vacuous here since bundle-seeded executables are not jit fns), and
+    scores must be bitwise-identical to the pre-restart engine's."""
+    import tempfile
+
+    import numpy as np
+
+    from photon_tpu.serving import (
+        DeviceResidentModel,
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+        export_program_bundle,
+        load_program_bundle,
+    )
+    from photon_tpu.serving.programs import bundle_dir_for
+    from photon_tpu.utils import jitcache
+
+    failures = []
+    config = ServingConfig(max_batch=8, max_wait_s=0.0)
+    model_def, names = build_serving_model(7)
+    engine = ServingEngine(DeviceResidentModel(model_def), config)
+    engine.warmup()
+    rng = np.random.default_rng(53)
+
+    def reqs():
+        r = np.random.default_rng(67)
+        out = []
+        for i in range(12):
+            feats = [(str(names[j]), "", float(r.normal()))
+                     for j in r.choice(len(names), size=6, replace=False)]
+            out.append(ScoreRequest(f"c{i}", {"shardA": feats},
+                                    {"userId": f"u{i % 5}"}))
+        return out
+
+    want = [r.score for r in engine.serve(reqs())]
+    with tempfile.TemporaryDirectory(prefix="progcache_ck_") as td:
+        bdir = bundle_dir_for(td, engine.model)
+        out = export_program_bundle(engine.model, engine.ladder.buckets,
+                                    bdir)
+        if out["skipped"]:
+            return [f"program-cache export skipped: {out['skipped']}"]
+
+        # "restart": the process-wide program cache is empty again
+        jitcache.clear()
+        model2, _ = build_serving_model(7)
+        dev2 = DeviceResidentModel(model2)
+        got_load = load_program_bundle(dev2, engine.ladder.buckets, bdir)
+        if got_load["refused"] is not None or \
+                got_load["loaded"] != out["exported"]:
+            return [f"program-cache load refused: {got_load}"]
+
+        base = compile_cache.compile_counts()
+        misses0 = registry.counter("jitcache.misses").value
+        engine2 = ServingEngine(dev2, config)
+        info = engine2.warmup()
+        after = compile_cache.compile_counts()
+        misses1 = registry.counter("jitcache.misses").value
+        if misses1 != misses0:
+            failures.append(f"warm-restart warmup traced: jitcache.misses "
+                            f"{misses0} -> {misses1}")
+        if after["warmup"] != base["warmup"] or \
+                after["steady_state"] != base["steady_state"]:
+            failures.append(f"warm-restart warmup compiled: {base} -> "
+                            f"{after}")
+        got = [r.score for r in engine2.serve(reqs())]
+        if got != want:
+            failures.append("warm-restart scores differ from pre-restart "
+                            "engine (bundle executables must be bitwise)")
+        final = compile_cache.compile_counts()
+        if final["steady_state"] != base["steady_state"]:
+            failures.append(f"warm-restart steady-state compiles moved: "
+                            f"{base['steady_state']} -> "
+                            f"{final['steady_state']}")
+        if not failures:
+            print(f"ok: program-cache arm restart warmed "
+                  f"{info['programs']} programs from {got_load['loaded']} "
+                  f"bundled executables with zero traces/compiles, "
+                  f"{len(want)} scores bitwise-equal")
+    return failures
+
+
 def main() -> int:
     from photon_tpu.obs.metrics import registry
     from photon_tpu.serving.scorer import serving_modes
@@ -813,6 +1005,25 @@ def main() -> int:
     if fl_failures:
         print("FAIL: fleet serving compiled:")
         for f in fl_failures:
+            print("  " + f)
+        return 1
+
+    # -- multi-tenant arm: N same-shape tenants, one compiled ladder —
+    # tenants 2..N and a same-shape swap add ZERO programs
+    mt_failures = tenant_arm(baseline, registry, compile_cache)
+    if mt_failures:
+        print("FAIL: multi-tenant serving compiled:")
+        for f in mt_failures:
+            print("  " + f)
+        return 1
+
+    # -- program-cache restart arm: AOT bundle load reaches zero-compile
+    # steady state without a single re-trace (runs LAST: it clears the
+    # process-wide jit cache to simulate the restart)
+    pc_failures = program_cache_arm(registry, compile_cache)
+    if pc_failures:
+        print("FAIL: program-cache warm restart compiled:")
+        for f in pc_failures:
             print("  " + f)
         return 1
     print(f"ok: {served} responses over buckets {list(engine.ladder.buckets)}"

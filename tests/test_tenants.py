@@ -1,0 +1,534 @@
+"""Multi-tenant serving + AOT program bundles (ISSUE 13).
+
+The load-bearing assertions:
+
+  * shape-generic scorer programs: N same-shape tenants share ONE
+    compiled ladder — warming 8 tenants costs <= 1.1x the program
+    builds of warming 1 (here: exactly 1x);
+  * isolation: a tenant's scores are BITWISE equal to a dedicated
+    single-tenant engine's, across full / SLO-shed fixed-only / int8 /
+    two-tier cold-miss paths, and a neighbor's breaker trip, budget
+    flood, or SLO shed never perturbs them;
+  * canary/A-B: the traffic split is deterministic per (tenant, uid),
+    sums to 100%, and responses carry typed (tenant, arm) attribution;
+  * AOT program bundles: export -> clear -> load -> warmup performs
+    zero traces and zero compiles, scores bitwise-equal; a corrupted
+    bundle is refused typed (crc gate) and falls back to tracing —
+    a re-trace, never a wrong score.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.game.dataset import EntityVocabulary
+from photon_tpu.game.model import (
+    Coefficients,
+    FixedEffectModel,
+    GameModel,
+    GeneralizedLinearModel,
+    RandomEffectModel,
+)
+from photon_tpu.io.index_map import IndexMap, feature_key
+from photon_tpu.io.model_io import (
+    ServingFixedEffect,
+    ServingGameModel,
+    ServingRandomEffect,
+    save_game_model,
+)
+from photon_tpu.obs.metrics import registry as metrics_registry
+from photon_tpu.resilience import chaos
+from photon_tpu.serving import (
+    BreakerConfig,
+    CoeffStoreConfig,
+    DeviceResidentModel,
+    FallbackReason,
+    MultiTenantEngine,
+    ScoreRequest,
+    ServingConfig,
+    ServingEngine,
+    SLOConfig,
+    SwapConfig,
+    export_program_bundle,
+    load_program_bundle,
+)
+from photon_tpu.serving.programs import bundle_dir_for
+from photon_tpu.serving.tenants import _ladder_buckets
+from photon_tpu.types import TaskType
+from photon_tpu.utils import compile_cache, jitcache
+
+D, E, K = 5, 3, 2
+
+
+def _reasons(resp):
+    return {f.reason for f in resp.fallbacks}
+
+
+def _synth_model(seed=7, nan_fixed=False):
+    """One-shard, one-RE ServingGameModel. Every seed produces the SAME
+    shapes (the multi-tenant premise) with different values."""
+    rng = np.random.default_rng(seed)
+    imap = IndexMap.from_keys([feature_key(f"f{j}", "") for j in range(D)])
+    theta = rng.normal(size=D).astype(np.float32)
+    if nan_fixed:
+        theta[0] = np.nan
+    proj = np.stack([np.sort(rng.choice(D, size=K, replace=False))
+                     for _ in range(E)]).astype(np.int32)
+    coef = rng.normal(size=(E, K)).astype(np.float32)
+    return ServingGameModel(
+        TaskType.LOGISTIC_REGRESSION,
+        [ServingFixedEffect("global", "s", theta)],
+        [ServingRandomEffect("per-u", "uid", "s", coef, proj,
+                             {f"u{e}": e for e in range(E)})],
+        {"s": imap}, {})
+
+
+def _req(uid, user="u0", tenant=None, seed=None):
+    if seed is None:
+        vals = [1.0] * D
+    else:
+        vals = np.random.default_rng(seed).normal(size=D).round(3).tolist()
+    return ScoreRequest(uid, {"s": [(f"f{j}", "", float(v))
+                                    for j, v in enumerate(vals)]},
+                        {"uid": user}, tenant=tenant)
+
+
+def _traffic(n, tenant=None, seed0=100):
+    return [_req(f"q{i}", user=f"u{i % E}", tenant=tenant, seed=seed0 + i)
+            for i in range(n)]
+
+
+_CFG = dict(max_batch=4, max_wait_s=0.0)
+
+
+def _misses():
+    return metrics_registry.snapshot()["counters"].get("jitcache.misses", 0)
+
+
+# -- shape-generic shared programs -------------------------------------------
+
+
+def test_shape_signature_seed_independent():
+    """Same shapes, different values -> same signature; a different
+    feature width -> a different signature (its own program ladder)."""
+    a = DeviceResidentModel(_synth_model(0))
+    b = DeviceResidentModel(_synth_model(1))
+    assert a.shape_signature() == b.shape_signature()
+    wide = _synth_model(0)
+    c = DeviceResidentModel(wide, feature_pad=16)
+    assert a.shape_signature() != c.shape_signature()
+
+
+def test_eight_tenants_share_one_compiled_ladder():
+    """The acceptance bound: warming 8 same-shape tenants builds at most
+    1.1x the programs of warming 1 (tenants 2..8 are pure cache hits)."""
+    jitcache.clear()
+    m0 = _misses()
+    solo = MultiTenantEngine(config=ServingConfig(**_CFG))
+    solo.add_tenant("t0", DeviceResidentModel(_synth_model(0)))
+    one = _misses() - m0
+    assert one > 0
+
+    jitcache.clear()
+    m1 = _misses()
+    mte = MultiTenantEngine(config=ServingConfig(**_CFG))
+    for i in range(8):
+        mte.add_tenant(f"t{i}", DeviceResidentModel(_synth_model(i)))
+    eight = _misses() - m1
+    assert eight <= math.ceil(1.1 * one), (one, eight)
+
+    # and the shared programs still score each tenant's OWN parameters
+    got = mte.serve([_req("a", tenant="t0", seed=5),
+                     _req("b", tenant="t5", seed=5)])
+    assert got[0].score != got[1].score   # same features, different models
+    assert (got[0].tenant, got[1].tenant) == ("t0", "t5")
+
+
+def test_tenant_ladder_mismatch_rejected():
+    mte = MultiTenantEngine(config=ServingConfig(**_CFG))
+    with pytest.raises(ValueError, match="bucket ladder"):
+        mte.add_tenant("bad", DeviceResidentModel(_synth_model(0)),
+                       config=ServingConfig(max_batch=8, max_wait_s=0.0))
+
+
+# -- per-tenant isolation: bitwise parity with a dedicated engine ------------
+
+
+def _parity(config, n=10, seed_a=0, seed_b=1):
+    """Serve identical traffic through tenant 'beta' of a 2-tenant MTE
+    and through a dedicated engine over the same model; return both
+    response lists (order preserved)."""
+    mte = MultiTenantEngine(config=config)
+    mte.add_tenant("alpha", DeviceResidentModel(_synth_model(seed_a)))
+    mte.add_tenant("beta", DeviceResidentModel(_synth_model(seed_b)))
+    dedicated = ServingEngine(DeviceResidentModel(_synth_model(seed_b)),
+                              config=config)
+    dedicated.warmup()
+    got = mte.serve(_traffic(n, tenant="beta"))
+    want = dedicated.serve(_traffic(n))
+    return got, want
+
+
+def test_tenant_full_path_bitwise_equal_dedicated():
+    got, want = _parity(ServingConfig(**_CFG))
+    for g, w in zip(got, want):
+        assert g.score == w.score          # bitwise: same compiled program
+        assert not g.degraded
+        assert (g.tenant, g.arm) == ("beta", "live")
+
+
+def test_tenant_int8_path_bitwise_equal_dedicated():
+    got, want = _parity(ServingConfig(int8_serving=True, **_CFG))
+    for g, w in zip(got, want):
+        assert g.score == w.score
+
+
+def test_tenant_slo_shed_bitwise_equal_dedicated():
+    """Queue past the shed depth without pumping: the overflow scores
+    fixed-effect-only, typed — identically in both hostings."""
+    cfg = ServingConfig(max_batch=4, max_wait_s=60.0,
+                        slo=SLOConfig(shed_queue_depth=2))
+    mte = MultiTenantEngine(config=cfg)
+    mte.add_tenant("beta", DeviceResidentModel(_synth_model(1)))
+    dedicated = ServingEngine(DeviceResidentModel(_synth_model(1)),
+                              config=cfg)
+    dedicated.warmup()
+    for r in _traffic(6, tenant="beta"):
+        assert mte.submit(r) is None
+    for r in _traffic(6):
+        assert dedicated.submit(r) is None
+    got, want = [], []
+    while any(st.depth() for st in mte.tenants.values()):
+        got.extend(mte.pump(flush=True))
+    while dedicated.batcher.depth():
+        want.extend(dedicated.pump(flush=True))
+    assert len(got) == len(want) == 6
+    by_uid_w = {w.uid: w for w in want}
+    shed = 0
+    for g in got:
+        w = by_uid_w[g.uid]
+        assert g.score == w.score and _reasons(g) == _reasons(w)
+        shed += FallbackReason.SLO_SHED_RANDOM_EFFECTS in _reasons(g)
+    assert shed > 0
+
+
+def _model_dir(tmp_path, name="m"):
+    """Reference-layout model dir (cold stores + sidecars) for the
+    two-tier arm."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    im_g = IndexMap.from_keys([feature_key("g", str(j)) for j in range(D)])
+    im_u = IndexMap.from_keys([feature_key("u", str(j)) for j in range(D)])
+    proj = np.stack([np.sort(rng.choice(D, size=K, replace=False))
+                     for _ in range(E)]).astype(np.int32)
+    users = [f"user{e}" for e in range(E)]
+    vocab = EntityVocabulary()
+    vocab.build("userId", users)
+    model = GameModel({
+        "fixed": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(jnp.asarray(rng.normal(size=D))),
+                TaskType.LOGISTIC_REGRESSION), "g"),
+        "per_user": RandomEffectModel(
+            jnp.asarray(rng.normal(size=(E, K))), "userId", "u",
+            TaskType.LOGISTIC_REGRESSION),
+    })
+    d = str(tmp_path / name)
+    save_game_model(d, model, {"g": im_g, "u": im_u}, vocab=vocab,
+                    projections={"per_user": proj}, sparsity_threshold=0.0)
+    return d, users
+
+
+def test_tenant_two_tier_cold_miss_bitwise_equal_dedicated(tmp_path):
+    """The cold-miss path under a tenant: first touch degrades typed
+    COLD_MISS with the identical fixed-only score a dedicated two-tier
+    engine produces; after the transfer drains, both score clean and
+    equal."""
+    d, users = _model_dir(tmp_path)
+    cfg = ServingConfig(max_batch=4, max_wait_s=0.0,
+                        coeff_store=CoeffStoreConfig(
+                            hot_capacity=4, transfer_batch=2,
+                            prefetch=False))
+    mte = MultiTenantEngine(config=cfg)
+    mte.add_tenant_from_dir("tt", d)
+    dedicated = ServingEngine.from_model_dir(d, config=cfg)
+    dedicated.warmup()
+    req = ScoreRequest("c0", {"g": [("g", str(j), 0.5) for j in range(D)],
+                              "u": [("u", str(j), 0.5) for j in range(D)]},
+                       {"userId": users[0]})
+    try:
+        g1 = mte.serve([ScoreRequest("c0", req.features, req.entity_ids,
+                                     tenant="tt")])[0]
+        w1 = dedicated.serve([req])[0]
+        assert g1.degraded and FallbackReason.COLD_MISS in _reasons(g1)
+        assert g1.score == w1.score and _reasons(g1) == _reasons(w1)
+        assert mte.tenants["tt"].engine.model.drain_prefetch()
+        assert dedicated.model.drain_prefetch()
+        g2 = mte.serve([ScoreRequest("c1", req.features, req.entity_ids,
+                                     tenant="tt")])[0]
+        w2 = dedicated.serve([ScoreRequest("c1", req.features,
+                                           req.entity_ids)])[0]
+        assert not g2.degraded and g2.score == w2.score
+    finally:
+        mte.shutdown(drain_budget_s=0.0)
+        dedicated.shutdown(drain_budget_s=0.0)
+
+
+# -- fault isolation ---------------------------------------------------------
+
+
+def test_breaker_trip_isolated_to_one_tenant():
+    """Tenant A's NaN model trips A's breaker; B's responses stay clean
+    and bitwise-equal to a dedicated engine's."""
+    cfg = ServingConfig(max_batch=1, max_wait_s=0.0,
+                        breaker=BreakerConfig(window=8, min_samples=2,
+                                              failure_rate=0.4),
+                        swap=SwapConfig(probation_s=0.0))
+    mte = MultiTenantEngine(config=cfg)
+    mte.add_tenant("A", DeviceResidentModel(_synth_model(0, nan_fixed=True)))
+    mte.add_tenant("B", DeviceResidentModel(_synth_model(1)))
+    dedicated = ServingEngine(DeviceResidentModel(_synth_model(1)),
+                              config=cfg)
+    dedicated.warmup()
+    got_b = []
+    for i in range(4):
+        mte.submit(_req(f"a{i}", tenant="A", seed=i))
+        mte.submit(_req(f"b{i}", tenant="B", seed=i))
+        got_b.extend(r for r in mte.pump(flush=True) if r.tenant == "B")
+    want_b = dedicated.serve([_req(f"b{i}", seed=i) for i in range(4)])
+    assert mte.tenants["A"].engine.breaker.state() in ("shed", "open")
+    assert mte.tenants["B"].engine.breaker.state() == "closed"
+    for g, w in zip(got_b, want_b):
+        assert g.score == w.score and not g.degraded
+
+
+def test_admission_budget_typed_refusal_neighbor_clean():
+    """Tenant A floods past its admission budget -> typed
+    TENANT_BUDGET_EXCEEDED for A only; B keeps scoring undegraded."""
+    cfg = ServingConfig(max_batch=4, max_wait_s=60.0)
+    mte = MultiTenantEngine(config=cfg)
+    mte.add_tenant("A", DeviceResidentModel(_synth_model(0)),
+                   admission_budget=3)
+    mte.add_tenant("B", DeviceResidentModel(_synth_model(1)))
+    refused = []
+    for i in range(8):
+        r = mte.submit(_req(f"a{i}", tenant="A", seed=i))
+        if r is not None:
+            refused.append(r)
+    assert len(refused) == 5
+    assert all(_reasons(r) == {FallbackReason.TENANT_BUDGET_EXCEEDED}
+               for r in refused)
+    assert all(r.tenant == "A" for r in refused)
+    got = mte.serve(_traffic(4, tenant="B"))
+    assert all(not r.degraded and r.tenant == "B" for r in got)
+
+
+def test_chaos_tenant_hot_loop_bounded_by_budget():
+    """The noisy-neighbor injector: floods enter through tenant A's OWN
+    budget gate, so B never sheds and never changes a score, while the
+    flood itself is visibly injected+dropped (counters)."""
+    cfg = ServingConfig(max_batch=2, max_wait_s=0.0)
+    mte = MultiTenantEngine(config=cfg)
+    mte.add_tenant("A", DeviceResidentModel(_synth_model(0)),
+                   admission_budget=2)
+    mte.add_tenant("B", DeviceResidentModel(_synth_model(1)))
+    dedicated = ServingEngine(DeviceResidentModel(_synth_model(1)),
+                              config=cfg)
+    dedicated.warmup()
+    with chaos.active(chaos.ChaosConfig(tenant_hot_loop="A",
+                                        tenant_hot_loop_burst=4,
+                                        tenant_hot_loop_total=40)):
+        got_b, got_a = [], []
+        for i in range(10):
+            ra = mte.submit(_req(f"a{i}", tenant="A", seed=i))
+            if ra is not None:
+                got_a.append(ra)
+            rb = mte.submit(_req(f"b{i}", tenant="B", seed=i))
+            assert rb is None             # B admission never touched
+            for r in mte.pump(flush=True):
+                (got_a if r.tenant == "A" else got_b).append(r)
+    want_b = dedicated.serve([_req(f"b{i}", seed=i) for i in range(10)])
+    by_uid = {r.uid: r for r in got_b}
+    for w in want_b:
+        g = by_uid[w.uid]
+        assert g.score == w.score and not g.degraded
+    snap = metrics_registry.snapshot()["counters"]
+    assert snap.get('serving.tenant_flood_injected{tenant="A"}', 0) > 0
+    # no flood uid ever reaches a caller
+    assert not any(r.uid.startswith("__chaos_flood__")
+                   for r in got_a + got_b)
+
+
+def test_unknown_tenant_typed_refusal():
+    mte = MultiTenantEngine(config=ServingConfig(**_CFG))
+    mte.add_tenant("only", DeviceResidentModel(_synth_model(0)))
+    r = mte.submit(_req("x", tenant="nope"))
+    assert r is not None and r.score is None
+    assert _reasons(r) == {FallbackReason.UNKNOWN_TENANT}
+    # tenant-less requests route to the default tenant
+    assert mte.submit(_req("y")) is None
+
+
+# -- canary / A-B ------------------------------------------------------------
+
+
+def test_canary_split_deterministic_and_sums_to_100():
+    mte = MultiTenantEngine(config=ServingConfig(**_CFG))
+    mte.add_tenant("t", DeviceResidentModel(_synth_model(0)))
+    res = mte.start_canary("t", _synth_model(9), "v2", fraction=0.3)
+    assert res.accepted, res.reason
+    n = 120
+    got = mte.serve(_traffic(n, tenant="t"))
+    arms = {r.uid: r.arm for r in got}
+    # typed per-arm attribution matches the published hash split exactly
+    for r in got:
+        want = ("canary" if MultiTenantEngine.canary_pick("t", r.uid, 0.3)
+                else "live")
+        assert r.arm == want
+    splits = dict(mte.tenants["t"].split_counts)      # first-pass snapshot
+    assert splits["live"] + splits["canary"] == n     # sums to 100%
+    assert 0 < splits["canary"] < n
+    # deterministic: a second pass splits identically per uid
+    got2 = mte.serve(_traffic(n, tenant="t"))
+    assert {r.uid: r.arm for r in got2} == arms
+    info = mte.promote_canary("t")
+    assert mte.tenants["t"].engine.model_version == 2
+    assert info["splits"]["canary"] == splits["canary"] * 2
+
+
+def test_canary_gate_failure_opens_no_arm():
+    mte = MultiTenantEngine(config=ServingConfig(**_CFG))
+    mte.add_tenant("t", DeviceResidentModel(_synth_model(0)))
+    res = mte.start_canary("t", _synth_model(9, nan_fixed=True), "bad",
+                           fraction=0.5)
+    assert not res.accepted
+    assert mte.tenants["t"].canary_engine is None
+    got = mte.serve(_traffic(4, tenant="t"))
+    assert all(r.arm == "live" for r in got)
+
+
+# -- AOT program bundles: instant cold start ---------------------------------
+
+
+def test_program_bundle_roundtrip_zero_trace_bitwise_equal(tmp_path):
+    cfg = ServingConfig(**_CFG)
+    model = DeviceResidentModel(_synth_model(0))
+    engine = ServingEngine(model, config=cfg)
+    engine.warmup()
+    want = engine.serve(_traffic(6))
+    buckets = _ladder_buckets(cfg)
+    bdir = bundle_dir_for(str(tmp_path), model)
+    out = export_program_bundle(model, buckets, bdir)
+    assert out["exported"] == len(buckets) * 2 and not out["skipped"]
+
+    # simulated restart: empty jitcache, load, warm — zero traces
+    jitcache.clear()
+    model2 = DeviceResidentModel(_synth_model(0))
+    got_load = load_program_bundle(model2, buckets, bdir)
+    assert got_load["refused"] is None
+    assert got_load["loaded"] == out["exported"]
+    m0, c0 = _misses(), dict(compile_cache.compile_counts())
+    engine2 = ServingEngine(model2, config=cfg)
+    engine2.warmup()
+    assert _misses() == m0                      # zero jit traces
+    c1 = compile_cache.compile_counts()
+    assert c1["warmup"] == c0["warmup"]         # zero XLA compiles
+    assert c1["steady_state"] == c0["steady_state"]
+    got = engine2.serve(_traffic(6))
+    for g, w in zip(got, want):
+        assert g.score == w.score
+
+
+def test_program_bundle_corrupt_refused_falls_back(tmp_path):
+    """chaos.program_cache_corrupt flips one byte -> the crc gate
+    refuses the WHOLE bundle (typed), warmup traces instead, and scores
+    are unchanged: a corrupt bundle costs a re-trace, never a wrong
+    score."""
+    cfg = ServingConfig(**_CFG)
+    model = DeviceResidentModel(_synth_model(0))
+    ServingEngine(model, config=cfg).warmup()
+    want = ServingEngine(model, config=cfg).serve(_traffic(4))
+    buckets = _ladder_buckets(cfg)
+    bdir = bundle_dir_for(str(tmp_path), model)
+    export_program_bundle(model, buckets, bdir)
+    victim = chaos.program_cache_corrupt(bdir, seed=1)
+    assert os.path.exists(victim)
+
+    jitcache.clear()
+    model2 = DeviceResidentModel(_synth_model(0))
+    got_load = load_program_bundle(model2, buckets, bdir)
+    assert got_load["loaded"] == 0 and got_load["refused"] == "crc_mismatch"
+    engine2 = ServingEngine(model2, config=cfg)
+    engine2.warmup()                            # tracing fallback
+    got = engine2.serve(_traffic(4))
+    for g, w in zip(got, want):
+        assert g.score == w.score
+
+
+def test_program_bundle_signature_mismatch_refused(tmp_path):
+    cfg = ServingConfig(**_CFG)
+    model = DeviceResidentModel(_synth_model(0))
+    ServingEngine(model, config=cfg).warmup()
+    buckets = _ladder_buckets(cfg)
+    bdir = str(tmp_path / "b")
+    export_program_bundle(model, buckets, bdir)
+    other = DeviceResidentModel(_synth_model(0), feature_pad=16)
+    got = load_program_bundle(other, buckets, bdir)
+    assert got["refused"] == "signature_mismatch"
+
+
+def test_multi_tenant_bundle_restart_zero_compile(tmp_path):
+    """The full cold-start story: a 3-tenant host exports ONE shared
+    bundle; a 'restarted' host loads it and warms all tenants with zero
+    traces and zero compiles."""
+    cfg = ServingConfig(**_CFG)
+    mte = MultiTenantEngine(config=cfg)
+    for i in range(3):
+        mte.add_tenant(f"t{i}", DeviceResidentModel(_synth_model(i)))
+    exported = mte.export_program_bundles(str(tmp_path))
+    assert len(exported) == 1                   # one shape -> one bundle
+
+    jitcache.clear()
+    mte2 = MultiTenantEngine(config=cfg)
+    for i in range(3):
+        mte2.add_tenant(f"t{i}", DeviceResidentModel(_synth_model(i)),
+                        warm=False)
+    loads = mte2.load_program_bundles(str(tmp_path))
+    assert all(v["loaded"] > 0 or "shared_with" in v for v in loads.values())
+    m0, c0 = _misses(), dict(compile_cache.compile_counts())
+    info = mte2.warmup()
+    assert info["programs"] == 3 * len(_ladder_buckets(cfg)) * 2
+    assert _misses() == m0
+    c1 = compile_cache.compile_counts()
+    assert (c1["warmup"], c1["steady_state"]) == \
+        (c0["warmup"], c0["steady_state"])
+
+
+# -- labeled warmup gauges through merge_snapshots (satellite a) -------------
+
+
+def test_warmup_gauges_labeled_per_tenant_survive_merge():
+    from photon_tpu.obs.metrics import MetricsRegistry, merge_snapshots
+
+    mte = MultiTenantEngine(config=ServingConfig(**_CFG))
+    mte.add_tenant("alpha", DeviceResidentModel(_synth_model(0)))
+    mte.add_tenant("beta", DeviceResidentModel(_synth_model(1)))
+    snap = metrics_registry.snapshot()["gauges"]
+    for t in ("alpha", "beta"):
+        assert f'serving.warmup_seconds{{tenant="{t}"}}' in snap
+        assert snap[f'serving.warmup_programs{{tenant="{t}"}}'] > 0
+
+    # regression: distinct labels stay distinct keys across a fleet merge
+    snaps = []
+    for pid, t in ((0, "alpha"), (1, "beta")):
+        reg = MetricsRegistry()
+        reg.gauge("serving.warmup_seconds", tenant=t).set(1.0 + pid)
+        snaps.append(reg.snapshot())
+    merged = merge_snapshots(snaps)
+    assert merged["gauges"]['serving.warmup_seconds{tenant="alpha"}'] == 1.0
+    assert merged["gauges"]['serving.warmup_seconds{tenant="beta"}'] == 2.0
