@@ -8,6 +8,7 @@ Entry points:
   python -m photon_tpu.cli.serve          online serving (JSONL stdin -> stdout)
   python -m photon_tpu.cli.fleet_serve    entity-sharded fleet router (JSONL -> routed shards)
   python -m photon_tpu.cli.nearline       nearline delta training (event log -> live tables)
+  python -m photon_tpu.cli.convert_data   LibSVM/Avro -> mmap columnar chunk store
 """
 
 from photon_tpu.cli.config import (

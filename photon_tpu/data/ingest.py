@@ -33,24 +33,34 @@ _PARALLEL_CHUNK_BYTES = 1 << 20   # fan out files bigger than 2x this
 
 
 def _split_at_newlines(data: bytes, n_chunks: int) -> list:
-    """Split ``data`` into up to ``n_chunks`` memoryview pieces (no byte
-    copies), cutting only just after a newline so every piece is a whole
-    number of lines (the LibSVM grammar is line-based, so chunked parses
-    splice exactly). Files below 2x _PARALLEL_CHUNK_BYTES stay whole —
-    thread-pool overhead beats the parse at small sizes."""
+    """Split ``data`` into up to ``n_chunks`` buffer pieces, cutting only
+    just after a newline so every piece is a whole number of lines (the
+    LibSVM grammar is line-based, so chunked parses splice exactly).
+    Every returned piece is newline-TERMINATED: a buffer whose final line
+    lacks its ``\\n`` gets one appended on a small owned copy of the tail
+    piece (all other pieces stay zero-copy memoryviews), so parsers may
+    rely on n-lines == n-newlines instead of the caller's buffer
+    happening to end in ``\\n``. Files below 2x _PARALLEL_CHUNK_BYTES
+    stay whole — thread-pool overhead beats the parse at small sizes."""
     mv = memoryview(data)
     if n_chunks <= 1 or len(data) < 2 * _PARALLEL_CHUNK_BYTES:
-        return [mv]
-    approx = len(data) // n_chunks
-    out, start = [], 0
-    for _ in range(n_chunks - 1):
-        cut = data.find(b"\n", start + approx)
-        if cut < 0:
-            break
-        out.append(mv[start:cut + 1])
-        start = cut + 1
+        out, start = [], 0
+    else:
+        approx = len(data) // n_chunks
+        out, start = [], 0
+        for _ in range(n_chunks - 1):
+            cut = data.find(b"\n", start + approx)
+            if cut < 0:
+                break
+            out.append(mv[start:cut + 1])
+            start = cut + 1
     if start < len(data):
-        out.append(mv[start:])
+        tail = mv[start:]
+        if data[-1:] != b"\n":
+            tail = memoryview(bytes(tail) + b"\n")
+        out.append(tail)
+    if not out:
+        out.append(mv)   # empty input: one empty piece, same as before
     return out
 
 

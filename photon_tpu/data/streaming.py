@@ -164,6 +164,165 @@ class CsrSource:
         )
 
 
+class MmapChunkSource:
+    """Disk-native chunk source over an ``io/data_store.py`` columnar
+    store: ``read_block`` is a zero-copy mmap slice per section — no
+    parse, no row assembly — so a fit streams straight off storage while
+    host RAM holds only the OS page-cache window.
+
+    The store carries sparse rows PRE-ASSEMBLED as padded ELL, bitwise
+    identical to what ``CsrSource.read_block`` materializes, and every
+    section file is page-aligned, so interior full chunks satisfy the
+    loader's 64-byte alias contract (any chunk boundary at a multiple of
+    16 rows is aligned for every section dtype) and flow through the
+    same zero-copy dlpack path as the in-RAM sources — a streamed
+    L-BFGS/OWL-QN fit off this source is bitwise identical to one off
+    ``CsrSource``/``DenseSource`` on the same rows.
+
+    ``shard_id`` restricts the source to the chunks the store's manifest
+    assigns to that mesh shard (crc32 partitioner, see
+    ``parallel/partition.entity_shard``); the shard's chunk spans are
+    remapped to a dense [0, num_rows) row space so the loader needs no
+    shard awareness. ``advise_behind`` (default on) drops clean resident
+    pages behind the consumption cursor via madvise(DONTNEED) — purely
+    an RSS bound; the pages re-fault identically if re-read, so repeated
+    passes stay correct and a full pass's resident high-water is a small
+    window instead of the dataset. Two release paths cover the loader's
+    two modes: ``read_block`` advises behind the *read* cursor (safe in
+    copy mode, where the reader's staging memcpy has already consumed
+    the pages synchronously), and ``consumed`` advises behind realized
+    *consumption tokens* (the loader hands over each source-aliased
+    chunk's token) — in alias mode the async dispatch queue lets XLA
+    executions lag the read cursor, so a reader-side advise alone gets
+    quietly re-faulted by the lagging reads and a full pass ends with
+    most of the store resident.
+    """
+
+    #: consumption-token lag (chunks) before a fenced page release:
+    #: small enough to bound the resident window, large enough to keep
+    #: chunk dispatch running ahead of execution
+    _CONSUME_LAG = 4
+
+    def __init__(self, path: str, *, shard_id: Optional[int] = None,
+                 verify: bool = True, advise_behind: bool = True):
+        # deferred: io.data_store imports resilience/io; keep streaming's
+        # import graph free of the io package until a store is opened
+        from photon_tpu.io.data_store import DataStore
+        self.store = DataStore(path, verify=verify)
+        man = self.store.manifest
+        self.dtype = np.dtype(man["dtype"])
+        self.dim = int(man["dim"])
+        self.ell_width: Optional[int] = (
+            None if man["ell_width"] is None else int(man["ell_width"]))
+        n = int(man["n_rows"])
+        cr = int(man["chunk_rows"])
+        if shard_id is None:
+            spans = [(0, n)] if n else []
+        else:
+            if not 0 <= int(shard_id) < int(man["num_shards"]):
+                raise ValueError(f"shard_id={shard_id} outside the "
+                                 f"store's {man['num_shards']} shards")
+            spans = []
+            for c, s in enumerate(man["chunk_shards"]):
+                if int(s) != int(shard_id):
+                    continue
+                lo, hi = c * cr, min(n, (c + 1) * cr)
+                if spans and spans[-1][1] == lo:
+                    spans[-1] = (spans[-1][0], hi)
+                else:
+                    spans.append((lo, hi))
+        self._spans = spans
+        self.num_rows = int(sum(hi - lo for lo, hi in spans))
+        self._cum = np.cumsum([0] + [hi - lo for lo, hi in spans])
+        self.labels = self.store.section("labels")
+        self.offsets = (self.store.section("offsets")
+                        if man["has_offsets"] else None)
+        self.weights = (self.store.section("weights")
+                        if man["has_weights"] else None)
+        if self.ell_width is None:
+            self._x = self.store.section("x")
+        else:
+            self._idx = self.store.section("idx")
+            self._val = self.store.section("val")
+        self._advise = bool(advise_behind)
+        self._advised_to = 0   # logical row watermark already released
+        self._pending: List[tuple] = []   # (row_stop, token) FIFO
+        self._consumed_to = 0  # logical row watermark token-fence-released
+
+    def _pieces(self, start: int, stop: int) -> List[tuple]:
+        """Logical row range -> physical (lo, hi) spans in the store."""
+        out = []
+        i = int(np.searchsorted(self._cum, start, side="right")) - 1
+        while start < stop and i < len(self._spans):
+            lo, hi = self._spans[i]
+            p_lo = lo + (start - int(self._cum[i]))
+            take = min(stop - start, hi - p_lo)
+            out.append((p_lo, p_lo + take))
+            start += take
+            i += 1
+        return out
+
+    def _gather(self, arr: np.ndarray, pieces: List[tuple]) -> np.ndarray:
+        if len(pieces) == 1:
+            lo, hi = pieces[0]
+            return arr[lo:hi]           # zero-copy mmap slice
+        return np.concatenate([arr[lo:hi] for lo, hi in pieces])
+
+    def _release_behind(self, start: int, stop: int) -> None:
+        """madvise(DONTNEED) rows more than ~4 blocks behind the cursor
+        (new pass detected by a backwards cursor => watermark reset)."""
+        if start < self._advised_to:
+            self._advised_to = 0
+        behind = start - 4 * (stop - start)
+        if behind - self._advised_to < (stop - start):
+            return
+        for lo, hi in self._pieces(self._advised_to, behind):
+            self.store.advise_dontneed(lo, hi)
+        self._advised_to = behind
+
+    def consumed(self, row_stop: int, token) -> None:
+        """Token-fenced page release for the zero-copy alias path. The
+        loader calls this with every source-aliased chunk's consumption
+        token (the streamed solver's new carry); the carry chain means
+        token k's readiness fences every chunk <= k's reads, so pages
+        advised after the wait can never be re-faulted by a lagging
+        async execution. The wait itself trails ``_CONSUME_LAG`` chunks
+        behind dispatch and lands on an almost-always-realized token —
+        compute, not this fence, stays the critical path."""
+        if not self._advise:
+            return
+        if self._pending and row_stop <= self._pending[-1][0]:
+            # backwards cursor = new pass; its tokens were realized at
+            # the pass-end (f, g) host read, nothing left to fence
+            self._pending.clear()
+            self._consumed_to = 0
+        self._pending.append((row_stop, token))
+        if len(self._pending) <= self._CONSUME_LAG:
+            return
+        stop, tok = self._pending.pop(0)
+        import jax
+        jax.block_until_ready(tok)   # host-sync-ok — trailing RSS fence,
+        # _CONSUME_LAG chunks behind dispatch, NOT the per-chunk path
+        for lo, hi in self._pieces(self._consumed_to, stop):
+            self.store.advise_dontneed(lo, hi)
+        self._consumed_to = stop
+
+    def read_block(self, start: int, stop: int) -> RawBlock:
+        pieces = self._pieces(start, stop)
+        g = lambda a: self._gather(a, pieces)   # noqa: E731
+        block = RawBlock(
+            labels=g(self.labels),
+            x=g(self._x) if self.ell_width is None else None,
+            idx=g(self._idx) if self.ell_width is not None else None,
+            val=g(self._val) if self.ell_width is not None else None,
+            offsets=None if self.offsets is None else g(self.offsets),
+            weights=None if self.weights is None else g(self.weights),
+        )
+        if self._advise:
+            self._release_behind(start, stop)
+        return block
+
+
 @dataclasses.dataclass(frozen=True)
 class StreamConfig:
     """Knobs for the streaming chunk loader.
@@ -613,10 +772,19 @@ class ChunkLoader:
         that dispatch async compute on zero-copy chunks; a no-op in copy
         mode. Consumers that read chunks synchronously may skip it: the
         generator auto-releases when the next chunk is requested."""
-        if (self._alias and self._streaming and chunk.fenced
+        if (self._alias and self._streaming
                 and chunk.index > self._released_idx):
             self._released_idx = chunk.index
-            self._release_q.put(token)
+            if chunk.fenced:
+                self._release_q.put(token)
+            else:
+                # source-aliased chunk: no buffer to recycle, but a
+                # disk-backed source can use the token to fence page
+                # release behind the consumption cursor
+                consumed = getattr(self.source, "consumed", None)
+                if consumed is not None:
+                    consumed(chunk.index * self.chunk_rows + chunk.rows,
+                             token)
 
     def stream(self, start_chunk: int = 0) -> Iterator[DeviceChunk]:
         """Yield DeviceChunks in deterministic ascending order, chunk

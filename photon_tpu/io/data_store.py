@@ -1,0 +1,785 @@
+"""Disk-native training data: a crc32-verified, mmap-backed columnar
+chunk store — the data-side twin of ``io/cold_store.py``.
+
+Every in-RAM ``ChunkSource`` (``data/streaming.py``) bounds dataset size
+by host memory and re-pays the LibSVM/Avro parse on every run. This
+module moves the parse to a ONE-TIME conversion into a fixed-layout
+binary store that ``data/streaming.MmapChunkSource`` then maps straight
+into the training pipeline: ``read_block`` is a pure mmap slice — no
+parse, no row assembly — flowing through the loader's 64-byte-aligned
+zero-copy alias fast path, so a streamed fit is bitwise identical to the
+in-RAM sources while the dataset never materializes in host RAM.
+
+Layout — a directory of section files plus a manifest-written-LAST:
+
+    store/
+      labels.sec              raw little-endian C-order array bytes [n]
+      weights.sec, offsets.sec        (optional per-row columns)
+      x.sec                   dense [n, dim]
+      idx.sec, val.sec        sparse padded-ELL [n, ell_width] — stored
+                              PRE-ASSEMBLED, bitwise identical to what
+                              ``CsrSource.read_block`` would materialize,
+                              so disk chunks equal in-RAM chunks byte for
+                              byte and read time does zero row assembly
+      nnz.sec                 int32 per-row nonzero counts (sparse)
+      manifest.json           crc32-wrapped JSON: geometry, per-section
+                              byte lengths + crc32s, per-chunk nnz
+                              headers, chunk -> mesh-shard assignment
+
+Invariants (mirroring the cold store's):
+
+- **Manifest last, atomically.** Section files are staged as ``.part``
+  files and renamed into place before the manifest is published via the
+  fsync-audited atomic write (``resilience/io``). A store without a
+  valid manifest does not exist; a kill at any point leaves either the
+  previous store or recognizable debris, never a half-store a reader
+  could silently truncate.
+- **Typed refusal, never a silent short read.** Missing or size-skewed
+  section files, torn or crc-skewed manifests, and bit-flipped section
+  bytes (``verify=True`` scans every section) all raise
+  ``DataStoreCorruptError``.
+- **64-byte alignment.** Each section starts at file (= mmap) offset 0,
+  page-aligned and therefore aligned to the ChunkLoader's ``_ALIGN=64``
+  staging granularity; any chunk boundary at a multiple of 8 rows stays
+  64-byte aligned for every section dtype, keeping the dlpack alias
+  path live. (Page-backed sections are also exactly what a future
+  pinned-host-allocation path wants to register for real DMA.)
+- **Resumable conversion.** The writer persists a crc-framed cursor
+  (per-section byte lengths + running crc32s + completed input units)
+  after every unit; a killed conversion resumes by truncating to the
+  cursor and re-converting deterministically from the next unit, landing
+  on a byte-identical store. Chaos hook: ``chaos.should_kill_convert``.
+- **Shard-aware.** ``chunk_shards[c] = partition.entity_shard(f"chunk-{c}",
+  num_shards)`` — the same crc32 partitioner that places entities —
+  so multi-host meshes read disjoint chunk ranges from one store.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from photon_tpu.resilience import chaos
+from photon_tpu.resilience import io as rio
+
+MAGIC = "PHOTDSTR"
+SCHEMA = 1
+_ALIGN = 64           # ChunkLoader staging-pool granularity
+MANIFEST = "manifest.json"
+CURSOR = "_convert_cursor.json"
+_SCAN_BUF = 4 << 20   # buffered crc scan: keeps verify RSS at 4MB
+
+
+class DataStoreCorruptError(RuntimeError):
+    """The on-disk training-data store failed an integrity gate; loading
+    anyway could train on silently truncated or bit-flipped rows."""
+
+    def __init__(self, path: str, detail: str):
+        super().__init__(f"data store {path!r} refused: {detail}")
+        self.path = path
+        self.detail = detail
+
+
+# -- crc-wrapped JSON documents (manifest + conversion cursor) --------------
+
+def _wrap_json(doc: dict) -> bytes:
+    payload = json.dumps(doc, sort_keys=True)
+    return json.dumps({"crc32": zlib.crc32(payload.encode()),
+                       "payload": doc}, sort_keys=True).encode()
+
+
+def _unwrap_json(blob: bytes, path: str) -> dict:
+    try:
+        outer = json.loads(blob.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise DataStoreCorruptError(path, f"torn/unparseable JSON: {e}")
+    if not isinstance(outer, dict) or "payload" not in outer:
+        raise DataStoreCorruptError(path, "missing crc envelope")
+    payload = outer["payload"]
+    want = outer.get("crc32")
+    got = zlib.crc32(json.dumps(payload, sort_keys=True).encode())
+    if want != got:
+        raise DataStoreCorruptError(
+            path, f"crc mismatch (manifest says {want}, computed {got})")
+    return payload
+
+
+# -- shared ELL assembly (bitwise contract with CsrSource.read_block) -------
+
+def ell_from_csr(indptr: np.ndarray, cols: np.ndarray, vals: np.ndarray,
+                 k: int, dtype) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """CSR rows -> (idx, val, row_nnz) padded-ELL block, with the EXACT
+    numpy operations ``data/streaming.CsrSource.read_block`` uses — zeros
+    padding, int32 indices, cast-on-assignment — so a store converted
+    here is bitwise identical to what the in-RAM source materializes."""
+    indptr = np.asarray(indptr, np.int64)
+    r = len(indptr) - 1
+    row_nnz = np.diff(indptr)
+    if int(row_nnz.max(initial=0)) > k:
+        raise ValueError(f"row has {int(row_nnz.max())} nonzeros > "
+                         f"ell_width={k}; refusing to silently truncate")
+    idx = np.zeros((r, k), np.int32)
+    val = np.zeros((r, k), np.dtype(dtype))
+    if r and k:
+        slot = np.arange(k)[None, :]
+        mask = slot < row_nnz[:, None]
+        src = indptr[:-1, None] + slot
+        idx[mask] = cols[src[mask]]
+        val[mask] = vals[src[mask]]
+    return idx, val, row_nnz.astype(np.int32)
+
+
+# -- section schema ---------------------------------------------------------
+
+def _section_schema(dim: int, ell_width: Optional[int], dtype: np.dtype,
+                    has_offsets: bool, has_weights: bool) -> Dict[str, dict]:
+    """name -> {dtype, cols} for every section this store carries (cols=0
+    means a flat [n] column)."""
+    dt = np.dtype(dtype).str
+    secs = {"labels": {"dtype": dt, "cols": 0}}
+    if has_weights:
+        secs["weights"] = {"dtype": dt, "cols": 0}
+    if has_offsets:
+        secs["offsets"] = {"dtype": dt, "cols": 0}
+    if ell_width is None:
+        secs["x"] = {"dtype": dt, "cols": int(dim)}
+    else:
+        secs["idx"] = {"dtype": np.dtype(np.int32).str,
+                       "cols": int(ell_width)}
+        secs["val"] = {"dtype": dt, "cols": int(ell_width)}
+        secs["nnz"] = {"dtype": np.dtype(np.int32).str, "cols": 0}
+    return secs
+
+
+def _row_bytes(spec: dict) -> int:
+    return np.dtype(spec["dtype"]).itemsize * max(1, int(spec["cols"]) or 1)
+
+
+# ===========================================================================
+# Writer: resumable, cursor-checkpointed section appender
+# ===========================================================================
+
+class DataStoreWriter:
+    """Append-only store builder with a resumable conversion cursor.
+
+    The converter appends row batches, calls ``mark_unit`` after each
+    completed input unit (a file, a directory), and ``finalize`` once.
+    A kill between ``mark_unit`` calls loses at most one unit of work:
+    ``resume=True`` truncates the ``.part`` sections back to the cursor
+    and the converter re-runs only the units the cursor does not list —
+    deterministically, so the finished store is byte-identical to an
+    uninterrupted conversion.
+    """
+
+    def __init__(self, path: str, *, dim: int, dtype=np.float64,
+                 ell_width: Optional[int] = None, has_offsets: bool = False,
+                 has_weights: bool = False, chunk_rows: int = 8192,
+                 num_shards: int = 1, source: Optional[dict] = None,
+                 resume: bool = False):
+        if chunk_rows <= 0 or chunk_rows % 8:
+            # multiples of 8 rows keep every chunk boundary 64-byte
+            # aligned for all section dtypes (f32 rows: 8*4 = 32... the
+            # widest flat column is 8 bytes, 8 rows * 8B = 64)
+            raise ValueError(f"chunk_rows={chunk_rows} must be a positive "
+                             "multiple of 8 (64-byte chunk alignment)")
+        self.path = path
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        self.ell_width = None if ell_width is None else int(ell_width)
+        self.chunk_rows = int(chunk_rows)
+        self.num_shards = int(num_shards)
+        self.source = dict(source or {})
+        self._schema = _section_schema(dim, self.ell_width, self.dtype,
+                                       has_offsets, has_weights)
+        self._rows = 0
+        self._crcs = {name: 0 for name in self._schema}
+        self._units: List[str] = []
+        self._finalized = False
+        os.makedirs(path, exist_ok=True)
+        if resume and os.path.exists(os.path.join(path, CURSOR)):
+            self._resume_from_cursor()
+        mode = "r+b" if resume and self._rows else "wb"
+        self._files = {name: open(self._part(name), mode)
+                       for name in self._schema}
+        for name, f in self._files.items():
+            f.seek(self._rows * _row_bytes(self._schema[name]))
+            f.truncate()
+
+    def _part(self, name: str) -> str:
+        return os.path.join(self.path, f"{name}.sec.part")
+
+    @property
+    def rows(self) -> int:
+        return self._rows
+
+    @property
+    def units_done(self) -> Tuple[str, ...]:
+        """Input units already durably recorded — the converter skips
+        these on resume."""
+        return tuple(self._units)
+
+    # -- cursor -------------------------------------------------------------
+
+    def _cursor_doc(self) -> dict:
+        return {
+            "magic": MAGIC, "schema": SCHEMA, "rows": self._rows,
+            "dim": self.dim, "dtype": self.dtype.str,
+            "ell_width": self.ell_width, "chunk_rows": self.chunk_rows,
+            "num_shards": self.num_shards,
+            "sections": {n: {"bytes": self._rows * _row_bytes(s),
+                             "crc32": self._crcs[n]}
+                         for n, s in self._schema.items()},
+            "units": list(self._units),
+        }
+
+    def _resume_from_cursor(self) -> None:
+        cpath = os.path.join(self.path, CURSOR)
+        cur = _unwrap_json(rio.read_bytes(cpath, op="data_store.cursor"),
+                           cpath)
+        for key, want in (("magic", MAGIC), ("schema", SCHEMA),
+                          ("dim", self.dim), ("dtype", self.dtype.str),
+                          ("ell_width", self.ell_width),
+                          ("chunk_rows", self.chunk_rows),
+                          ("num_shards", self.num_shards)):
+            if cur.get(key) != want:
+                raise DataStoreCorruptError(
+                    cpath, f"cursor {key}={cur.get(key)!r} does not match "
+                           f"this conversion's {want!r}")
+        if set(cur["sections"]) != set(self._schema):
+            raise DataStoreCorruptError(cpath, "cursor section set skew")
+        self._rows = int(cur["rows"])
+        for name, rec in cur["sections"].items():
+            want = self._rows * _row_bytes(self._schema[name])
+            if int(rec["bytes"]) != want:
+                raise DataStoreCorruptError(
+                    cpath, f"cursor bytes for {name!r} != rows * row_bytes")
+            part = self._part(name)
+            have = os.path.getsize(part) if os.path.exists(part) else -1
+            if have < want:
+                raise DataStoreCorruptError(
+                    cpath, f"section {name}.sec.part is {have} bytes, "
+                           f"shorter than the cursor's {want} — the store "
+                           "lost data the cursor says was durable")
+            self._crcs[name] = int(rec["crc32"])
+        self._units = [str(u) for u in cur["units"]]
+
+    # -- appending ----------------------------------------------------------
+
+    def _append_one(self, name: str, arr: Optional[np.ndarray],
+                    rows: int) -> None:
+        spec = self._schema[name]
+        want_dt = np.dtype(spec["dtype"])
+        cols = int(spec["cols"])
+        shape = (rows, cols) if cols else (rows,)
+        if arr is None:
+            raise ValueError(f"store schema includes section {name!r} but "
+                             "append() received None for it")
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype != want_dt:
+            raise ValueError(f"section {name!r} expects dtype {want_dt}, "
+                             f"got {arr.dtype} (cast explicitly — silent "
+                             "casts would break bitwise parity)")
+        if arr.shape != shape:
+            raise ValueError(f"section {name!r} expects shape {shape}, "
+                             f"got {arr.shape}")
+        data = arr.tobytes()
+        self._crcs[name] = zlib.crc32(data, self._crcs[name])
+        self._files[name].write(data)
+
+    def append(self, labels: np.ndarray, *, x: Optional[np.ndarray] = None,
+               idx: Optional[np.ndarray] = None,
+               val: Optional[np.ndarray] = None,
+               nnz: Optional[np.ndarray] = None,
+               offsets: Optional[np.ndarray] = None,
+               weights: Optional[np.ndarray] = None) -> None:
+        if self._finalized:
+            raise RuntimeError("writer already finalized")
+        rows = int(np.shape(labels)[0])
+        by_name = {"labels": labels, "x": x, "idx": idx, "val": val,
+                   "nnz": nnz, "offsets": offsets, "weights": weights}
+        for name in self._schema:
+            self._append_one(name, by_name[name], rows)
+        self._rows += rows
+
+    def append_csr(self, labels: np.ndarray, indptr: np.ndarray,
+                   cols: np.ndarray, vals: np.ndarray, *,
+                   offsets: Optional[np.ndarray] = None,
+                   weights: Optional[np.ndarray] = None) -> None:
+        """Append CSR rows, assembling the stored padded-ELL block with
+        the CsrSource-bitwise ``ell_from_csr``."""
+        if self.ell_width is None:
+            raise ValueError("append_csr on a dense store")
+        idx, val, nnz = ell_from_csr(indptr, cols, vals, self.ell_width,
+                                     self.dtype)
+        self.append(np.asarray(labels, self.dtype), idx=idx, val=val,
+                    nnz=nnz, offsets=offsets, weights=weights)
+
+    def mark_unit(self, unit_id: str) -> None:
+        """Durably record one completed input unit: flush + fsync the
+        section data, then publish the cursor. The chaos kill point sits
+        between the two — data durable, cursor stale — the harshest spot
+        for resume correctness (the unit is re-converted and must land
+        byte-identically)."""
+        for f in self._files.values():
+            f.flush()
+            os.fsync(f.fileno())
+        if chaos.should_kill_convert(len(self._units)):
+            raise chaos.SimulatedKill(
+                f"chaos: killed conversion after unit {unit_id!r} data "
+                "write, before its cursor advance")
+        self._units.append(str(unit_id))
+        rio.atomic_write_bytes(os.path.join(self.path, CURSOR),
+                               _wrap_json(self._cursor_doc()),
+                               op="data_store.cursor")
+
+    # -- finalize -----------------------------------------------------------
+
+    def _chunk_nnz(self) -> Optional[List[int]]:
+        """Per-chunk nnz headers from the nnz section (read back buffered,
+        resume-proof — the writer's in-memory state never has to carry
+        partial chunk sums across a kill)."""
+        if self.ell_width is None:
+            return None
+        nnz = np.fromfile(self._part("nnz"), np.int32)
+        starts = np.arange(0, self._rows, self.chunk_rows)
+        return [int(v) for v in np.add.reduceat(nnz.astype(np.int64),
+                                                starts)] if self._rows \
+            else []
+
+    def finalize(self) -> dict:
+        """Rename sections into place and publish the manifest LAST.
+        Returns the manifest payload."""
+        if self._finalized:
+            raise RuntimeError("writer already finalized")
+        chunk_nnz = self._chunk_nnz()
+        for f in self._files.values():
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+        num_chunks = max(1, -(-self._rows // self.chunk_rows)) \
+            if self._rows else 0
+        from photon_tpu.parallel.partition import entity_shard
+        chunk_shards = [entity_shard(f"chunk-{c}", self.num_shards)
+                        for c in range(num_chunks)]
+        sections = {}
+        for name, spec in self._schema.items():
+            final = os.path.join(self.path, f"{name}.sec")
+            os.replace(self._part(name), final)
+            sections[name] = {
+                "dtype": spec["dtype"], "cols": spec["cols"],
+                "bytes": self._rows * _row_bytes(spec),
+                "crc32": self._crcs[name],
+            }
+        rio.fsync_dir(self.path)
+        manifest = {
+            "magic": MAGIC, "schema": SCHEMA,
+            "dtype": self.dtype.str, "n_rows": self._rows,
+            "dim": self.dim, "ell_width": self.ell_width,
+            "has_offsets": "offsets" in self._schema,
+            "has_weights": "weights" in self._schema,
+            "chunk_rows": self.chunk_rows, "num_chunks": num_chunks,
+            "num_shards": self.num_shards, "chunk_shards": chunk_shards,
+            "chunk_nnz": chunk_nnz,
+            "sections": sections,
+            "source": self.source,
+        }
+        rio.atomic_write_bytes(os.path.join(self.path, MANIFEST),
+                               _wrap_json(manifest),
+                               op="data_store.manifest")
+        cursor = os.path.join(self.path, CURSOR)
+        if os.path.exists(cursor):
+            os.remove(cursor)
+        self._finalized = True
+        return manifest
+
+    def abort(self) -> None:
+        """Close part files without publishing (error-path cleanup; the
+        cursor and parts stay for a later resume)."""
+        for f in self._files.values():
+            if not f.closed:
+                f.close()
+
+
+# ===========================================================================
+# Reader: typed-refusal manifest gate + mmap section views
+# ===========================================================================
+
+class DataStore:
+    """Read side of the store: validates the manifest envelope and every
+    section's size up front (and, with ``verify=True`` — the default —
+    crc-scans all section bytes with bounded 4MB buffers), then serves
+    zero-copy mmap array views per section.
+
+    Sections are mapped ``ACCESS_COPY`` (private, copy-on-write): the
+    arrays are writable as far as the buffer protocol is concerned — so
+    dlpack export, and with it the ChunkLoader's zero-copy alias path,
+    works — but no write can ever reach the store. Consumers treat the
+    views as immutable training data; ``advise_dontneed`` relies on that
+    to drop clean resident pages behind a streaming cursor.
+    """
+
+    def __init__(self, path: str, *, verify: bool = True):
+        self.path = path
+        mpath = os.path.join(path, MANIFEST)
+        if not os.path.exists(mpath):
+            raise DataStoreCorruptError(
+                path, "no manifest.json — not a data store (or its "
+                      "conversion never finalized)")
+        man = _unwrap_json(rio.read_bytes(mpath, op="data_store.manifest"),
+                           mpath)
+        if man.get("magic") != MAGIC or man.get("schema") != SCHEMA:
+            raise DataStoreCorruptError(
+                path, f"bad magic/schema {man.get('magic')!r}/"
+                      f"{man.get('schema')!r} (want {MAGIC!r}/{SCHEMA})")
+        for key in ("dtype", "n_rows", "dim", "ell_width", "chunk_rows",
+                    "num_chunks", "num_shards", "chunk_shards", "sections"):
+            if key not in man:
+                raise DataStoreCorruptError(path,
+                                            f"manifest missing {key!r}")
+        if len(man["chunk_shards"]) != man["num_chunks"]:
+            raise DataStoreCorruptError(
+                path, "chunk_shards length != num_chunks")
+        want_secs = _section_schema(
+            man["dim"], man["ell_width"], np.dtype(man["dtype"]),
+            man["has_offsets"], man["has_weights"])
+        if set(man["sections"]) != set(want_secs):
+            raise DataStoreCorruptError(
+                path, f"section set {sorted(man['sections'])} does not "
+                      f"match schema {sorted(want_secs)}")
+        for name, rec in man["sections"].items():
+            spath = os.path.join(path, f"{name}.sec")
+            if not os.path.exists(spath):
+                raise DataStoreCorruptError(path,
+                                            f"missing section {name}.sec")
+            size = os.path.getsize(spath)
+            want = int(man["n_rows"]) * _row_bytes(rec)
+            if size != want or int(rec["bytes"]) != want:
+                raise DataStoreCorruptError(
+                    path, f"section {name}.sec is {size} bytes, manifest "
+                          f"rows demand {want} — refusing the short/long "
+                          "read")
+        self.manifest = man
+        self._maps: Dict[str, Tuple[object, np.ndarray]] = {}
+        self._page = mmap.ALLOCATIONGRANULARITY
+        if verify:
+            self.verify()
+
+    def verify(self) -> None:
+        """Buffered crc32 scan of every section against the manifest —
+        bounded host memory (one 4MB buffer), typed refusal on any flip."""
+        for name, rec in self.manifest["sections"].items():
+            spath = os.path.join(self.path, f"{name}.sec")
+            crc = 0
+            with open(spath, "rb") as f:
+                while True:
+                    buf = f.read(_SCAN_BUF)
+                    if not buf:
+                        break
+                    crc = zlib.crc32(buf, crc)
+            if crc != int(rec["crc32"]):
+                raise DataStoreCorruptError(
+                    self.path, f"section {name}.sec crc mismatch "
+                               f"(manifest {rec['crc32']}, scanned {crc}) "
+                               "— bit flip or torn write")
+
+    # -- mmap views ---------------------------------------------------------
+
+    def section(self, name: str) -> np.ndarray:
+        """Zero-copy array view of one section (cached mmap)."""
+        if name in self._maps:
+            return self._maps[name][1]
+        rec = self.manifest["sections"][name]
+        spath = os.path.join(self.path, f"{name}.sec")
+        n = int(self.manifest["n_rows"])
+        cols = int(rec["cols"])
+        with open(spath, "rb") as f:
+            if n == 0:
+                arr = np.zeros((n, cols) if cols else (n,),
+                               np.dtype(rec["dtype"]))
+                self._maps[name] = (None, arr)
+                return arr
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
+        arr = np.frombuffer(mm, dtype=np.dtype(rec["dtype"]))
+        if cols:
+            arr = arr.reshape(n, cols)
+        self._maps[name] = (mm, arr)
+        return arr
+
+    def advise_dontneed(self, row_lo: int, row_hi: int) -> None:
+        """Release resident pages of rows [row_lo, row_hi) across every
+        mapped section — the streaming source calls this behind its read
+        cursor so a full pass's resident-set high-water stays a small
+        window instead of the whole dataset. Purely an RSS hint: the
+        pages are clean and file-backed, so a racing reader simply
+        re-faults the same bytes."""
+        if row_hi <= row_lo or not hasattr(mmap.mmap, "madvise"):
+            return
+        for name, (mm, _arr) in self._maps.items():
+            if mm is None:
+                continue
+            rb = _row_bytes(self.manifest["sections"][name])
+            lo = -(-(row_lo * rb) // self._page) * self._page  # round up
+            hi = (row_hi * rb) // self._page * self._page      # round down
+            if hi > lo:
+                mm.madvise(mmap.MADV_DONTNEED, lo, hi - lo)
+
+    def close(self) -> None:
+        maps, self._maps = self._maps, {}
+        for mm, _arr in maps.values():
+            if mm is not None:
+                # the array still references the buffer; drop our handle
+                # and let refcounting unmap when consumers are done
+                del _arr
+
+    def describe(self) -> dict:
+        m = self.manifest
+        return {
+            "path": self.path, "rows": m["n_rows"], "dim": m["dim"],
+            "ell_width": m["ell_width"], "dtype": m["dtype"],
+            "chunk_rows": m["chunk_rows"], "num_chunks": m["num_chunks"],
+            "num_shards": m["num_shards"],
+            "bytes": sum(int(s["bytes"]) for s in m["sections"].values()),
+            "source": m.get("source", {}),
+        }
+
+
+# ===========================================================================
+# One-shot array writer (tests / in-memory conversion)
+# ===========================================================================
+
+def write_data_store(path: str, labels: np.ndarray, *,
+                     x: Optional[np.ndarray] = None,
+                     indptr: Optional[np.ndarray] = None,
+                     cols: Optional[np.ndarray] = None,
+                     vals: Optional[np.ndarray] = None,
+                     dim: Optional[int] = None,
+                     ell_width: Optional[int] = None,
+                     offsets: Optional[np.ndarray] = None,
+                     weights: Optional[np.ndarray] = None,
+                     dtype=np.float64, chunk_rows: int = 8192,
+                     num_shards: int = 1,
+                     source: Optional[dict] = None) -> dict:
+    """Build a store from in-memory arrays: dense ``x`` [n, dim] or CSR
+    ``(indptr, cols, vals)``. Returns the manifest payload."""
+    dt = np.dtype(dtype)
+    labels = np.asarray(labels, dt)
+    if x is not None:
+        dim = int(x.shape[1]) if dim is None else int(dim)
+        w = DataStoreWriter(path, dim=dim, dtype=dt, ell_width=None,
+                            has_offsets=offsets is not None,
+                            has_weights=weights is not None,
+                            chunk_rows=chunk_rows, num_shards=num_shards,
+                            source=source)
+        w.append(labels, x=np.asarray(x, dt), offsets=offsets,
+                 weights=weights)
+    else:
+        if indptr is None or cols is None or vals is None or dim is None:
+            raise ValueError("sparse store needs indptr/cols/vals/dim")
+        indptr = np.asarray(indptr, np.int64)
+        widest = int(np.diff(indptr).max(initial=0))
+        k = widest if ell_width is None else int(ell_width)
+        w = DataStoreWriter(path, dim=int(dim), dtype=dt, ell_width=k,
+                            has_offsets=offsets is not None,
+                            has_weights=weights is not None,
+                            chunk_rows=chunk_rows, num_shards=num_shards,
+                            source=source)
+        w.append_csr(labels, indptr - indptr[0], cols, vals,
+                     offsets=offsets, weights=weights)
+    w.mark_unit("arrays")
+    return w.finalize()
+
+
+# ===========================================================================
+# Converters: LibSVM text and Avro feature bags -> store
+# ===========================================================================
+
+def _parse_libsvm_file(path: str, zero_based: bool):
+    """Raw columnar parse of ONE LibSVM file via the native tokenizer,
+    python fallback otherwise — the same ladder ``read_libsvm`` uses, so
+    converted bytes match the in-RAM ingest bit for bit."""
+    from photon_tpu.data import ingest
+    try:
+        parsed = ingest._parse_libsvm_native([path], zero_based)
+    except (MemoryError, ValueError):
+        raise
+    except Exception:  # noqa: BLE001 — optional fast path, never fatal
+        parsed = None
+    if parsed is None:
+        parsed = ingest._parse_libsvm_python([path], zero_based)
+    return parsed
+
+
+def _libsvm_units(path: str) -> List[str]:
+    if os.path.isdir(path):
+        return sorted(os.path.join(path, f) for f in os.listdir(path)
+                      if not f.startswith("."))
+    return [path]
+
+
+def _libsvm_scan(files: Sequence[str], zero_based: bool) -> dict:
+    """Global pass-0 facts the per-file conversion needs to reproduce
+    ``read_libsvm`` on the concatenated input: feature dimension, widest
+    row, and whether the label alphabet is the {-1,+1} convention (the
+    remap decision is global — one file of {0,1} labels flips it for the
+    whole dataset, exactly as the in-RAM reader would see)."""
+    dim = 0
+    max_nnz = 0
+    remap = True
+    n_rows = 0
+    for fp in files:
+        labels, indptr, cols, vals = _parse_libsvm_file(fp, zero_based)
+        if len(cols) and int(cols.min()) < 0:
+            raise ValueError("negative feature index (1-based data parsed "
+                             "with zero_based=True?)")
+        if len(cols):
+            dim = max(dim, int(cols.max()) + 1)
+        if len(labels):
+            max_nnz = max(max_nnz, int(np.diff(indptr).max()))
+        remap = remap and set(np.unique(labels)) <= {-1.0, 1.0}
+        n_rows += len(labels)
+    return {"dim": dim, "max_nnz": max_nnz,
+            "remap_pm1": bool(remap and n_rows > 0), "n_rows": n_rows}
+
+
+def convert_libsvm(input_path: str, out_path: str, *,
+                   dim: Optional[int] = None, add_intercept: bool = True,
+                   zero_based: bool = False, dtype=np.float64,
+                   chunk_rows: int = 8192, num_shards: int = 1,
+                   max_nnz: Optional[int] = None,
+                   resume: bool = False) -> dict:
+    """One-time LibSVM text -> chunk store conversion, one resumable
+    unit per input file. The result streams bitwise identically to
+    ``chunk_source(read_libsvm(input_path, ...), dtype=...)``: same file
+    order, same global label remap / intercept / dimension decisions,
+    same ELL assembly. Peak host memory is one parsed file, never the
+    dataset. Returns the manifest payload."""
+    files = _libsvm_units(input_path)
+    if not files:
+        raise FileNotFoundError(f"no LibSVM files under {input_path!r}")
+    scan = _libsvm_scan(files, zero_based)
+    d = int(dim) if dim is not None else scan["dim"]
+    k = int(max_nnz) if max_nnz is not None else scan["max_nnz"]
+    if add_intercept:
+        k += 1
+    if scan["n_rows"] == 0:
+        k = max(k, 1 if add_intercept else 0)
+    writer = DataStoreWriter(
+        out_path, dim=d + 1 if add_intercept else d, dtype=dtype,
+        ell_width=k, chunk_rows=chunk_rows, num_shards=num_shards,
+        resume=resume,
+        source={"kind": "libsvm", "input": os.path.abspath(input_path),
+                "files": [os.path.basename(f) for f in files],
+                "add_intercept": bool(add_intercept),
+                "zero_based": bool(zero_based), "scan": scan})
+    try:
+        done = set(writer.units_done)
+        for fp in files:
+            unit = os.path.basename(fp)
+            if unit in done:
+                continue
+            labels, indptr, cols, vals = _parse_libsvm_file(fp, zero_based)
+            y = labels
+            if scan["remap_pm1"]:
+                y = (y + 1.0) / 2.0
+            if add_intercept:
+                # same vectorized append read_libsvm uses: a constant-1
+                # slot at index d on every row (row-local => per-file
+                # application equals the global one)
+                n = len(y)
+                cols = np.insert(cols, indptr[1:], d).astype(np.int32)
+                vals = np.insert(vals, indptr[1:], 1.0)
+                indptr = indptr + np.arange(n + 1, dtype=np.int64)
+            writer.append_csr(np.asarray(y, writer.dtype), indptr, cols,
+                              vals)
+            writer.mark_unit(unit)
+        manifest = writer.finalize()
+    except BaseException:
+        writer.abort()
+        raise
+    return manifest
+
+
+def convert_avro(input_dirs: Sequence[str], out_path: str, *,
+                 feature_bags: Sequence[str] = ("features",),
+                 intercept: bool = True, dtype=np.float64,
+                 chunk_rows: int = 8192, num_shards: int = 1,
+                 max_nnz: Optional[int] = None,
+                 resume: bool = False) -> dict:
+    """Avro feature-bag records -> chunk store, through the vectorized
+    ``io/fast_ingest.read_frame_with_fallback`` ladder (native columnar
+    decode when available, generic ``io/avro.py`` otherwise — identical
+    output either way). One resumable unit per input directory; the
+    feature index map is built over ALL inputs first so per-dir batches
+    share one index space. Returns the manifest payload."""
+    from photon_tpu.io.data_io import FeatureShardConfiguration
+    from photon_tpu.io.fast_ingest import read_frame_with_fallback
+
+    input_dirs = list(input_dirs)
+    if not input_dirs:
+        raise FileNotFoundError("no Avro input directories")
+    cfg = {"store": FeatureShardConfiguration.of(*feature_bags,
+                                                 intercept=intercept)}
+    # pass 0: the full frame fixes the global facts every per-dir unit
+    # must share — one feature index space, the widest row (the static
+    # ELL width), and which optional per-row columns exist
+    full, maps = read_frame_with_fallback(input_dirs, cfg)
+    d = maps["store"].feature_dimension
+    k = int(max_nnz) if max_nnz is not None \
+        else max(1, full.feature_shards["store"].max_nnz())
+    writer = DataStoreWriter(
+        out_path, dim=d, dtype=dtype, ell_width=k,
+        has_offsets=full.offsets is not None,
+        has_weights=full.weights is not None,
+        chunk_rows=chunk_rows, num_shards=num_shards, resume=resume,
+        source={"kind": "avro",
+                "inputs": [os.path.abspath(p) for p in input_dirs],
+                "feature_bags": list(feature_bags),
+                "intercept": bool(intercept)})
+    try:
+        for i, indir in enumerate(input_dirs):
+            if str(i) in writer.units_done:
+                continue
+            frame = full if len(input_dirs) == 1 else \
+                read_frame_with_fallback([indir], cfg,
+                                         index_maps=maps)[0]
+            indptr, ccols, cvals = _csr_arrays(
+                frame.feature_shards["store"].rows)
+            dt = writer.dtype
+            writer.append_csr(
+                np.asarray(frame.response, dt), indptr, ccols, cvals,
+                offsets=None if frame.offsets is None
+                else np.asarray(frame.offsets, dt),
+                weights=None if frame.weights is None
+                else np.asarray(frame.weights, dt))
+            writer.mark_unit(str(i))
+        manifest = writer.finalize()
+    except BaseException:
+        writer.abort()
+        raise
+    return manifest
+
+
+def _csr_arrays(rows) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """FeatureShard rows (CsrRows, list-form SparseRows, or dense) ->
+    (indptr, cols, vals) CSR arrays."""
+    from photon_tpu.game.dataset import CsrRows
+    if isinstance(rows, np.ndarray):
+        rows = CsrRows.from_dense(rows)
+    if isinstance(rows, CsrRows):
+        return rows.indptr, rows.cols, rows.vals
+    indptr = np.zeros(len(rows) + 1, np.int64)
+    cols_l, vals_l = [], []
+    for i, (ci, vi) in enumerate(rows):
+        indptr[i + 1] = indptr[i] + len(ci)
+        cols_l.append(np.asarray(ci, np.int64))
+        vals_l.append(np.asarray(vi, np.float64))
+    cols = (np.concatenate(cols_l) if cols_l
+            else np.zeros(0, np.int64))
+    vals = (np.concatenate(vals_l) if vals_l
+            else np.zeros(0, np.float64))
+    return indptr, cols, vals

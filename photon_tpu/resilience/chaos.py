@@ -53,6 +53,17 @@ hook points consult it:
   at the configured ``stream_kill_at`` writes the chunk-cursor
   checkpoint and raises ``SimulatedKill`` (fires once), the mid-epoch
   preemption the bitwise-resume test replays.
+- ``should_kill_convert(unit_idx)`` — io/data_store.py's writer asks
+  after fsyncing each input unit's section bytes, BEFORE advancing the
+  conversion cursor; a hit raises ``SimulatedKill`` at that harshest
+  point (durable but unclaimed bytes), and resume must truncate back to
+  the cursor and land on a byte-identical store (fires once).
+- data-store injectors (``datastore_torn_manifest``,
+  ``datastore_corrupt_section``) — deterministic helpers that tear a
+  training-data store's manifest to half its bytes or bit-flip one
+  section byte; ``io/data_store.DataStore`` must refuse both with a
+  typed ``DataStoreCorruptError`` — never a silent short read into a
+  fit.
 - ``should_poison_publish_row()`` — nearline/publisher.py asks while
   building the final commit payload (AFTER the gate ladder has passed);
   a hit NaN-poisons one published row so the post-apply readback verify
@@ -146,6 +157,10 @@ class ChaosConfig:
     # streamed solver: (pass index, chunk index) after whose accumulation
     # the consumer checkpoints its chunk cursor and dies (fires once)
     stream_kill_at: Optional[Tuple[int, int]] = None
+    # data-store conversion: unit index after whose data write (fsynced,
+    # cursor NOT yet advanced) the converter dies (fires once) — resume
+    # must re-convert that unit and land on a byte-identical store
+    convert_kill_at: Optional[int] = None
     # serving fleet: shard id whose clients answer nothing (a dead
     # process); stays dead for the config's lifetime — kill, not flake
     shard_kill_id: Optional[int] = None
@@ -181,6 +196,7 @@ class _State:
         self.chunk_read_delays_done = 0
         self.chunk_read_errors_done = 0
         self.stream_kill_fired = False
+        self.convert_kill_fired = False
         self.shard_slow_done = 0
         self.tenant_floods_done = 0
 
@@ -345,6 +361,71 @@ def should_kill_stream(pass_idx: int, chunk_idx: int) -> bool:
             return False
         s.stream_kill_fired = True
     return True
+
+
+def should_kill_convert(unit_idx: int) -> bool:
+    """True exactly once when the data-store converter finishes the data
+    write (flushed + fsynced) of input unit ``unit_idx`` and the
+    installed config names that index — the writer raises
+    ``SimulatedKill`` BEFORE advancing its conversion cursor, the
+    harshest kill point: the unit's bytes are durable but unclaimed, so
+    resume must truncate them away and re-convert the unit to a
+    byte-identical store."""
+    s = _active
+    if s is None or s.config.convert_kill_at is None:
+        return False
+    with s.lock:
+        if s.convert_kill_fired:
+            return False
+        if s.config.convert_kill_at != unit_idx:
+            return False
+        s.convert_kill_fired = True
+    return True
+
+
+def datastore_torn_manifest(store_dir: str) -> int:
+    """Tear a data store's manifest: truncate ``manifest.json`` to half
+    its bytes — the shape a kill between tmp-write and rename (or a
+    partial copy) leaves. Returns the number of bytes removed.
+    ``io/data_store.DataStore``'s crc envelope must refuse the torn
+    document with a typed ``DataStoreCorruptError``; a trainer must
+    never fit on guessed row counts (a silent short read)."""
+    import os
+
+    path = os.path.join(store_dir, "manifest.json")
+    size = os.path.getsize(path)
+    if size < 2:
+        raise ValueError(f"data-store manifest too small to tear: "
+                         f"{path!r}")
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+    return size - size // 2
+
+
+def datastore_corrupt_section(store_dir: str, seed: int = 0) -> Tuple[str,
+                                                                      int]:
+    """Deterministically flip one byte of one ``.sec`` section file in a
+    data store (file and offset chosen by crc32(seed)) — silent media
+    corruption aimed at the training bytes themselves. The store's
+    per-section crc32 verify must refuse with ``DataStoreCorruptError``:
+    a flipped label or feature value may never reach a fit. Returns
+    (corrupted file path, flipped offset)."""
+    import os
+
+    secs = sorted(n for n in os.listdir(store_dir) if n.endswith(".sec")
+                  and os.path.getsize(os.path.join(store_dir, n)) > 0)
+    if not secs:
+        raise ValueError(f"no non-empty sections under {store_dir!r}")
+    name = secs[zlib.crc32(str(seed).encode()) % len(secs)]
+    path = os.path.join(store_dir, name)
+    size = os.path.getsize(path)
+    offset = zlib.crc32(f"{seed}-offset".encode()) % size
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return path, offset
 
 
 def corrupt_cold_store(path: str, seed: int = 0) -> int:

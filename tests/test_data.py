@@ -210,6 +210,49 @@ def test_chunked_native_libsvm_parse_parity(tmp_path, monkeypatch):
     assert all(bytes(pc).endswith(b"\n") for pc in pieces[:-1])
 
 
+def test_split_at_newlines_terminates_final_piece(tmp_path, monkeypatch):
+    """Regression: the splitter's final piece used to end wherever the
+    caller's buffer ended, so a file without a trailing newline handed
+    its last line to the parser unterminated — correctness then hinged
+    on every parser self-handling the partial tail. The splitter now
+    guarantees every returned piece is newline-terminated (the tail gets
+    one appended on a small owned copy), for terminated and unterminated
+    buffers, chunked and whole, and the parse result is identical either
+    way."""
+    import numpy as np
+
+    from photon_tpu.data import ingest
+
+    body = b"\n".join(b"1 1:0.5 2:%d.25" % i for i in range(400))
+
+    monkeypatch.setattr(ingest, "_PARALLEL_CHUNK_BYTES", 256)
+    for data in (body, body + b"\n"):
+        pieces = ingest._split_at_newlines(data, 7)
+        assert len(pieces) > 1
+        assert all(bytes(pc).endswith(b"\n") for pc in pieces)
+        assert b"".join(bytes(pc) for pc in pieces) == \
+            data + (b"" if data.endswith(b"\n") else b"\n")
+
+    # below the chunking threshold the same contract holds
+    monkeypatch.setattr(ingest, "_PARALLEL_CHUNK_BYTES", 1 << 40)
+    (piece,) = ingest._split_at_newlines(b"1 1:0.5", 7)
+    assert bytes(piece) == b"1 1:0.5\n"
+    (piece,) = ingest._split_at_newlines(b"1 1:0.5\n", 7)
+    assert bytes(piece) == b"1 1:0.5\n"
+    assert ingest._split_at_newlines(b"", 7) == [memoryview(b"")]
+
+    # end to end: an unterminated file parses identically to its
+    # terminated twin through the chunked ladder
+    monkeypatch.setattr(ingest, "_PARALLEL_CHUNK_BYTES", 256)
+    p1, p2 = tmp_path / "noeol.svm", tmp_path / "eol.svm"
+    p1.write_bytes(body)
+    p2.write_bytes(body + b"\n")
+    a, b = ingest.read_libsvm(str(p1)), ingest.read_libsvm(str(p2))
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.rows.indptr, b.rows.indptr)
+    np.testing.assert_array_equal(a.rows.vals, b.rows.vals)
+
+
 def test_native_parse_unterminated_buffers():
     """strtod/strtol bounding (ADVICE r4): the C parser must accept
     non-NUL-terminated buffer types (memoryview/bytearray) whose last

@@ -1,0 +1,359 @@
+"""Disk-native training data store: on-disk format invariants, typed
+corruption refusals, resumable conversion, and converter bitwise parity
+with the in-RAM ingest paths.
+
+The load-bearing invariants:
+  * a store either opens whole or refuses typed (``DataStoreCorruptError``)
+    — a torn manifest, a bit-flipped section, or a size-skewed file can
+    never become a silent short read into a fit;
+  * conversion is resumable: a kill after any unit's data fsync (cursor
+    not yet advanced — the harshest point) resumes from the cursor to a
+    byte-identical store;
+  * the converters reproduce the in-RAM ingest bit for bit: LibSVM
+    stores equal ``chunk_source(read_libsvm(...))`` blocks, Avro stores
+    equal the ``read_frame_with_fallback`` frame's CSR rows.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.data import ingest
+from photon_tpu.data.streaming import CsrSource, MmapChunkSource
+from photon_tpu.io import data_store as ds
+from photon_tpu.parallel.partition import entity_shard
+from photon_tpu.resilience import chaos
+
+
+def _csr_dataset(rng, n=900, d=40, kmax=6):
+    indptr = np.zeros(n + 1, np.int64)
+    indptr[1:] = np.cumsum(rng.integers(1, kmax + 1, n))
+    cols = rng.integers(0, d, indptr[-1]).astype(np.int64)
+    vals = rng.normal(size=indptr[-1])
+    labels = rng.integers(0, 2, n).astype(np.float64)
+    return indptr, cols, vals, labels, d
+
+
+def _libsvm_dir(rng, path, files=3, rows=200, d=39, pm1=True):
+    os.makedirs(path, exist_ok=True)
+    for fi in range(files):
+        lines = []
+        for _ in range(rows):
+            y = rng.choice([-1, 1]) if pm1 else rng.integers(0, 2)
+            nz = int(rng.integers(1, 6))
+            ids = np.sort(rng.choice(np.arange(1, d + 1), nz,
+                                     replace=False))
+            lines.append(f"{y} " + " ".join(
+                f"{i}:{rng.normal():.6f}" for i in ids))
+        with open(os.path.join(path, f"part-{fi}.txt"), "w") as f:
+            f.write("\n".join(lines) + "\n")
+    return path
+
+
+def _tree_hash(path):
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(path)):
+        h.update(name.encode())
+        with open(os.path.join(path, name), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+class TestStoreFormat:
+    def test_sparse_roundtrip_blocks_and_chunk_nnz(self, rng, tmp_path):
+        indptr, cols, vals, labels, d = _csr_dataset(rng)
+        p = str(tmp_path / "s")
+        man = ds.write_data_store(p, labels, indptr=indptr, cols=cols,
+                                  vals=vals, dim=d, chunk_rows=64)
+        src = MmapChunkSource(p)
+        ref = CsrSource(indptr, cols, vals, labels, dim=d,
+                        dtype=np.float64)
+        assert (src.num_rows, src.dim, src.ell_width) == \
+            (ref.num_rows, ref.dim, ref.ell_width)
+        for s, e in [(0, 64), (64, 192), (832, 900), (0, 900)]:
+            b1, b2 = src.read_block(s, e), ref.read_block(s, e)
+            np.testing.assert_array_equal(b1.labels, b2.labels)
+            np.testing.assert_array_equal(b1.idx, b2.idx)
+            np.testing.assert_array_equal(b1.val, b2.val)
+        # per-chunk nnz headers sum to the dataset nnz, per chunk
+        nnz = np.diff(indptr)
+        want = [int(nnz[c * 64:(c + 1) * 64].sum())
+                for c in range(man["num_chunks"])]
+        assert man["chunk_nnz"] == want
+
+    def test_dense_roundtrip_with_offsets_weights(self, rng, tmp_path):
+        n, d = 300, 8
+        X = rng.normal(size=(n, d))
+        labels = rng.normal(size=n)
+        offsets = rng.normal(size=n)
+        weights = rng.uniform(0.5, 2.0, size=n)
+        p = str(tmp_path / "dense")
+        man = ds.write_data_store(p, labels, x=X, offsets=offsets,
+                                  weights=weights, chunk_rows=32)
+        assert man["ell_width"] is None
+        assert man["has_offsets"] and man["has_weights"]
+        src = MmapChunkSource(p)
+        b = src.read_block(0, n)
+        np.testing.assert_array_equal(b.x, X)
+        np.testing.assert_array_equal(b.labels, labels)
+        np.testing.assert_array_equal(b.offsets, offsets)
+        np.testing.assert_array_equal(b.weights, weights)
+
+    def test_interior_chunk_slices_are_64b_aligned(self, rng, tmp_path):
+        """The alignment contract behind the loader's zero-copy alias
+        path: sections are page-aligned files, so every chunk boundary
+        at a multiple of 16 rows yields 64-byte-aligned slices for every
+        section dtype (f64 columns, int32 ELL indices of any width)."""
+        indptr, cols, vals, labels, d = _csr_dataset(rng, n=640, kmax=7)
+        p = str(tmp_path / "aligned")
+        ds.write_data_store(p, labels, indptr=indptr, cols=cols,
+                            vals=vals, dim=d, chunk_rows=64)
+        src = MmapChunkSource(p)
+        assert src.ell_width % 2 == 1   # the hostile (odd-width) case
+        for start in range(0, 640, 128):
+            b = src.read_block(start, start + 128)
+            for a in (b.labels, b.idx, b.val):
+                assert a.ctypes.data % 64 == 0
+                assert a.flags["C_CONTIGUOUS"]
+
+    def test_shard_assignment_is_the_crc32_partitioner(self, rng,
+                                                       tmp_path):
+        indptr, cols, vals, labels, d = _csr_dataset(rng, n=1000)
+        p = str(tmp_path / "sharded")
+        man = ds.write_data_store(p, labels, indptr=indptr, cols=cols,
+                                  vals=vals, dim=d, chunk_rows=64,
+                                  num_shards=4)
+        assert man["chunk_shards"] == [
+            entity_shard(f"chunk-{c}", 4)
+            for c in range(man["num_chunks"])]
+        # the shard views partition the store's rows exactly
+        parts = [MmapChunkSource(p, shard_id=s, verify=False)
+                 for s in range(4)]
+        assert sum(x.num_rows for x in parts) == 1000
+        got = np.concatenate(
+            [x.read_block(0, x.num_rows).labels for x in parts])
+        assert sorted(got.tolist()) == sorted(labels.tolist())
+        with pytest.raises(ValueError, match="shard_id"):
+            MmapChunkSource(p, shard_id=4, verify=False)
+
+    def test_writer_refuses_overwide_rows_and_bad_chunk_rows(
+            self, rng, tmp_path):
+        indptr, cols, vals, labels, d = _csr_dataset(rng, n=100)
+        with pytest.raises(ValueError, match="refusing to silently"):
+            ds.write_data_store(str(tmp_path / "narrow"), labels,
+                                indptr=indptr, cols=cols, vals=vals,
+                                dim=d, ell_width=1, chunk_rows=64)
+        with pytest.raises(ValueError, match="multiple of 8"):
+            ds.DataStoreWriter(str(tmp_path / "odd"), dim=4,
+                               chunk_rows=12)
+
+    def test_empty_store_roundtrip(self, tmp_path):
+        p = str(tmp_path / "empty")
+        man = ds.write_data_store(p, np.zeros(0), x=np.zeros((0, 4)))
+        assert man["n_rows"] == 0 and man["num_chunks"] == 0
+        src = MmapChunkSource(p)
+        assert src.num_rows == 0
+
+
+class TestCorruptionRefusals:
+    @pytest.fixture
+    def store(self, rng, tmp_path):
+        indptr, cols, vals, labels, d = _csr_dataset(rng, n=400)
+        p = str(tmp_path / "victim")
+        ds.write_data_store(p, labels, indptr=indptr, cols=cols,
+                            vals=vals, dim=d, chunk_rows=64)
+        return p
+
+    def test_missing_manifest_refuses(self, store):
+        os.remove(os.path.join(store, "manifest.json"))
+        with pytest.raises(ds.DataStoreCorruptError, match="no manifest"):
+            ds.DataStore(store)
+
+    def test_torn_manifest_refuses(self, store):
+        removed = chaos.datastore_torn_manifest(store)
+        assert removed > 0
+        with pytest.raises(ds.DataStoreCorruptError,
+                           match="torn|crc|envelope"):
+            ds.DataStore(store)
+
+    @pytest.mark.parametrize("seed", [0, 1, 7])
+    def test_bit_flipped_section_refuses(self, store, seed):
+        path, _off = chaos.datastore_corrupt_section(store, seed=seed)
+        name = os.path.basename(path).removesuffix(".sec")
+        with pytest.raises(ds.DataStoreCorruptError,
+                           match=f"{name}.sec crc mismatch"):
+            ds.DataStore(store)
+        # verify=False skips the crc scan — the caller opted out, but
+        # the size gate still holds (see the short-read test)
+        ds.DataStore(store, verify=False)
+
+    def test_short_read_refuses_even_without_verify(self, store):
+        vp = os.path.join(store, "val.sec")
+        with open(vp, "r+b") as f:
+            f.truncate(os.path.getsize(vp) // 2)
+        with pytest.raises(ds.DataStoreCorruptError, match="short"):
+            ds.DataStore(store, verify=False)
+
+    def test_oversize_section_refuses(self, store):
+        with open(os.path.join(store, "labels.sec"), "ab") as f:
+            f.write(b"\x00" * 64)
+        with pytest.raises(ds.DataStoreCorruptError):
+            ds.DataStore(store, verify=False)
+
+    def test_missing_section_refuses(self, store):
+        os.remove(os.path.join(store, "idx.sec"))
+        with pytest.raises(ds.DataStoreCorruptError,
+                           match="missing section"):
+            ds.DataStore(store, verify=False)
+
+
+class TestResumableConversion:
+    @pytest.mark.parametrize("kill_at", [0, 1, 2])
+    def test_convert_kill_resumes_byte_identical(self, rng, tmp_path,
+                                                 kill_at):
+        """A kill after any unit's fsynced data write (cursor not yet
+        advanced) leaves durable-but-unclaimed bytes; resume truncates
+        back to the cursor, re-converts that unit, and the finished
+        store is byte-identical to an uninterrupted conversion."""
+        sv = _libsvm_dir(rng, str(tmp_path / "sv"))
+        ref = str(tmp_path / "ref")
+        ds.convert_libsvm(sv, ref, chunk_rows=64)
+
+        victim = str(tmp_path / "killed")
+        with chaos.active(chaos.ChaosConfig(convert_kill_at=kill_at)):
+            with pytest.raises(chaos.SimulatedKill):
+                ds.convert_libsvm(sv, victim, chunk_rows=64)
+        # no manifest: the half-store does not exist as far as any
+        # reader is concerned
+        with pytest.raises(ds.DataStoreCorruptError, match="no manifest"):
+            ds.DataStore(victim)
+        ds.convert_libsvm(sv, victim, chunk_rows=64, resume=True)
+        assert _tree_hash(ref) == _tree_hash(victim)
+        ds.DataStore(victim)   # and it verifies clean
+
+    def test_resume_refuses_geometry_skew(self, rng, tmp_path):
+        sv = _libsvm_dir(rng, str(tmp_path / "sv"), files=2)
+        victim = str(tmp_path / "skew")
+        # kill at unit 1 so unit 0's cursor is already on disk — a kill
+        # at unit 0 predates the first cursor write, so resume would
+        # just start over (nothing durable to disagree with)
+        with chaos.active(chaos.ChaosConfig(convert_kill_at=1)):
+            with pytest.raises(chaos.SimulatedKill):
+                ds.convert_libsvm(sv, victim, chunk_rows=64)
+        with pytest.raises(ds.DataStoreCorruptError, match="chunk_rows"):
+            ds.convert_libsvm(sv, victim, chunk_rows=128, resume=True)
+
+    def test_resume_refuses_lost_part_bytes(self, rng, tmp_path):
+        sv = _libsvm_dir(rng, str(tmp_path / "sv"), files=2)
+        victim = str(tmp_path / "lost")
+        with chaos.active(chaos.ChaosConfig(convert_kill_at=1)):
+            with pytest.raises(chaos.SimulatedKill):
+                ds.convert_libsvm(sv, victim, chunk_rows=64)
+        vp = os.path.join(victim, "val.sec.part")
+        with open(vp, "r+b") as f:
+            f.truncate(8)
+        with pytest.raises(ds.DataStoreCorruptError, match="shorter"):
+            ds.convert_libsvm(sv, victim, chunk_rows=64, resume=True)
+
+
+class TestConverterParity:
+    @pytest.mark.parametrize("pm1", [True, False])
+    def test_libsvm_store_equals_inram_chunk_source(self, rng, tmp_path,
+                                                    pm1):
+        """The store's blocks equal chunk_source(read_libsvm(...))'s bit
+        for bit: same sorted file order, same GLOBAL {-1,+1} label remap
+        decision, same intercept append, same ELL assembly."""
+        sv = _libsvm_dir(rng, str(tmp_path / "sv"), pm1=pm1)
+        p = str(tmp_path / "store")
+        man = ds.convert_libsvm(sv, p, chunk_rows=64)
+        data = ingest.read_libsvm(sv)
+        ref = ingest.chunk_source(data, dtype=np.float64)
+        src = MmapChunkSource(p)
+        assert (src.num_rows, src.dim, src.ell_width) == \
+            (ref.num_rows, ref.dim, ref.ell_width)
+        assert man["source"]["scan"]["remap_pm1"] is pm1
+        b1 = src.read_block(0, src.num_rows)
+        b2 = ref.read_block(0, ref.num_rows)
+        np.testing.assert_array_equal(
+            b1.labels, np.asarray(b2.labels, np.float64))
+        np.testing.assert_array_equal(b1.idx, b2.idx)
+        np.testing.assert_array_equal(b1.val, b2.val)
+
+    def test_mixed_label_alphabet_is_a_global_decision(self, rng,
+                                                       tmp_path):
+        """One {0,1}-labelled file must flip the remap off for EVERY
+        file, exactly as read_libsvm sees the concatenated dataset — a
+        per-file remap would silently relabel half the store."""
+        sv = str(tmp_path / "sv")
+        _libsvm_dir(rng, sv, files=1, pm1=True)
+        with open(os.path.join(sv, "part-9.txt"), "w") as f:
+            f.write("0 1:1.0\n1 2:1.0\n")
+        p = str(tmp_path / "store")
+        ds.convert_libsvm(sv, p, chunk_rows=64)
+        data = ingest.read_libsvm(sv)
+        ref = ingest.chunk_source(data, dtype=np.float64)
+        b1 = MmapChunkSource(p).read_block(0, ref.num_rows)
+        b2 = ref.read_block(0, ref.num_rows)
+        np.testing.assert_array_equal(
+            b1.labels, np.asarray(b2.labels, np.float64))
+        # -1 labels survived un-remapped (alphabet was {-1, 0, 1})
+        assert float(b1.labels.min()) == -1.0
+
+    def test_avro_store_equals_frame_rows(self, rng, tmp_path):
+        from photon_tpu.io.avro import write_avro
+        from photon_tpu.io.data_io import FeatureShardConfiguration
+        from photon_tpu.io.fast_ingest import read_frame_with_fallback
+        from photon_tpu.io.schemas import TRAINING_EXAMPLE_AVRO
+
+        dirs = []
+        for di in range(2):
+            d = str(tmp_path / f"in{di}")
+            os.makedirs(d)
+            dirs.append(d)
+            recs = [
+                {"uid": f"u{di}-{i}",
+                 "label": float(rng.integers(0, 2)),
+                 "features": [
+                     {"name": "g", "term": str(t),
+                      "value": float(rng.normal())}
+                     for t in rng.choice(20, int(rng.integers(1, 5)),
+                                         replace=False)],
+                 "metadataMap": None,
+                 "weight": float(rng.uniform(0.5, 2.0)),
+                 "offset": float(rng.normal())}
+                for i in range(120)]
+            write_avro(os.path.join(d, "p0.avro"),
+                       TRAINING_EXAMPLE_AVRO, recs)
+        p = str(tmp_path / "store")
+        man = ds.convert_avro(dirs, p, chunk_rows=64)
+        cfg = {"store": FeatureShardConfiguration.of("features",
+                                                     intercept=True)}
+        frame, _ = read_frame_with_fallback(dirs, cfg)
+        rows = frame.feature_shards["store"].rows
+        ref = CsrSource(rows.indptr, rows.cols, rows.vals,
+                        np.asarray(frame.response, np.float64),
+                        dim=man["dim"],
+                        offsets=np.asarray(frame.offsets, np.float64),
+                        weights=np.asarray(frame.weights, np.float64),
+                        dtype=np.float64)
+        src = MmapChunkSource(p)
+        assert man["has_offsets"] and man["has_weights"]
+        b1 = src.read_block(0, src.num_rows)
+        b2 = ref.read_block(0, ref.num_rows)
+        for a, b in [(b1.labels, b2.labels), (b1.idx, b2.idx),
+                     (b1.val, b2.val), (b1.offsets, b2.offsets),
+                     (b1.weights, b2.weights)]:
+            np.testing.assert_array_equal(a, np.asarray(b, a.dtype))
+
+    def test_cli_converts_and_describes(self, rng, tmp_path):
+        from photon_tpu.cli import convert_data
+
+        sv = _libsvm_dir(rng, str(tmp_path / "sv"), files=1, rows=100)
+        out = str(tmp_path / "store")
+        desc = convert_data.run(convert_data.build_arg_parser().parse_args(
+            ["--format", "libsvm", "--input", sv, "--output", out,
+             "--chunk-rows", "64", "--num-shards", "2"]))
+        assert desc["rows"] == 100 and desc["num_shards"] == 2
+        assert os.path.exists(os.path.join(out, "manifest.json"))

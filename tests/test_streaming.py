@@ -510,6 +510,148 @@ class TestHierInnerChunks:
             jnp.asarray(0.0, F64), hyper, sharded) == 1
 
 
+class TestMmapSourceParity:
+    """Satellite: the disk-native source must be indistinguishable from
+    the in-RAM sources at the solver level — bitwise-identical fits, not
+    just close ones — across chunk sizes, padded tails, drop-invalid
+    filtering, and kill/resume."""
+
+    def _sparse_store(self, rng, tmp_path, n=900, d=24, kmax=6):
+        from photon_tpu.io.data_store import write_data_store
+
+        indptr = np.zeros(n + 1, np.int64)
+        indptr[1:] = np.cumsum(rng.integers(1, kmax + 1, n))
+        cols = rng.integers(0, d, indptr[-1]).astype(np.int64)
+        vals = rng.normal(size=indptr[-1])
+        y = rng.integers(0, 2, n).astype(np.float64)
+        p = str(tmp_path / "store")
+        write_data_store(p, y, indptr=indptr, cols=cols, vals=vals,
+                         dim=d, chunk_rows=64)
+        return p, (indptr, cols, vals, y, d)
+
+    @staticmethod
+    def _fit(source, chunk_rows, d, **stream_kw):
+        from photon_tpu.data.streaming import MmapChunkSource  # noqa: F401
+
+        loader = ChunkLoader(
+            source, StreamConfig(chunk_rows=chunk_rows, dtype=np.float64,
+                                 **stream_kw))
+        return minimize_streamed(
+            StreamedProblem(_objective(), loader, l2_weight=L2),
+            np.zeros(d))
+
+    @pytest.mark.parametrize("chunk_rows", [128, 300])
+    def test_fit_bitwise_vs_csr_source(self, rng, tmp_path, chunk_rows):
+        """Same solver iterates off disk as off RAM — divisible chunks
+        and the non-divisible case (300 -> pow2 512, padded tail)."""
+        from photon_tpu.data.streaming import MmapChunkSource
+
+        p, (indptr, cols, vals, y, d) = self._sparse_store(rng, tmp_path)
+        ref = self._fit(CsrSource(indptr, cols, vals, y, dim=d,
+                                  dtype=np.float64), chunk_rows, d)
+        res = self._fit(MmapChunkSource(p), chunk_rows, d)
+        assert np.array_equal(np.asarray(ref.coef), np.asarray(res.coef))
+        assert int(ref.iterations) == int(res.iterations)
+        assert int(ref.num_fun_evals) == int(res.num_fun_evals)
+
+    def test_fit_bitwise_vs_dense_source(self, rng, tmp_path):
+        from photon_tpu.data.streaming import MmapChunkSource
+        from photon_tpu.io.data_store import write_data_store
+
+        X, y = _logistic_problem(rng, n=700)
+        p = str(tmp_path / "dense")
+        write_data_store(p, y, x=X, chunk_rows=64)
+        ref = self._fit(DenseSource(X, y), 256, X.shape[1])
+        res = self._fit(MmapChunkSource(p), 256, X.shape[1])
+        assert np.array_equal(np.asarray(ref.coef), np.asarray(res.coef))
+        assert int(ref.iterations) == int(res.iterations)
+
+    def test_drop_invalid_bitwise_vs_csr_source(self, rng, tmp_path):
+        """NaN labels in the STORE (bitwise-preserved by the crc'd
+        sections) filter identically to the in-RAM source — survivors
+        pack into the same chunks, the fit stays bitwise."""
+        from photon_tpu.data.streaming import MmapChunkSource
+        from photon_tpu.io.data_store import write_data_store
+
+        n, d, kmax = 700, 16, 5
+        indptr = np.zeros(n + 1, np.int64)
+        indptr[1:] = np.cumsum(rng.integers(1, kmax + 1, n))
+        cols = rng.integers(0, d, indptr[-1]).astype(np.int64)
+        vals = rng.normal(size=indptr[-1])
+        y = rng.integers(0, 2, n).astype(np.float64)
+        y[::13] = np.nan
+        p = str(tmp_path / "store")
+        write_data_store(p, y, indptr=indptr, cols=cols, vals=vals,
+                         dim=d, chunk_rows=64)
+        kw = dict(drop_invalid=True, task=TaskType.LOGISTIC_REGRESSION)
+        ref = self._fit(CsrSource(indptr, cols, vals, y, dim=d,
+                                  dtype=np.float64), 128, d, **kw)
+        res = self._fit(MmapChunkSource(p), 128, d, **kw)
+        assert np.array_equal(np.asarray(ref.coef), np.asarray(res.coef))
+        assert int(ref.iterations) == int(res.iterations)
+
+    def test_consumed_token_fence_trails_and_resets(self, rng, tmp_path):
+        """RSS bounding on the alias path is token-fenced: ``consumed``
+        releases pages only ``_CONSUME_LAG`` chunks behind the handed-in
+        consumption tokens (a reader-side advise would be re-faulted by
+        lagging async executions), and a backwards cursor (new pass)
+        resets the watermark without fencing — those tokens were
+        realized at the pass-end host read."""
+        from photon_tpu.data.streaming import MmapChunkSource
+
+        p, _ = self._sparse_store(rng, tmp_path, n=640)
+        src = MmapChunkSource(p)
+        calls = []
+        src.store.advise_dontneed = lambda lo, hi: calls.append((lo, hi))
+        lag = src._CONSUME_LAG
+        for c in range(lag):   # fills the FIFO: nothing released yet
+            src.consumed((c + 1) * 64, np.zeros(2))
+        assert calls == [] and src._consumed_to == 0
+        src.consumed((lag + 1) * 64, np.zeros(2))   # pops chunk 0
+        assert calls == [(0, 64)] and src._consumed_to == 64
+        src.consumed(64, np.zeros(2))   # backwards cursor: new pass
+        assert src._consumed_to == 0
+        assert len(src._pending) == 1   # only the new pass's first chunk
+        assert calls == [(0, 64)]       # reset released nothing extra
+        # advise_behind=False turns the whole path off
+        src2 = MmapChunkSource(p, advise_behind=False)
+        src2.store.advise_dontneed = lambda lo, hi: calls.append((lo, hi))
+        for c in range(2 * lag):
+            src2.consumed((c + 1) * 64, np.zeros(2))
+        assert calls == [(0, 64)] and src2._pending == []
+
+    def test_kill_mid_epoch_bitwise_resume_on_disk_path(self, rng,
+                                                        tmp_path):
+        """The chunk-cursor checkpoint machinery rides the disk-backed
+        source unchanged: kill mid-pass, resume from the checkpoint,
+        finish bitwise identical to the uninterrupted disk-backed run."""
+        from photon_tpu.data.streaming import MmapChunkSource
+
+        p, (_indptr, _cols, _vals, _y, d) = self._sparse_store(
+            rng, tmp_path, n=800)
+        ckpt = str(tmp_path / "stream.ckpt")
+
+        def fit(**kw):
+            loader = ChunkLoader(
+                MmapChunkSource(p),
+                StreamConfig(chunk_rows=128, dtype=np.float64))
+            return minimize_streamed(
+                StreamedProblem(_objective(), loader, l2_weight=L2),
+                np.zeros(d), **kw)
+
+        ref = fit()
+        with chaos.active(chaos.ChaosConfig(stream_kill_at=(3, 2))):
+            with pytest.raises(chaos.SimulatedKill):
+                fit(checkpoint_path=ckpt, checkpoint_every_chunks=2)
+        assert os.path.exists(ckpt)
+        meta, _arrays = load_stream_checkpoint(ckpt)
+        assert meta["pass_idx"] == 3 and meta["next_chunk"] == 3
+        res = fit(checkpoint_path=ckpt, checkpoint_every_chunks=2)
+        assert np.array_equal(np.asarray(ref.coef), np.asarray(res.coef))
+        assert int(ref.iterations) == int(res.iterations)
+        assert int(ref.num_fun_evals) == int(res.num_fun_evals)
+
+
 class TestBenchSmoke:
     def test_bench_stream_quick(self):
         """Tier-1 wiring for bench.py --mode stream --quick: parity and
@@ -533,3 +675,30 @@ class TestBenchSmoke:
         assert rec["staging_budget_fraction"] <= 0.26, rec
         assert rec["value"] > 0
         assert rec["overlap"]["overlap_efficiency"] >= 0.0
+
+    def test_bench_ingest_quick(self):
+        """Tier-1 wiring for bench.py --mode ingest --quick: the
+        convert -> mmap-store -> streamed-fit loop must stay bitwise
+        identical to the in-RAM arm at the smoke shape, in the parent
+        AND in the fresh RSS-witness child, with every chunk on the
+        zero-copy alias path (wall/RSS budgets are only gated on the
+        full artifact run, where the dataset dwarfs the JAX baseline
+        and the machine is not also running a test suite)."""
+        bench = os.path.join(os.path.dirname(__file__), os.pardir,
+                             "bench.py")
+        proc = subprocess.run(
+            [sys.executable, bench, "--mode", "ingest", "--quick"],
+            capture_output=True, text=True, timeout=480,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        rec = json.loads([l for l in proc.stdout.splitlines()
+                          if l.startswith("{")][-1])
+        assert rec["metric"] == "ingest_mmap_vs_inram_wall_ratio"
+        assert "error" not in rec, rec
+        assert rec["quick"] is True
+        assert rec["bitwise_vs_inram"] is True, rec
+        assert rec["bitwise_run_to_run"] is True, rec
+        assert rec["rss_child_bitwise_vs_inram"] is True, rec
+        assert rec["aliased_chunks"] == rec["chunks_per_pass"], rec
+        assert rec["convert_mb_per_s"] > 0, rec
+        assert rec["value"] > 0
