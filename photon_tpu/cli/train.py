@@ -38,7 +38,7 @@ from photon_tpu.cli.config import (
     parse_feature_shard_config,
 )
 from photon_tpu.data.validators import DataValidationType, validate_dataframe
-from photon_tpu.estimators.game_estimator import GameEstimator
+from photon_tpu.estimators.game_estimator import GameEstimator, GameResult
 from photon_tpu.hyperparameter.tuner import (
     HyperparameterTuningMode,
     run_hyperparameter_tuning,
@@ -124,6 +124,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         '{"records": [{<coord>: weight, "evaluationValue": '
                         "v}]} (reference: GameHyperparameterDefaults + "
                         "HyperparameterSerialization)")
+    p.add_argument("--sweep-l2", default=None,
+                   help="comma-separated l2 grid, e.g. 0.1,1,10: fitted as "
+                        "ONE lane-batched solve for single fixed-effect "
+                        "models (optim/batched), sequential configurations "
+                        "otherwise; grid values are validated typed before "
+                        "any training starts")
+    p.add_argument("--tune", type=int, default=0,
+                   help="run N rounds of lane-batched GP tuning "
+                        "(GameEstimator.tune): each round's ask-batch of "
+                        "candidates is fitted as one batched solve, rounds "
+                        "warm-start from the previous best lane")
+    p.add_argument("--tune-ask-batch", type=int, default=4,
+                   help="candidates per tuning round (= lanes per batched "
+                        "solve) for --tune")
     p.add_argument("--model-sparsity-threshold", type=float, default=1e-4)
     p.add_argument("--num-devices", type=int, default=0,
                    help="shard training over this many devices (0 = single)")
@@ -281,6 +295,13 @@ def _run(args: argparse.Namespace) -> List:
     out_dir = args.root_output_directory
     os.makedirs(out_dir, exist_ok=True)
 
+    sweep_l2 = None
+    if args.sweep_l2:
+        # typed refusal of a bad grid BEFORE any data is read or compiled
+        from photon_tpu.optim.batched import validate_lane_weights
+        sweep_l2 = validate_lane_weights(
+            [s.strip() for s in args.sweep_l2.split(",")], name="--sweep-l2")
+
     shard_configs = dict(parse_feature_shard_config(s)
                          for s in args.feature_shards)
     parsed = [parse_coordinate_config(c) for c in args.coordinates]
@@ -416,9 +437,44 @@ def _run(args: argparse.Namespace) -> List:
         _write_telemetry_artifacts(out_dir, mesh, len(sweeps),
                                    update_sequence)
         raise
+    if sweep_l2 is not None:
+        with Timed(f"lane-batched l2 sweep over {len(sweep_l2)} weights",
+                   logger):
+            results = results + estimator.fit_swept(
+                df, validation_df=validation_df, weights=sweep_l2)
     _emit_optimization_logs(estimator, results)
 
     tuned = []
+    if args.tune > 0:
+        if validation_df is None:
+            logger.warning("--tune %d requested but no "
+                           "--validation-data-directories given: skipping "
+                           "tuning", args.tune)
+        else:
+            with Timed(f"lane-batched tuning ({args.tune} rounds)", logger):
+                mode = HyperparameterTuningMode(args.hyper_parameter_tuning)
+                tune_res = estimator.tune(
+                    df, validation_df,
+                    n_rounds=args.tune, ask_batch=args.tune_ask_batch,
+                    mode=None if mode == HyperparameterTuningMode.NONE
+                    else mode)
+            from photon_tpu.game.descent import CoordinateDescentResult
+            primary = estimator.evaluators[0]
+            gm = tune_res.best_model
+            tuned.append(GameResult(
+                model=gm,
+                config={cid: estimator.coordinate_configs[cid]
+                        .with_regularization_weight(w)
+                        for cid, w in tune_res.best_config.items()},
+                evaluation={primary.name: tune_res.best_metric},
+                descent=CoordinateDescentResult(
+                    model=gm, best_model=gm,
+                    validation_history=[{primary.name:
+                                         tune_res.best_metric}]),
+            ))
+            logger.info("tuned best config %s -> %s=%s",
+                        tune_res.best_config, primary.name,
+                        tune_res.best_metric)
     mode = HyperparameterTuningMode(args.hyper_parameter_tuning)
     if mode != HyperparameterTuningMode.NONE:
         if args.hyper_parameter_tuning_iter <= 0:

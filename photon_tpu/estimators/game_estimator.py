@@ -74,6 +74,13 @@ class CoordinateConfiguration:
         return isinstance(self.data, RandomEffectDataConfiguration)
 
     def with_regularization_weight(self, w: float) -> "CoordinateConfiguration":
+        """Round-trips everything but the weight. Negative / non-finite
+        weights are refused with a typed
+        :class:`~photon_tpu.optim.batched.SweepWeightError` HERE, at
+        config time — a bad sweep value must never reach a compiled
+        solve."""
+        from photon_tpu.optim.batched import validate_lane_weights
+        w = float(validate_lane_weights([w])[0])
         return dataclasses.replace(
             self, optimization=dataclasses.replace(
                 self.optimization, regularization_weight=w))
@@ -89,6 +96,23 @@ class GameResult:
     # configuration's descent (coordinates are reused across a sweep, so
     # their live trackers only ever show the last configuration)
     tracker_summaries: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TuneResult:
+    """Outcome of :meth:`GameEstimator.tune`.
+
+    ``best_value`` follows the search's MINIMIZE convention (the primary
+    metric negated when bigger-is-better); ``best_metric`` is the same
+    number in the metric's natural orientation."""
+
+    best_config: Dict[str, float]
+    best_value: float
+    best_metric: float
+    best_model: GameModel
+    rounds: List[dict]
+    total_iterations: int
+    observations: List[Tuple[np.ndarray, float]]
 
 
 class GameEstimator:
@@ -203,6 +227,33 @@ class GameEstimator:
                     norm=norm, intercept_index=icpt)
         return coordinates, re_datasets
 
+    def _prepare_cached(self, df: GameDataFrame):
+        """Dataset preparation (entity grouping, padding, device placement)
+        is a pure function of (df, data configs, dtype, mesh) — cache it
+        per estimator so repeated fits on the same frame (hyperparameter
+        tuning candidates, warm re-fits) skip the host-side ingest
+        entirely; only regularization weights change between candidates
+        and those are traced arguments of the cached solves."""
+        prep_key = (self.dtype, self.feature_dtype, self.mesh,
+                    tuple((cid, cfg.data)
+                          for cid, cfg in self.coordinate_configs.items()))
+        cached = getattr(self, "_prep_cache", None)
+        # identity check on the HELD frame (not id() of a possibly-freed
+        # object): the cache keeps df alive, so `is` cannot false-hit
+        if (cached is not None and cached[0] is df and cached[1] == prep_key):
+            vocab, coordinates, re_datasets = cached[2]
+            # a fresh fit must be reproducible: the down-sampling PRNG
+            # fold-in counters restart at 0 exactly as _prepare would
+            # have built them (checkpoint resume overwrites them later)
+            for coord in coordinates.values():
+                if hasattr(coord, "_update_count"):
+                    coord._update_count = 0
+        else:
+            vocab = EntityVocabulary()
+            coordinates, re_datasets = self._prepare(df, vocab)
+            self._prep_cache = (df, prep_key, (vocab, coordinates, re_datasets))
+        return vocab, coordinates, re_datasets
+
     def _build_scorer(self, df: GameDataFrame, vocab: EntityVocabulary,
                       re_datasets: Dict[str, RandomEffectDataset]) -> GameScorer:
         scorer = GameScorer(df.num_samples, dtype=self.dtype)
@@ -245,30 +296,7 @@ class GameEstimator:
         GameEstimatorEvaluationFunction.vectorToConfiguration).
         With ``configurations=None``, one fit with the coordinates' own
         weights."""
-        # dataset preparation (entity grouping, padding, device placement)
-        # is a pure function of (df, data configs, dtype, mesh) — cache it
-        # per estimator so repeated fits on the same frame (hyperparameter
-        # tuning candidates, warm re-fits) skip the host-side ingest
-        # entirely; only regularization weights change between candidates
-        # and those are traced arguments of the cached solves
-        prep_key = (self.dtype, self.feature_dtype, self.mesh,
-                    tuple((cid, cfg.data)
-                          for cid, cfg in self.coordinate_configs.items()))
-        cached = getattr(self, "_prep_cache", None)
-        # identity check on the HELD frame (not id() of a possibly-freed
-        # object): the cache keeps df alive, so `is` cannot false-hit
-        if (cached is not None and cached[0] is df and cached[1] == prep_key):
-            vocab, coordinates, re_datasets = cached[2]
-            # a fresh fit must be reproducible: the down-sampling PRNG
-            # fold-in counters restart at 0 exactly as _prepare would
-            # have built them (checkpoint resume overwrites them later)
-            for coord in coordinates.values():
-                if hasattr(coord, "_update_count"):
-                    coord._update_count = 0
-        else:
-            vocab = EntityVocabulary()
-            coordinates, re_datasets = self._prepare(df, vocab)
-            self._prep_cache = (df, prep_key, (vocab, coordinates, re_datasets))
+        vocab, coordinates, re_datasets = self._prepare_cached(df)
         # a model loaded from disk must be re-packed into this fit's entity
         # order / projection slots before it can warm-start or lock coords
         from photon_tpu.io.model_io import LoadedGameModel
@@ -337,6 +365,278 @@ class GameEstimator:
         self._re_datasets = re_datasets
         self._coordinates = coordinates
         return results
+
+    def fit_swept(
+        self,
+        df: GameDataFrame,
+        validation_df: Optional[GameDataFrame] = None,
+        weights: Sequence[float] = (),
+    ) -> List[GameResult]:
+        """Fit an l2 grid over a single fixed-effect model as ONE
+        lane-batched solve (``cli/train --sweep-l2``): one compiled
+        program, one shared data pass per iteration, one
+        :class:`GameResult` per lane with lane-batched validation
+        scoring. Multi-coordinate / random-effect / model-sharded
+        estimators fall back to :meth:`fit` with one configuration per
+        weight — identical results, sequential solves."""
+        from photon_tpu.optim import batched
+        from photon_tpu.optim.base import ConvergenceReason
+
+        lams = batched.validate_lane_weights(weights, name="sweep-l2 grid")
+        cids = list(self.coordinate_configs.keys())
+        vocab, coordinates, re_datasets = self._prepare_cached(df)
+        only = coordinates[cids[0]] if len(cids) == 1 else None
+        if not (isinstance(only, FixedEffectCoordinate)
+                and not only._model_sharded
+                and self.coordinate_configs[cids[0]].optimization.optimizer
+                    .optimizer_type.name in ("LBFGS", "OWLQN")):
+            return self.fit(df, validation_df=validation_df,
+                            configurations=[{cid: float(w) for cid in cids}
+                                            for w in lams])
+        cid = cids[0]
+        shard_id = self.coordinate_configs[cid].data.feature_shard_id
+        swept = only.update_model_swept(None, None, lams)
+        evaluations: List[Optional[Dict[str, float]]] = [None] * len(lams)
+        if validation_df is not None:
+            from photon_tpu.game.coordinate import _fixed_score_lanes
+            vbatch = validation_df.fixed_effect_batch(
+                shard_id, dtype=np.dtype(self.dtype).type,
+                feature_dtype=self.feature_dtype)
+            suite = EvaluationSuite(self.evaluators, validation_df.response,
+                                    offsets=validation_df.offsets,
+                                    weights=validation_df.weights,
+                                    id_tags=validation_df.id_tags,
+                                    dtype=self.dtype)
+            scores = _fixed_score_lanes(vbatch.features,
+                                        jnp.asarray(swept.coefs))
+            evaluations = [suite.evaluate(scores[i]).evaluations
+                           for i in range(len(lams))]
+        iters = np.asarray(swept.stacked.iterations)
+        reasons = np.asarray(swept.stacked.reason)
+        results = []
+        for i, w in enumerate(lams):
+            gm = GameModel({cid: FixedEffectModel(swept.models[i], shard_id)})
+            results.append(GameResult(
+                model=gm,
+                config={cid: self.coordinate_configs[cid]
+                        .with_regularization_weight(float(w))},
+                evaluation=evaluations[i],
+                descent=CoordinateDescentResult(
+                    model=gm, best_model=gm,
+                    validation_history=[evaluations[i]]
+                    if evaluations[i] is not None else []),
+                tracker_summaries={cid: (
+                    f"{int(iters[i])} iters, "
+                    f"{ConvergenceReason(int(reasons[i])).name}")},
+            ))
+        self._vocab = vocab
+        self._re_datasets = re_datasets
+        self._coordinates = coordinates
+        return results
+
+    # -- hyperparameter tuning (lane-batched ask/tell) -----------------------
+
+    def tune(
+        self,
+        df: GameDataFrame,
+        validation_df: GameDataFrame,
+        *,
+        n_rounds: int = 2,
+        ask_batch: int = 4,
+        mode=None,
+        ranges=None,
+        seed: int = 0,
+        warm_start_lanes: bool = True,
+    ) -> TuneResult:
+        """GP / random search over regularization weights where each
+        ask-batch of candidates is evaluated as ONE lane-batched solve.
+
+        Every round asks the search for ``ask_batch`` candidates, fits
+        them as K lanes of one compiled program
+        (:meth:`~photon_tpu.game.coordinate.FixedEffectCoordinate
+        .update_model_swept`), scores all lanes against the validation
+        frame in one shared feature pass, and tells the observed values
+        back. Rounds warm-start every lane from the previous round's best
+        lane (``warm_start_lanes``), so later rounds converge in fewer
+        solver iterations than cold starts.
+
+        The batched path applies to a single non-model-sharded
+        fixed-effect coordinate on an LBFGS/OWLQN solver (the sweepable
+        family); anything else — random effects, multi-coordinate
+        models — evaluates candidates sequentially through :meth:`fit`
+        with the same ask/tell search loop, so tuning semantics are
+        identical either way.
+        """
+        from photon_tpu.hyperparameter.rescaling import scale_backward
+        from photon_tpu.hyperparameter.search import (
+            GaussianProcessSearch,
+            RandomSearch,
+        )
+        from photon_tpu.hyperparameter.tuner import (
+            HyperparameterTuningMode,
+            TuningRange,
+            game_hyperparameter_defaults,
+        )
+        from photon_tpu.obs.metrics import registry
+        from photon_tpu.optim import batched
+
+        if mode is None:
+            mode = HyperparameterTuningMode.BAYESIAN
+        if mode == HyperparameterTuningMode.NONE:
+            raise ValueError("tune() needs a tuning mode (BAYESIAN/RANDOM)")
+        if n_rounds <= 0 or ask_batch <= 0:
+            raise ValueError(
+                f"tune() needs n_rounds > 0 and ask_batch > 0, got "
+                f"{n_rounds}/{ask_batch}")
+
+        cids = list(self.coordinate_configs.keys())
+        if ranges is None:
+            ranges = game_hyperparameter_defaults(cids)
+        else:
+            ranges = {cid: ranges.get(cid, TuningRange()) for cid in cids}
+        log_ranges = [ranges[cid].log_range for cid in cids]
+
+        def to_config(cand: np.ndarray) -> Dict[str, float]:
+            logw = scale_backward(np.asarray(cand, float), log_ranges)
+            return {cid: float(10.0 ** w) for cid, w in zip(cids, logw)}
+
+        search_cls = (GaussianProcessSearch
+                      if mode == HyperparameterTuningMode.BAYESIAN
+                      else RandomSearch)
+        search = search_cls(len(cids), seed=seed)
+        primary = self.evaluators[0]
+
+        vocab, coordinates, re_datasets = self._prepare_cached(df)
+        only = coordinates[cids[0]] if len(cids) == 1 else None
+        batched_path = (
+            only is not None
+            and isinstance(only, FixedEffectCoordinate)
+            and not only._model_sharded
+            and self.coordinate_configs[cids[0]].optimization.optimizer
+                .optimizer_type.name in ("LBFGS", "OWLQN"))
+
+        best_value = np.inf
+        best_config: Dict[str, float] = {}
+        best_model: Optional[GameModel] = None
+        best_coef: Optional[np.ndarray] = None
+        rounds: List[dict] = []
+        observations: List[Tuple[np.ndarray, float]] = []
+        total_iterations = 0
+
+        if batched_path:
+            cid = cids[0]
+            shard_id = self.coordinate_configs[cid].data.feature_shard_id
+            vbatch = validation_df.fixed_effect_batch(
+                shard_id, dtype=np.dtype(self.dtype).type,
+                feature_dtype=self.feature_dtype)
+            suite = EvaluationSuite(self.evaluators, validation_df.response,
+                                    offsets=validation_df.offsets,
+                                    weights=validation_df.weights,
+                                    id_tags=validation_df.id_tags,
+                                    dtype=self.dtype)
+            from photon_tpu.game.coordinate import _fixed_score_lanes
+
+        for r in range(n_rounds):
+            cands = search.ask(ask_batch)
+            values: List[float] = []
+            round_weights: List[float] = []
+            round_iters: List[int] = []
+
+            if batched_path:
+                weights = [to_config(c)[cids[0]] for c in cands]
+                init_lanes = None
+                if warm_start_lanes and best_coef is not None:
+                    # every lane starts from the previous round's best lane
+                    init_lanes = np.tile(best_coef, (ask_batch, 1))
+                swept = only.update_model_swept(None, None, weights,
+                                                initial_lanes=init_lanes)
+                scores = _fixed_score_lanes(vbatch.features,
+                                            jnp.asarray(swept.coefs))
+                iters = np.asarray(swept.stacked.iterations)
+                for i, w in enumerate(weights):
+                    metric = suite.evaluate(scores[i]).evaluations[primary.name]
+                    v = -metric if primary.bigger_is_better else metric
+                    lane_fail = only.last_lane_failures[i]
+                    if lane_fail is not None:
+                        v = np.inf  # failed lane never wins selection
+                    values.append(float(v))
+                    round_weights.append(float(w))
+                    round_iters.append(int(iters[i]))
+                    total_iterations += int(iters[i])
+                    if v < best_value:
+                        best_value = float(v)
+                        best_config = {cids[0]: float(w)}
+                        best_coef = np.asarray(swept.coefs[i])
+                        best_model = GameModel({cids[0]: FixedEffectModel(
+                            swept.models[i], shard_id)})
+            else:
+                warm = best_model if warm_start_lanes else None
+                for c in cands:
+                    config = to_config(c)
+                    result = self.fit(df, validation_df=validation_df,
+                                      configurations=[config],
+                                      initial_model=warm)[-1]
+                    metric = result.evaluation[primary.name]
+                    v = -metric if primary.bigger_is_better else metric
+                    it = sum(
+                        int(np.asarray(coord.last_result.iterations))
+                        for coord in self._coordinates.values()
+                        if getattr(coord, "last_result", None) is not None)
+                    values.append(float(v))
+                    round_weights.append(
+                        config[cids[0]] if len(cids) == 1 else np.nan)
+                    round_iters.append(it)
+                    total_iterations += it
+                    if v < best_value:
+                        best_value = float(v)
+                        best_config = dict(config)
+                        best_model = result.model
+
+            # ±inf is a sentinel, not an observable value — feed the
+            # search a finite penalty so the GP fit stays well-posed
+            told = [v if np.isfinite(v)
+                    else (max(x for x in values if np.isfinite(x))
+                          if any(np.isfinite(x) for x in values) else 0.0)
+                    for v in values]
+            search.tell(cands, told)
+            observations.extend(
+                (np.asarray(c, float), float(v))
+                for c, v in zip(cands, told))
+            registry.counter("tuner.rounds").inc()
+            registry.gauge("tuner.best_value").set(float(best_value))
+            rounds.append({
+                "round": r,
+                "weights": round_weights,
+                "values": values,
+                "iterations": round_iters,
+                "best_value": float(best_value),
+                "best_config": dict(best_config),
+            })
+            logger.info("tune round %d: best %s -> %s", r, best_config,
+                        best_value)
+
+        batched.record_tuner_summary({
+            "mode": mode.value,
+            "rounds": len(rounds),
+            "ask_batch": ask_batch,
+            "batched": bool(batched_path),
+            "warm_start_lanes": bool(warm_start_lanes),
+            "best_config": dict(best_config),
+            "best_value": float(best_value),
+            "total_iterations": int(total_iterations),
+            "round_records": rounds,
+        })
+        best_metric = (-best_value if primary.bigger_is_better
+                       else best_value)
+        return TuneResult(
+            best_config=best_config,
+            best_value=float(best_value),
+            best_metric=float(best_metric),
+            best_model=best_model,
+            rounds=rounds,
+            total_iterations=int(total_iterations),
+            observations=observations,
+        )
 
 
 def _tracker_summaries(coordinates) -> Dict[str, str]:

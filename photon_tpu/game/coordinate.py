@@ -54,6 +54,13 @@ def _fixed_score(feats, coef: Array) -> Array:
     return F.matvec(feats, coef)
 
 
+@jax.jit
+def _fixed_score_lanes(feats, coefs: Array) -> Array:
+    # lane-batched validation/score pass for the sweep path: one shared
+    # data read for all K coefficient lanes (ops/features.matvec_lanes)
+    return F.matvec_lanes(feats, coefs)
+
+
 class FixedEffectCoordinate:
     """Reference: FixedEffectCoordinate.scala:136-165."""
 
@@ -232,6 +239,94 @@ class FixedEffectCoordinate:
             s = _fixed_score(self.batch.features, coef)
         if s.shape[0] != self._n_orig:
             s = s[: self._n_orig]
+        return s
+
+    def update_model_swept(self, prev: Optional[FixedEffectModel],
+                           residual_scores: Optional[Array],
+                           weights,
+                           initial_lanes: Optional[Array] = None):
+        """Fit the whole regularization grid ``weights`` against the same
+        residual-injected batch as ONE lane-batched program
+        (optim/problem.solve_swept) — the per-coordinate sweep that used
+        to cost K sequential ``update_model`` calls and K data passes.
+
+        ``initial_lanes [K, d]`` warm-starts each lane independently
+        (tuner rounds warm-start every lane from the previous round's
+        best); otherwise every lane starts from ``prev``'s coefficients.
+        Returns the :class:`~photon_tpu.optim.problem.SweptSolve`;
+        per-lane failures stay per-lane (a poisoned lane freezes typed
+        without sinking its siblings). Sweep telemetry: ``sweep.*``
+        metrics + the RunReport ``sweep`` section.
+        """
+        if self._model_sharded:
+            raise ValueError(
+                "lane-batched sweeps are not supported on model-axis "
+                "sharded coordinates: K lanes hold K full coefficient "
+                "vectors, which contradicts a range-sharded theta — sweep "
+                "this coordinate sequentially")
+        from photon_tpu.obs.metrics import registry
+        from photon_tpu.optim import batched
+        batch = self.batch
+        if residual_scores is not None:
+            extra = batch.num_samples - residual_scores.shape[0]
+            if extra:  # mesh padding: zero residual on zero-weight pad rows
+                residual_scores = jnp.pad(residual_scores, (0, extra))
+            batch = batch.add_scores_to_offsets(residual_scores)
+        if getattr(self, "_chaos_poison_once", False):
+            # fault injection (resilience/chaos.py): poisons every lane's
+            # shared data term, like a corrupt upstream residual
+            self._chaos_poison_once = False
+            batch = batch.add_scores_to_offsets(
+                jnp.full((batch.num_samples,), jnp.nan, batch.labels.dtype))
+        if self._sampling_key is not None and self.config.down_sampling_rate < 1.0:
+            key = jax.random.fold_in(self._sampling_key, self._update_count)
+            self._update_count += 1
+            batch = maybe_downsample(batch, self.task,
+                                     self.config.down_sampling_rate, key)
+        init = prev.model.coefficients.means if prev is not None else None
+        with _obs_annotate("fe/solve_swept"):
+            # the coordinate's batch was (possibly) sharded at
+            # construction, so the solve gets mesh=None: GSPMD follows
+            # the input placement exactly as in update_model
+            swept = self.problem.solve_swept(
+                batch, weights, initial=init, initial_lanes=initial_lanes,
+                dim=self.dim, dtype=batch.labels.dtype)
+        # host boundary: per-lane scalars for telemetry + failure typing
+        iters = np.asarray(swept.stacked.iterations)
+        reasons = np.asarray(swept.stacked.reason)
+        fails = (np.zeros_like(iters) if swept.stacked.failure is None
+                 else np.asarray(swept.stacked.failure))
+        losses = np.asarray(swept.stacked.value)
+        self.last_lane_failures = [
+            None if code == FailureMode.NONE else FailureMode(int(code))
+            for code in fails]
+        registry.gauge("sweep.lanes_active").set(
+            int(np.sum(fails == FailureMode.NONE)))
+        hist = registry.histogram(
+            "sweep.lane_iterations",
+            buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500))
+        for it in iters:
+            hist.observe(float(it))
+        lams = batched.validate_lane_weights(weights)
+        batched.record_sweep_run([
+            {"weight": float(lams[i]), "loss": float(losses[i]),
+             "iterations": int(iters[i]), "reason": int(reasons[i]),
+             "failure": int(fails[i])}
+            for i in range(len(lams))])
+        return swept
+
+    def score_lanes(self, coefs: Array) -> Array:
+        """Training-data scores for K coefficient lanes ``[K, d] ->
+        [K, n]`` — one shared feature pass (the sweep counterpart of
+        ``score``). Mesh pad rows are sliced off per lane."""
+        if self._model_sharded:
+            raise ValueError(
+                "score_lanes is not supported on model-axis sharded "
+                "coordinates (see update_model_swept)")
+        with _obs_annotate("fe/score_lanes"):
+            s = _fixed_score_lanes(self.batch.features, jnp.asarray(coefs))
+        if s.shape[1] != self._n_orig:
+            s = s[:, : self._n_orig]
         return s
 
     @functools.cached_property

@@ -9,6 +9,20 @@ random draws until observations exceed the parameter count).
 
 The evaluation function MINIMIZES its value (negate bigger-is-better
 metrics in the glue — reference convention).
+
+Determinism contract: the primary Sobol stream serves ONLY emitted
+candidates. Scrambled Sobol is position-stateful with
+``random(a) + random(b) == random(a + b)`` element-wise, so the emitted
+candidate sequence for a given seed is identical across runs and across
+ask-batch sizes. The GP's acquisition candidate pool draws from a
+SEPARATE derived-seed stream (``draw_pool``) — pooling used to consume
+the primary stream, which made the candidate sequence depend on when
+the GP kicked in.
+
+Batch protocol for lane-batched evaluation (optim/batched): ``ask(q)``
+returns q candidates to evaluate as one batched solve; ``tell``
+records the observed values. The sequential ``find*`` protocol
+delegates to the same internals and is unchanged.
 """
 
 from __future__ import annotations
@@ -31,7 +45,8 @@ Observation = Tuple[np.ndarray, float]
 class RandomSearch:
     """Sobol-sequence search (reference: RandomSearch.scala:34)."""
 
-    def __init__(self, num_params: int, evaluation_function: EvaluationFunction,
+    def __init__(self, num_params: int,
+                 evaluation_function: Optional[EvaluationFunction] = None,
                  discrete_params: Optional[Dict[int, int]] = None,
                  kernel: StationaryKernel = Matern52(),
                  seed: int = 0):
@@ -42,6 +57,23 @@ class RandomSearch:
         self.kernel = kernel
         self.seed = seed
         self._sobol = qmc.Sobol(d=num_params, scramble=True, seed=seed)
+
+    # -- batch protocol (ask/tell — lane-batched evaluation) -----------------
+
+    def ask(self, q: int) -> np.ndarray:
+        """The next ``q`` candidates ``[q, num_params]`` to evaluate as one
+        batch. Pure Sobol here: ``ask(a); ask(b)`` emits the exact same
+        candidates as ``ask(a + b)``."""
+        assert q > 0
+        return np.stack([self._discretize(c)
+                         for c in self.draw_candidates(q)])
+
+    def tell(self, candidates: np.ndarray,
+             values: Sequence[float]) -> None:
+        """Record one batch of observed (candidate, value) pairs."""
+        assert len(candidates) == len(values)
+        for c, v in zip(candidates, values):
+            self._on_observation(np.asarray(c, float), float(v))
 
     # -- protocol ------------------------------------------------------------
 
@@ -101,7 +133,8 @@ class RandomSearch:
 class GaussianProcessSearch(RandomSearch):
     """Bayesian optimization (reference: GaussianProcessSearch.scala:52)."""
 
-    def __init__(self, num_params: int, evaluation_function: EvaluationFunction,
+    def __init__(self, num_params: int,
+                 evaluation_function: Optional[EvaluationFunction] = None,
                  discrete_params: Optional[Dict[int, int]] = None,
                  kernel: StationaryKernel = Matern52(),
                  candidate_pool_size: int = 250,
@@ -111,6 +144,12 @@ class GaussianProcessSearch(RandomSearch):
                          kernel, seed)
         self.candidate_pool_size = candidate_pool_size
         self.noisy_target = noisy_target
+        # acquisition pool stream, seed-derived but DISJOINT from the
+        # primary candidate stream: pool draws must not advance the
+        # emitted-candidate sequence (see module docstring)
+        self._pool_sobol = qmc.Sobol(
+            d=num_params, scramble=True,
+            seed=np.random.default_rng([seed, 0x9E3779B9]))
         self._points: List[np.ndarray] = []
         self._values: List[float] = []
         self._best = np.inf
@@ -129,13 +168,13 @@ class GaussianProcessSearch(RandomSearch):
         self._prior_values.append(float(value))
         self._prior_best = min(self._prior_best, float(value))
 
-    def _next(self, last_point: np.ndarray, last_value: float) -> np.ndarray:
-        self._on_observation(last_point, last_value)
-        # under-determined -> uniform draws until we exceed num_params obs
-        if len(self._points) <= self.num_params:
-            return super()._next(last_point, last_value)
+    def draw_pool(self, n: int) -> np.ndarray:
+        """Acquisition-pool draws — a separate stream from the emitted
+        candidates (the determinism fix; see module docstring)."""
+        return self._pool_sobol.random(n)
 
-        candidates = self.draw_candidates(self.candidate_pool_size)
+    def _fit_acquisition_model(self):
+        """Fit the GP on all observations; returns (model, transformation)."""
         evals = np.asarray(self._values)
         current_mean = float(np.mean(evals))
         overall_best = min(self._prior_best, self._best - current_mean)
@@ -153,8 +192,31 @@ class GaussianProcessSearch(RandomSearch):
             seed=self.seed)
         model = estimator.fit(points, centered)
         self.last_model = model
+        return model, transformation
 
+    def _next(self, last_point: np.ndarray, last_value: float) -> np.ndarray:
+        self._on_observation(last_point, last_value)
+        # under-determined -> uniform draws until we exceed num_params obs
+        if len(self._points) <= self.num_params:
+            return super()._next(last_point, last_value)
+
+        candidates = self.draw_pool(self.candidate_pool_size)
+        model, transformation = self._fit_acquisition_model()
         predictions = model.predict_transformed(candidates)
         idx = (np.argmax(predictions) if transformation.is_max_opt
                else np.argmin(predictions))
         return candidates[idx]
+
+    def ask(self, q: int) -> np.ndarray:
+        """Top-q of the acquisition pool (one GP fit per round); Sobol
+        exploration from the primary stream while under-determined, so
+        the exploration-phase sequence is batch-size invariant."""
+        assert 0 < q <= self.candidate_pool_size
+        if len(self._points) <= self.num_params:
+            return super().ask(q)
+        pool = self.draw_pool(self.candidate_pool_size)
+        model, transformation = self._fit_acquisition_model()
+        predictions = model.predict_transformed(pool)
+        order = np.argsort(-predictions if transformation.is_max_opt
+                           else predictions)
+        return np.stack([self._discretize(pool[i]) for i in order[:q]])

@@ -91,6 +91,9 @@ def build_run_report(driver: str,
     nearline = _nearline_section()
     if nearline is not None:
         report["nearline"] = nearline
+    sweep = _sweep_section()
+    if sweep is not None:
+        report["sweep"] = sweep
     if extra:
         report["extra"] = extra
     return report
@@ -133,6 +136,22 @@ def _nearline_section() -> Optional[Dict[str, Any]]:
         return None
     try:
         return mod.report_section()
+    except Exception:  # noqa: BLE001 — reporting must not kill a run
+        return None
+
+
+def _sweep_section() -> Optional[Dict[str, Any]]:
+    """Lane-batched sweep/tuner accounting (batched solves, per-lane
+    outcomes, tuner round summary), when this process ran one. Same
+    ``sys.modules`` pattern as :func:`_serving_section` — runs that never
+    sweep pay nothing."""
+    mod = sys.modules.get("photon_tpu.optim.batched")
+    if mod is None:
+        return None
+    try:
+        section = mod.report_section()
+        # an imported-but-idle batched module stays out of the report
+        return section if section.get("runs") else None
     except Exception:  # noqa: BLE001 — reporting must not kill a run
         return None
 
@@ -256,6 +275,16 @@ def validate_run_report(report: Dict[str, Any]) -> List[str]:
                             errors.append(f"serving.swap missing {k!r}")
                     if not isinstance(swap.get("history", []), list):
                         errors.append("serving.swap history must be a list")
+    if "sweep" in report:  # optional: only lane-batched sweep processes
+        sweep = report["sweep"]
+        if not isinstance(sweep, dict):
+            errors.append("sweep must be a dict")
+        else:
+            for k in ("runs", "lanes_total", "lane_records", "tuner"):
+                if k not in sweep:
+                    errors.append(f"sweep missing {k!r}")
+            if not isinstance(sweep.get("lane_records", []), list):
+                errors.append("sweep.lane_records must be a list")
     if "cd" in report:  # optional: only parallel-CD training processes
         cd = report["cd"]
         if not isinstance(cd, dict) or not isinstance(

@@ -155,6 +155,29 @@ def matvec(x: FeatureMatrix, theta: Array) -> Array:
     return x @ theta
 
 
+def matvec_lanes(x: FeatureMatrix, thetas: Array) -> Array:
+    """Stacked margins for K coefficient lanes: ``thetas [K, d] -> [K, n]``.
+
+    The lane-batched data pass of the sweep path (optim/batched): dense
+    rows contract as ONE ``Θ Xᵀ`` dot_general (contracting over d — no
+    materialized transpose of the big matrix, same strided-path concern
+    as ``rmatvec``'s ``w @ x``), and sparse ELL rows as ONE stacked
+    gather over the shared ``x.indices`` plan — the batch is read once
+    regardless of K. Model-sharded layouts train one model per mesh and
+    are refused typed (sweep lanes would multiply the sharded theta
+    footprint K-fold).
+    """
+    if isinstance(x, ModelShardedSparse):
+        raise NotImplementedError(
+            "matvec_lanes does not support ModelShardedSparse features — "
+            "lane-batched sweeps hold K full coefficient vectors, which "
+            "contradicts a theta range-sharded over the model axis")
+    if isinstance(x, SparseFeatures):
+        gathered = jnp.take(thetas, x.indices, axis=1)   # [K, n, k]
+        return jnp.sum(x.values[None, :, :] * gathered, axis=-1)
+    return jnp.einsum("kd,nd->kn", thetas, x)
+
+
 def _ms_scatter(x: ModelShardedSparse, w: Array, square: bool) -> Array:
     """Shared shard_map scatter for X^T w / (X*X)^T w on the model-sharded
     layout: local scatters into this chip's theta range, psum over data.

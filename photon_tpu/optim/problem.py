@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Optional, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,16 @@ from photon_tpu.types import OptimizerType, TaskType, VarianceComputationType
 from photon_tpu.utils import jitcache
 
 Array = jax.Array
+
+
+class SweptSolve(NamedTuple):
+    """Output of :meth:`GlmOptimizationProblem.solve_swept`: one model /
+    solver result per grid lane, plus the stacked device views."""
+
+    models: List[GeneralizedLinearModel]   # per-lane, original space
+    results: List[SolverResult]            # per-lane views of ``stacked``
+    stacked: SolverResult                  # every field has a [K] lane axis
+    coefs: Array                           # [K, d] original-space stack
 
 
 def _validate_direct(task, opt: "OptimizerConfig", regularization) -> None:
@@ -355,6 +365,124 @@ class GlmOptimizationProblem:
             coef = norm.transformed_space_to_model(coef, self.intercept_index)
         model = GeneralizedLinearModel(Coefficients(coef), self.task)
         return model, result
+
+    # -- lane-batched sweeps (optim/batched) --------------------------------
+
+    def _swept_solve_fn(self, mesh):
+        opt = self.config.optimizer
+        if opt.optimizer_type not in (OptimizerType.LBFGS,
+                                      OptimizerType.OWLQN):
+            raise ValueError(
+                f"solve_swept supports LBFGS/OWLQN only, not "
+                f"{opt.optimizer_type} (second-order solvers have no "
+                f"vmappable lax-level batching rule for the lane stack)")
+        from photon_tpu.optim import batched
+        solver_cfg = opt.solver_config()
+        obj = self.objective
+        use_owlqn = opt.optimizer_type == OptimizerType.OWLQN
+
+        def build():
+            if mesh is None:
+                def solve(x0_lanes: Array, batch: DataBatch,
+                          l2: Array, l1: Array) -> SolverResult:
+                    vg = lambda c, hyper: obj.value_and_gradient(
+                        c, batch, hyper)
+                    return batched.minimize_lanes(
+                        vg, x0_lanes, l2=l2, l1=l1, config=solver_cfg,
+                        use_owlqn=use_owlqn)
+                return jit_donating(solve, donate_argnums=(0,))
+
+            def solve(x0_lanes: Array, batch: DataBatch,
+                      l2: Array, l1: Array) -> SolverResult:
+                return batched.minimize_lanes_meshed(
+                    obj, batch, x0_lanes, l2=l2, l1=l1, mesh=mesh,
+                    config=solver_cfg, use_owlqn=use_owlqn)
+            return jax.jit(solve)
+
+        key = ("glm_solve_swept", self.task, solver_cache_key(opt),
+               norm_cache_key(self.objective.norm),
+               None if mesh is None else jitcache.array_token(mesh))
+        return jitcache.get_or_build(key, build)
+
+    def solve_swept(
+        self,
+        batch: DataBatch,
+        lambdas,
+        initial: Optional[Array] = None,
+        initial_lanes: Optional[Array] = None,
+        dim: Optional[int] = None,
+        dtype=None,
+        mesh=None,
+    ) -> "SweptSolve":
+        """Fit the whole regularization grid ``lambdas`` as ONE compiled
+        lane-batched program (optim/batched.minimize_lanes).
+
+        Same model-space contract as ``run``, per lane: warm starts
+        (``initial`` shared, or ``initial_lanes [K, d]`` per lane) arrive
+        in original space and the returned models live in original
+        space. Weights are validated typed at entry
+        (:class:`~photon_tpu.optim.batched.SweepWeightError`), never
+        inside the compiled solve. A singleton grid compiles the same
+        loop structure as the scalar solver ("any over one lane" is the
+        scalar cond), so K=1 matches ``run``'s iteration count with
+        coefficient parity at trace precision.
+        """
+        from photon_tpu.optim import batched
+        from photon_tpu.ops.features import ModelShardedSparse
+        if isinstance(batch.features, ModelShardedSparse):
+            raise ValueError(
+                "solve_swept does not support model-sharded features: K "
+                "lanes hold K full coefficient vectors, which contradicts "
+                "a theta range-sharded over the model axis")
+        lams = batched.validate_lane_weights(lambdas, name="solve_swept grid")
+        k = int(lams.shape[0])
+        norm = self.objective.norm
+        if dtype is None:
+            dtype = batch.labels.dtype
+        to_opt_space = (lambda c: c) if norm.is_identity else (
+            lambda c: norm.model_to_transformed_space(c, self.intercept_index))
+        if initial_lanes is not None:
+            x0 = jnp.asarray(initial_lanes, dtype)
+            if x0.ndim != 2 or x0.shape[0] != k:
+                raise ValueError(
+                    f"initial_lanes must be [K={k}, d], got {x0.shape}")
+            x0 = jax.vmap(to_opt_space)(x0)
+        elif initial is not None:
+            init = to_opt_space(jnp.asarray(initial, dtype))
+            x0 = jnp.broadcast_to(init, (k,) + init.shape) + 0
+        else:
+            assert dim is not None, "need dim when no initial coefficients"
+            x0 = jnp.zeros((k, dim), dtype)
+        if mesh is not None:
+            from photon_tpu.optim import hier
+            from photon_tpu.parallel import mesh as M
+            sample_axes = hier._sample_axes(mesh)
+            batch = M.shard_batch(
+                batch, mesh,
+                axis=sample_axes if len(sample_axes) > 1 else sample_axes[0])
+            x0 = M.replicate(x0, mesh)
+        reg = self.config.regularization
+        l2 = jnp.asarray([reg.l2_weight(l) for l in lams], dtype)
+        l1 = jnp.asarray([reg.l1_weight(l) for l in lams], dtype)
+        solve = self._swept_solve_fn(mesh)
+        import os
+        if os.environ.get("PHOTON_TPU_PALLAS_GLM") == "1":
+            # the fused kernel has no batching rule for the lane stack;
+            # the swept program always traces with it hard-disabled
+            from photon_tpu.ops import pallas_glm
+            with pallas_glm.disabled():
+                stacked = solve(x0, batch, l2, l1)
+        else:
+            stacked = solve(x0, batch, l2, l1)
+        coefs = stacked.coef
+        if not norm.is_identity:
+            coefs = jax.vmap(lambda c: norm.transformed_space_to_model(
+                c, self.intercept_index))(coefs)
+        models = [GeneralizedLinearModel(Coefficients(coefs[i]), self.task)
+                  for i in range(k)]
+        return SweptSolve(models=models,
+                          results=batched.split_lanes(stacked),
+                          stacked=stacked, coefs=coefs)
 
     def run_streamed(
         self,

@@ -9,12 +9,16 @@ StateTracking), never via callbacks. A ``jax.debug.callback`` /
 round-trip per iteration and silently serialize every solve; a
 ``.block_until_ready`` in solver code would stall the dispatch pipeline.
 
-This script walks ``photon_tpu/optim/`` (plus ``photon_tpu/game/``,
-which drives the jitted solves — including the parallel-sweep scheduler
-in ``game/descent.py`` / ``game/parallel_cd.py``, whose worker threads
-must dispatch solves asynchronously: one blocking transfer inside a
-group member would serialize the whole concurrency group) with an AST
-visitor and fails — with file:line — on any of:
+This script walks ``photon_tpu/optim/`` — including the lane-batched
+sweep solvers in ``optim/batched.py``, whose per-lane convergence
+freezing must stay a ``where``-masked while_loop carry with no host
+reads as lanes finish — (plus ``photon_tpu/game/``, which drives the
+jitted solves: the parallel-sweep scheduler in ``game/descent.py`` /
+``game/parallel_cd.py``, whose worker threads must dispatch solves
+asynchronously: one blocking transfer inside a group member would
+serialize the whole concurrency group, and the lane-sweep boundary in
+``game/coordinate.py update_model_swept``) with an AST visitor and
+fails — with file:line — on any of:
 
   * ``jax.debug.callback`` / ``jax.debug.print``
   * ``io_callback`` / ``jax.experimental.io_callback`` / ``pure_callback``
