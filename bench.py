@@ -3657,6 +3657,211 @@ def run_stream_bench(scale: float, quick: bool = False):
 
 
 # --------------------------------------------------------------------------
+# sdca mode: --mode sdca -> BENCH_SDCA_r01.json
+# --------------------------------------------------------------------------
+
+def run_sdca_bench(scale: float, quick: bool = False):
+    """Chunk-local SDCA vs streamed L-BFGS off the SAME mmap chunk store.
+
+    The claim under test (ISSUE 16): stochastic dual coordinate ascent
+    makes per-ROW progress inside each resident chunk, so it reaches a
+    fixed AUC target in >= 2x fewer STORAGE PASSES than the streamed
+    L-BFGS baseline, whose every objective evaluation (including line-
+    search probes) is one full pass over the store. Storage passes — not
+    wall clock — are the metric: they are the unit the disk/DCN bill is
+    denominated in and they are hardware-independent, which is what a
+    1-core CI host can honestly certify (the ``machine_balance`` section
+    carries that caveat, same framing as BENCH_SWEEP_r01.json).
+
+    Both arms fit the identical f32 logistic problem from the identical
+    crc-verified mmap store. Per-pass AUC curves are recorded for BOTH
+    arms (L-BFGS via an eval-point-recording StreamedProblem, SDCA via
+    the ``on_epoch`` hook); the target is ``max(final AUCs) - 1e-3`` so
+    neither arm can win by stopping early. Also certified: final-AUC
+    parity <= 1e-3, duality-gap-TYPED termination (the solver's reason
+    is DUALITY_GAP_CONVERGED, not an epoch cap), and a third SDCA run as
+    the bitwise run-to-run witness. ``--quick`` is the tier-1 smoke
+    shape with NO artifact write."""
+    del scale  # fixed shapes: the pass-count ratio IS the point
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_tpu.data.streaming import (ChunkLoader, MmapChunkSource,
+                                            StreamConfig)
+    from photon_tpu.evaluation.evaluators import auc as _auc
+    from photon_tpu.function.objective import GLMObjective
+    from photon_tpu.io.data_store import write_data_store
+    from photon_tpu.ops.losses import LogisticLoss
+    from photon_tpu.optim.base import ConvergenceReason, SolverConfig
+    from photon_tpu.optim.sdca import SdcaConfig, minimize_sdca
+    from photon_tpu.optim.streaming import StreamedProblem, minimize_streamed
+
+    if quick:
+        n, d, chunk_rows = 8192, 32, 2048
+        sdca_epochs, lbfgs_iters = 20, 60
+    else:
+        n, d, chunk_rows = 60000, 64, 8192
+        sdca_epochs, lbfgs_iters = 40, 120
+    # Anisotropic spectrum (condition ~1e3 in covariance) with the true
+    # separator carrying EQUAL signal per direction: a gradient method
+    # only sees the low-variance components after it has resolved the
+    # high-variance ones, so its AUC climbs one spectral band at a time —
+    # while SDCA's rate (1 - 1/(1+q))^epochs depends only on the row-norm
+    # ratio q = |x|^2/l2, not the spectrum. Isotropic well-separated data
+    # would be a strawman in the other direction: there the first descent
+    # step already points at w* and BOTH arms hit the AUC target in one
+    # effective pass.
+    rng = np.random.default_rng(23)
+    scales = np.logspace(0.0, -1.5, d)
+    X = rng.normal(size=(n, d)) * scales
+    w_true = rng.normal(size=d) / scales * (3.0 / np.sqrt(d))
+    y = (rng.random(n)
+         < 1.0 / (1.0 + np.exp(-(X @ w_true)))).astype(np.float64)
+    # l2 ~ E||x||^2 keeps the per-coordinate curvature ratio q near 1
+    l2 = float(np.sum(scales ** 2))
+
+    store_dir = tempfile.mkdtemp(prefix="bench_sdca_")
+    store_path = os.path.join(store_dir, "store")
+    try:
+        write_data_store(store_path, y, x=X, dtype=np.float32,
+                         chunk_rows=chunk_rows)
+        src = MmapChunkSource(store_path)
+
+        def make_loader():
+            return ChunkLoader(src, StreamConfig(chunk_rows=chunk_rows,
+                                                 num_buffers=2,
+                                                 dtype=np.float32))
+
+        obj = GLMObjective(loss=LogisticLoss)
+
+        def auc_of(coef: np.ndarray) -> float:
+            s = jnp.asarray(X @ np.asarray(coef, np.float64))
+            return float(np.asarray(_auc(s, jnp.asarray(y))))
+
+        # -- streamed L-BFGS arm: every objective evaluation (iteration
+        #    OR line-search probe) is one full storage pass ---------------
+        eval_coefs = []
+
+        class _RecordingProblem(StreamedProblem):
+            def value_and_gradient(self, coef, **kw):
+                eval_coefs.append(np.array(coef, np.float64, copy=True))
+                return super().value_and_gradient(coef, **kw)
+
+        t0 = time.perf_counter()
+        res_lbfgs = minimize_streamed(
+            _RecordingProblem(obj, make_loader(), l2_weight=l2),
+            np.zeros(d, np.float32),
+            config=SolverConfig(max_iterations=lbfgs_iters, tolerance=1e-7))
+        lbfgs_wall_s = time.perf_counter() - t0
+        lbfgs_aucs = [auc_of(c) for c in eval_coefs]
+
+        # -- SDCA arm: one storage pass per outer epoch -------------------
+        sdca_cfg = SdcaConfig(max_epochs=sdca_epochs, gap_tolerance=1e-3,
+                              seed=5)
+        epoch_aucs, epoch_gaps = [], []
+
+        def on_epoch(_e: int, info: dict) -> None:
+            epoch_aucs.append(auc_of(info["coef"]))
+            epoch_gaps.append(float(info["gap"]))
+
+        t0 = time.perf_counter()
+        res_sdca = minimize_sdca(obj, make_loader(), l2_weight=l2,
+                                 config=sdca_cfg, dim=d, dtype=np.float32,
+                                 on_epoch=on_epoch)
+        sdca_wall_s = time.perf_counter() - t0
+        # third run = the bitwise run-to-run witness
+        res_repro = minimize_sdca(obj, make_loader(), l2_weight=l2,
+                                  config=sdca_cfg, dim=d, dtype=np.float32)
+        bitwise = bool(np.array_equal(np.asarray(res_sdca.coef),
+                                      np.asarray(res_repro.coef)))
+        src.store.close()
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+    # -- storage passes to the shared AUC target --------------------------
+    target = max(lbfgs_aucs[-1], epoch_aucs[-1]) - 1e-3
+
+    def passes_to(aucs):
+        for i, a in enumerate(aucs):
+            if a >= target:
+                return i + 1  # pass counts are 1-based
+        return None
+
+    sdca_passes = passes_to(epoch_aucs)
+    lbfgs_passes = passes_to(lbfgs_aucs)
+    reached = sdca_passes is not None and lbfgs_passes is not None
+    speedup = (lbfgs_passes / sdca_passes) if reached else 0.0
+    parity = abs(lbfgs_aucs[-1] - epoch_aucs[-1])
+    gap_typed = (int(np.asarray(res_sdca.reason))
+                 == int(ConvergenceReason.DUALITY_GAP_CONVERGED))
+
+    cpus = os.cpu_count() or 1
+    rec = {
+        "metric": "sdca_storage_pass_speedup",
+        "value": round(speedup, 3),
+        "unit": "x (streamed L-BFGS storage passes / SDCA epochs to the "
+                "same AUC target)",
+        "auc_target": round(target, 6),
+        "passes_floor_enforced": 2.0,
+        "passes_ge_2x": bool(reached and speedup >= 2.0),
+        "auc_parity_abs": parity,
+        "auc_parity_le_1e3": bool(parity <= 1e-3),
+        "bitwise_run_to_run": bitwise,
+        "sdca": {
+            "passes_to_target": sdca_passes,
+            "epochs_run": int(np.asarray(res_sdca.iterations)),
+            "final_auc": round(epoch_aucs[-1], 6),
+            "auc_by_epoch": [round(a, 6) for a in epoch_aucs],
+            "gap_by_epoch": [float(f"{g:.6g}") for g in epoch_gaps],
+            "duality_gap_converged": gap_typed,
+            "reason": int(np.asarray(res_sdca.reason)),
+            "wall_s": round(sdca_wall_s, 3),
+        },
+        "lbfgs": {
+            "passes_to_target": lbfgs_passes,
+            "storage_passes": len(lbfgs_aucs),
+            "iterations": int(np.asarray(res_lbfgs.iterations)),
+            "final_auc": round(lbfgs_aucs[-1], 6),
+            "auc_by_pass": [round(a, 6) for a in lbfgs_aucs],
+            "wall_s": round(lbfgs_wall_s, 3),
+        },
+        "workload": {
+            "n": n, "dim": d, "chunk_rows": chunk_rows,
+            "num_chunks": -(-n // chunk_rows), "l2": round(l2, 6),
+            "feature_condition": round(float((scales[0] / scales[-1]) ** 2),
+                                       1),
+            "dtype": "float32", "sdca_seed": sdca_cfg.seed,
+            "gap_tolerance": sdca_cfg.gap_tolerance,
+        },
+        "machine_balance": {
+            "host_cpus": cpus,
+            "single_core_host": bool(cpus == 1),
+            "note": "storage passes are the gated unit — hardware-"
+                    "independent (the disk/DCN bill is denominated in "
+                    "passes); wall clock on this CPU host is context "
+                    "only: SDCA's sequential per-row inner loop has no "
+                    "TPU lane parallelism here, so wall ratios do NOT "
+                    "transfer to the accelerator",
+        },
+        "quick": quick,
+        "device": jax.default_backend(),
+    }
+    if not quick:
+        out = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(out, "BENCH_SDCA_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"sdca: {speedup:.2f}x fewer storage passes to AUC {target:.4f} "
+        f"(SDCA {sdca_passes} vs L-BFGS {lbfgs_passes}), parity "
+        f"{parity:.2e}, gap-typed={gap_typed}, bitwise={bitwise}")
+    return rec
+
+
+# --------------------------------------------------------------------------
 # ingest mode: --mode ingest -> BENCH_INGEST_r01.json
 # --------------------------------------------------------------------------
 
@@ -4517,7 +4722,7 @@ def main():
     ap.add_argument("--mode", default=os.environ.get("BENCH_MODE", "train"),
                     choices=("train", "serving", "game_cd", "coldtier",
                              "nearline", "hier", "fused", "stream", "fleet",
-                             "tenant", "ingest", "sweep"),
+                             "tenant", "ingest", "sweep", "sdca"),
                     help="train = the solver configs (default); serving = "
                          "the online-serving bench -> BENCH_SERVING_r01.json; "
                          "game_cd = parallel-vs-sequential CD sweeps "
@@ -4539,11 +4744,13 @@ def main():
                          "mmap chunk store convert + streamed fit "
                          "-> BENCH_INGEST_r01.json; sweep = lane-batched "
                          "multi-lambda grid vs sequential solves + "
-                         "warm-started GP tuning -> BENCH_SWEEP_r01.json")
+                         "warm-started GP tuning -> BENCH_SWEEP_r01.json; "
+                         "sdca = chunk-local SDCA vs streamed L-BFGS "
+                         "storage passes to AUC -> BENCH_SDCA_r01.json")
     ap.add_argument("--quick", action="store_true",
                     help="game_cd/coldtier/nearline/hier/fused/stream/"
-                         "fleet/tenant/ingest/sweep: tiny tier-1 smoke "
-                         "shape (no artifact write)")
+                         "fleet/tenant/ingest/sweep/sdca: tiny tier-1 "
+                         "smoke shape (no artifact write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -4709,6 +4916,23 @@ def main():
                   "unit": "x (streamed / resident, full L-BFGS fit)",
                   "error": repr(e)})
         _DONE.set()     # stream mode: the record above IS the summary
+        return
+
+    if args.mode == "sdca":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/sdca"):
+                emit(run_sdca_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"sdca bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "sdca_storage_pass_speedup", "value": 0.0,
+                  "unit": "x (streamed L-BFGS storage passes / SDCA "
+                          "epochs to the same AUC target)",
+                  "error": repr(e)})
+        _DONE.set()     # sdca mode: the record above IS the summary
         return
 
     if args.mode == "ingest":

@@ -164,6 +164,46 @@ class CsrSource:
         )
 
 
+class EllSource:
+    """In-RAM padded-ELL rows as a chunk source — the layout the mmap
+    store carries on disk and ``ops/features.SparseFeatures`` holds on
+    device. ``read_block`` returns plain row slices (zero-copy views),
+    so a resident sparse batch can be re-streamed through the chunk
+    pipeline (the SDCA passthrough wraps a coordinate's ELL batch this
+    way) without a CSR round-trip."""
+
+    def __init__(self, idx, val, labels, dim: int, offsets=None,
+                 weights=None):
+        idx = np.asarray(idx)
+        val = np.asarray(val)
+        if idx.ndim != 2 or idx.shape != val.shape:
+            raise ValueError(f"idx {idx.shape} / val {val.shape} must be "
+                             "matching [rows, ell_width] ELL arrays")
+        if idx.shape[0] != np.shape(labels)[0]:
+            raise ValueError(f"ELL rows {idx.shape[0]} do not match labels "
+                             f"{np.shape(labels)}")
+        self.idx = idx
+        self.val = val
+        self.labels = labels
+        self.offsets = offsets
+        self.weights = weights
+        self.num_rows = int(idx.shape[0])
+        self.dim = int(dim)
+        self.ell_width = int(idx.shape[1])
+
+    def read_block(self, start: int, stop: int) -> RawBlock:
+        sl = slice(start, stop)
+        return RawBlock(
+            labels=np.asarray(self.labels[sl]),
+            idx=np.asarray(self.idx[sl]),
+            val=np.asarray(self.val[sl]),
+            offsets=None if self.offsets is None
+            else np.asarray(self.offsets[sl]),
+            weights=None if self.weights is None
+            else np.asarray(self.weights[sl]),
+        )
+
+
 class MmapChunkSource:
     """Disk-native chunk source over an ``io/data_store.py`` columnar
     store: ``read_block`` is a zero-copy mmap slice per section — no
@@ -358,6 +398,13 @@ class DeviceChunk(NamedTuple):
     # a consumption token before reuse; False for chunks aliased straight
     # off the (immutable, never-recycled) source arrays
     fenced: bool = True
+    # stable chunk identity: which chunk of the CANONICAL ascending order
+    # this is. Equal to ``index`` on ascending streams; under
+    # ``stream(order=...)`` the visit position (``index``) permutes while
+    # ``chunk_id`` names the same rows every epoch — the key consumers
+    # with per-chunk state (SDCA's dual slots) key on. -1 = unset
+    # (legacy constructions), meaning "same as index".
+    chunk_id: int = -1
 
 
 @dataclasses.dataclass
@@ -423,6 +470,54 @@ def ensure_aligned(a: np.ndarray) -> np.ndarray:
     return out
 
 
+_U64 = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """One splitmix64 step (pure-int, platform/numpy-version independent —
+    the permutation below must be bitwise stable forever, so it cannot
+    ride numpy's Generator, whose stream is only stable per release
+    line)."""
+    x = (x + 0x9E3779B97F4A7C15) & _U64
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
+    return (z ^ (z >> 31)) & _U64
+
+
+def epoch_chunk_order(seed: int, epoch: int, num_chunks: int) -> np.ndarray:
+    """Deterministic chunk visit order for outer epoch ``epoch``.
+
+    Counter-derived (splitmix64-keyed Fisher-Yates on ``(seed, epoch)``)
+    so two runs — and a kill/resume replay — produce bitwise-identical
+    orders with no wall-clock or global-RNG entropy. Epoch 0 is the
+    IDENTITY by contract: the first pass must ascend because chunk
+    geometry is only learned on a completed ascending pass (with
+    ``drop_invalid`` the survivor-packed chunk count and composition are
+    unknown before it). Later epochs shuffle.
+
+    Stable under drop-invalid filtering: the permutation is a function of
+    ``num_chunks`` alone and chunk *composition* never changes with visit
+    order (survivors pack ascending into chunk ``i // chunk_rows`` slots
+    regardless of the order those chunks are later visited in), so
+    enabling the filter permutes exactly the same chunk ids it packs.
+    """
+    n = int(num_chunks)
+    if n < 0:
+        raise ValueError(f"num_chunks must be >= 0, got {num_chunks}")
+    order = np.arange(n, dtype=np.int64)
+    if int(epoch) == 0 or n <= 1:
+        return order
+    # key the stream on (seed, epoch) via two absorb steps
+    state = _splitmix64((int(seed) & _U64) ^ 0xD6E8FEB86659FD93)
+    state = _splitmix64(state ^ (int(epoch) & _U64))
+    for i in range(n - 1, 0, -1):
+        state = _splitmix64(state)
+        j = state % (i + 1)
+        order[i], order[j] = order[j], order[i]
+    return order
+
+
 class ChunkLoader:
     """Async prefetching chunk loader over a ChunkSource.
 
@@ -480,6 +575,11 @@ class ChunkLoader:
         self._released_idx = -1
         self._streaming = False
         self._num_chunks: Optional[int] = None
+        # cumulative survivor counts per raw block, cached by the first
+        # COMPLETE ascending pass with drop_invalid; permuted streams use
+        # it to find which raw blocks feed chunk k without a full rescan
+        self._block_cum: Optional[np.ndarray] = None
+        self._ordered = False
         self.last_stats = StreamStats()
 
     # -- geometry -----------------------------------------------------------
@@ -497,6 +597,30 @@ class ChunkLoader:
     def chunk_bytes(self) -> int:
         """Host bytes of one staged chunk (= device bytes per chunk)."""
         return sum(a.nbytes for a in self._buffers[0].values())
+
+    def geometry(self) -> Optional[dict]:
+        """Snapshot of the learned pass geometry (chunk count and, with
+        ``drop_invalid``, the per-raw-block survivor cumsum), for
+        checkpoint consumers: a killed permuted-epoch run resumes in a
+        fresh process whose loader never streamed ascending, so the
+        geometry must travel with the checkpoint. None until a first
+        complete pass has learned it."""
+        if self.num_chunks is None:
+            return None
+        g: dict = {"num_chunks": int(self.num_chunks)}
+        if self._block_cum is not None:
+            g["block_cum"] = np.array(self._block_cum)
+        return g
+
+    def restore_geometry(self, g: Optional[dict]) -> None:
+        """Install a :meth:`geometry` snapshot taken from the SAME
+        (immutable) source + config — permuted streams become available
+        without re-paying the ascending discovery pass."""
+        if g is None:
+            return
+        self._num_chunks = int(g["num_chunks"])
+        if g.get("block_cum") is not None:
+            self._block_cum = np.asarray(g["block_cum"], np.int64)
 
     # -- staging pool -------------------------------------------------------
 
@@ -672,6 +796,7 @@ class ChunkLoader:
             # staged_i rotates the staging pool independently of the
             # global chunk index: source-aliased chunks consume no buffer
             emitted, staged_i, fill = 0, 0, 0
+            survivors: List[int] = []
             buf = self._acquire(0, stop, stats)
             for s in range(0, n, c):
                 if stop.is_set():
@@ -682,6 +807,7 @@ class ChunkLoader:
                                      policy=self._policy)
                 if self.config.drop_invalid:
                     block = self._filter(block, stats)
+                    survivors.append(block.rows)
                 if (self._alias and fill == 0 and block.rows == c
                         and not self.config.drop_invalid):
                     dev = (None if emitted < start_chunk
@@ -720,25 +846,32 @@ class ChunkLoader:
                            staged_i % self.config.num_buffers, fill,
                            start_chunk, stats, t0)
                 emitted += 1
+            if self.config.drop_invalid:
+                # complete ascending pass: cache the survivor geometry
+                # permuted epochs need to locate chunk k's raw blocks
+                self._block_cum = np.cumsum([0] + survivors,
+                                            dtype=np.int64)
             self._q_put(q, stop, _EndOfPass(emitted))
         except BaseException as e:  # noqa: BLE001 — surfaced to consumer
             self._q_put(q, stop, _ReaderError(e))
 
     def _emit_aliased(self, q: queue.Queue, stop: threading.Event,
                       index: int, rows: int, dev: Optional[DataBatch],
-                      stats: StreamStats, t0: float) -> None:
+                      stats: StreamStats, t0: float,
+                      chunk_id: Optional[int] = None) -> None:
         stats.reader_busy_s += time.perf_counter() - t0
         if dev is None:   # resume fast-forward: nothing to publish
             return
         stats.chunks += 1
         stats.rows += rows
         stats.bytes_h2d += self.chunk_bytes()
-        self._q_put(q, stop, DeviceChunk(index=index, rows=rows, batch=dev,
-                                         fenced=False))
+        self._q_put(q, stop, DeviceChunk(
+            index=index, rows=rows, batch=dev, fenced=False,
+            chunk_id=index if chunk_id is None else chunk_id))
 
     def _emit(self, q: queue.Queue, stop: threading.Event, index: int,
               b: int, rows: int, start_chunk: int, stats: StreamStats,
-              t0: float) -> None:
+              t0: float, chunk_id: Optional[int] = None) -> None:
         if index < start_chunk:
             # resume fast-forward: the raw read/pack had to happen (chunk
             # packing state carries across chunks) but the transfer is
@@ -751,7 +884,89 @@ class ChunkLoader:
         stats.rows += rows
         stats.bytes_h2d += self.chunk_bytes()
         stats.reader_busy_s += time.perf_counter() - t0
-        self._q_put(q, stop, DeviceChunk(index=index, rows=rows, batch=dev))
+        self._q_put(q, stop, DeviceChunk(
+            index=index, rows=rows, batch=dev,
+            chunk_id=index if chunk_id is None else chunk_id))
+
+    def _produce_ordered(self, q: queue.Queue, stop: threading.Event,
+                         order: np.ndarray, start_pos: int,
+                         stats: StreamStats) -> None:
+        """Reader loop for ``stream(order=...)``: visit chunks of the
+        canonical ascending composition in an arbitrary order. Without
+        filtering, chunk k IS raw block k, so a visit is one direct
+        block read (resume positions are skipped without any I/O —
+        unlike the ascending path there is no cross-chunk packing
+        state). With ``drop_invalid``, the cached survivor geometry maps
+        chunk k's survivor-index span to the raw blocks that feed it;
+        each visit reads and re-filters just those blocks, reproducing
+        the ascending pass's packing bitwise."""
+        try:
+            c, n = self.chunk_rows, self.source.num_rows
+            cum = self._block_cum
+            emitted, staged_i = 0, 0
+            buf = self._acquire(0, stop, stats)
+            for pos in range(int(start_pos), len(order)):
+                if stop.is_set():
+                    return
+                cid = int(order[pos])
+                t0 = time.perf_counter()
+                if cum is None:
+                    lo, hi = cid * c, min(n, (cid + 1) * c)
+                    block = with_retries(self._read_raw, lo, hi,
+                                         op="stream.chunk_read",
+                                         policy=self._policy)
+                    rows = block.rows
+                    if self._alias and rows == c:
+                        dev = self._alias_block(block)
+                        if dev is not None:
+                            self._emit_aliased(q, stop, pos, rows, dev,
+                                               stats, t0, chunk_id=cid)
+                            emitted += 1
+                            continue
+                    self._pack(buf, 0, block, 0, rows)
+                else:
+                    # survivor-index span of chunk cid -> raw blocks
+                    total = int(cum[-1])
+                    lo, hi = cid * c, min(total, (cid + 1) * c)
+                    b0 = int(np.searchsorted(cum, lo, side="right")) - 1
+                    fill = 0
+                    for b in range(b0, len(cum) - 1):
+                        if int(cum[b]) >= hi:
+                            break
+                        block = with_retries(
+                            self._read_raw, b * c, min(n, (b + 1) * c),
+                            op="stream.chunk_read", policy=self._policy)
+                        block = self._filter(block, stats)
+                        if block.rows != int(cum[b + 1]) - int(cum[b]):
+                            raise RuntimeError(
+                                "survivor geometry changed between "
+                                "passes: cached block survivor count "
+                                f"{int(cum[b + 1]) - int(cum[b])} != "
+                                f"refiltered {block.rows} (block {b}) — "
+                                "the source must be immutable for the "
+                                "lifetime of the stream")
+                        p_lo = max(lo - int(cum[b]), 0)
+                        p_hi = min(hi - int(cum[b]), block.rows)
+                        take = p_hi - p_lo
+                        self._pack(buf, fill, block, p_lo, take)
+                        fill += take
+                    rows = fill
+                if rows < c:
+                    self._zero_tail(buf, rows)
+                self._emit(q, stop, pos,
+                           staged_i % self.config.num_buffers, rows,
+                           0, stats, t0, chunk_id=cid)
+                emitted += 1
+                staged_i += 1
+                if stop.is_set():
+                    return
+                buf = self._acquire(staged_i % self.config.num_buffers,
+                                    stop, stats)
+            # the pass covers len(order) chunk positions even when a
+            # resume skipped the leading ones (ascending-path parity)
+            self._q_put(q, stop, _EndOfPass(len(order)))
+        except BaseException as e:  # noqa: BLE001 — surfaced to consumer
+            self._q_put(q, stop, _ReaderError(e))
 
     @staticmethod
     def _q_put(q: queue.Queue, stop: threading.Event, item) -> None:
@@ -777,20 +992,33 @@ class ChunkLoader:
             self._released_idx = chunk.index
             if chunk.fenced:
                 self._release_q.put(token)
-            else:
+            elif not self._ordered:
                 # source-aliased chunk: no buffer to recycle, but a
                 # disk-backed source can use the token to fence page
-                # release behind the consumption cursor
+                # release behind the consumption cursor. Skipped on
+                # permuted streams — the source's release watermark
+                # assumes a monotone row cursor, which only the
+                # ascending order provides (permuted epochs trade the
+                # RSS bound for random visit order).
                 consumed = getattr(self.source, "consumed", None)
                 if consumed is not None:
                     consumed(chunk.index * self.chunk_rows + chunk.rows,
                              token)
 
-    def stream(self, start_chunk: int = 0) -> Iterator[DeviceChunk]:
+    def stream(self, start_chunk: int = 0,
+               order=None) -> Iterator[DeviceChunk]:
         """Yield DeviceChunks in deterministic ascending order, chunk
         k+1's staging overlapping chunk k's compute. ``start_chunk``
         resumes mid-pass (chunks before it are read but not transferred).
         Stats for the pass land in ``self.last_stats`` on close.
+
+        ``order`` (a permutation of ``range(num_chunks)``, e.g. from
+        :func:`epoch_chunk_order`) visits the SAME ascending-composition
+        chunks in that order: ``DeviceChunk.index`` is the visit
+        position, ``DeviceChunk.chunk_id`` the stable chunk identity,
+        and ``start_chunk`` counts positions in ``order``. With
+        ``drop_invalid`` a permuted pass needs the survivor geometry a
+        completed ascending pass caches — stream ascending once first.
 
         A new pass reuses the staging pool unfenced, so in zero-copy
         mode all chunks of the previous pass must be fully consumed
@@ -798,16 +1026,40 @@ class ChunkLoader:
         per-pass host read of (f, g) guarantees exactly that."""
         if self._streaming:
             raise RuntimeError("one active stream per ChunkLoader")
+        if order is not None:
+            order = np.asarray(order, np.int64)
+            if self.config.drop_invalid:
+                if self._block_cum is None or self._num_chunks is None:
+                    raise ValueError(
+                        "stream(order=...) with drop_invalid needs the "
+                        "survivor geometry of a completed ascending "
+                        "pass — stream() once without order first")
+                expect = self._num_chunks
+            else:
+                expect = self.num_chunks
+            if (order.ndim != 1 or len(order) != expect
+                    or not np.array_equal(np.sort(order),
+                                          np.arange(expect))):
+                raise ValueError(
+                    f"order must be a permutation of range({expect}), "
+                    f"got shape {order.shape}")
         self._streaming = True
+        self._ordered = order is not None
         q: queue.Queue = queue.Queue(maxsize=self.config.num_buffers)
         stop = threading.Event()
         stats = StreamStats()
         self._inflight = [None] * self.config.num_buffers
         self._release_q = queue.Queue()
         self._released_idx = -1
-        reader = threading.Thread(
-            target=self._produce, args=(q, stop, start_chunk, stats),
-            daemon=True, name="photon-stream-reader")
+        if order is not None:
+            reader = threading.Thread(
+                target=self._produce_ordered,
+                args=(q, stop, order, start_chunk, stats),
+                daemon=True, name="photon-stream-reader")
+        else:
+            reader = threading.Thread(
+                target=self._produce, args=(q, stop, start_chunk, stats),
+                daemon=True, name="photon-stream-reader")
         wall0 = time.perf_counter()
         reader.start()
         try:
@@ -835,6 +1087,7 @@ class ChunkLoader:
             stats.wall_s = time.perf_counter() - wall0
             self.last_stats = stats
             self._streaming = False
+            self._ordered = False
             try:
                 from photon_tpu.obs.metrics import registry
                 registry.counter("stream.chunks").inc(stats.chunks)

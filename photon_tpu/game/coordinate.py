@@ -144,6 +144,12 @@ class FixedEffectCoordinate:
         self.problem = GlmOptimizationProblem(task, config,
                                               norm or no_normalization(),
                                               intercept_index=intercept_index)
+        if config.optimizer.optimizer_type == OptimizerType.SDCA:
+            # config-time typed refusal (SdcaUnsupportedLossError) for
+            # tasks whose loss has no conjugate dual step (Poisson) —
+            # don't wait for the first sweep to fail mid-fit
+            from photon_tpu.optim.sdca import validate_loss
+            validate_loss(loss_for_task(task).name)
         self._sampling_key = sampling_key
         self._update_count = 0
         self.mesh = mesh
@@ -517,6 +523,12 @@ class RandomEffectCoordinate:
 
     def _validate_solver(self) -> None:
         opt = self.config.optimizer
+        if opt.optimizer_type == OptimizerType.SDCA:
+            raise ValueError(
+                "SDCA is a streaming fixed-effect solver (per-example "
+                "dual state over the chunk store); the per-entity "
+                "random-effect solves have no dual-state batching rule — "
+                "use LBFGS/DIRECT/NEWTON for random-effect coordinates")
         if opt.optimizer_type == OptimizerType.DIRECT:
             from photon_tpu.optim.problem import _validate_direct
             _validate_direct(self.task, opt, self.config.regularization)

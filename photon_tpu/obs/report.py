@@ -94,6 +94,9 @@ def build_run_report(driver: str,
     sweep = _sweep_section()
     if sweep is not None:
         report["sweep"] = sweep
+    sdca = _sdca_section()
+    if sdca is not None:
+        report["sdca"] = sdca
     if extra:
         report["extra"] = extra
     return report
@@ -152,6 +155,20 @@ def _sweep_section() -> Optional[Dict[str, Any]]:
         section = mod.report_section()
         # an imported-but-idle batched module stays out of the report
         return section if section.get("runs") else None
+    except Exception:  # noqa: BLE001 — reporting must not kill a run
+        return None
+
+
+def _sdca_section() -> Optional[Dict[str, Any]]:
+    """Stochastic dual (SDCA) solve accounting — runs/epochs/staleness
+    fallbacks and the last run's gap outcome — when this process ran one.
+    Same ``sys.modules`` pattern as :func:`_serving_section`; the section
+    itself returns None while no solve has run."""
+    mod = sys.modules.get("photon_tpu.optim.sdca")
+    if mod is None:
+        return None
+    try:
+        return mod.report_section()
     except Exception:  # noqa: BLE001 — reporting must not kill a run
         return None
 
@@ -285,6 +302,14 @@ def validate_run_report(report: Dict[str, Any]) -> List[str]:
                     errors.append(f"sweep missing {k!r}")
             if not isinstance(sweep.get("lane_records", []), list):
                 errors.append("sweep.lane_records must be a list")
+    if "sdca" in report:  # optional: only stochastic-dual training runs
+        sdca = report["sdca"]
+        if not isinstance(sdca, dict):
+            errors.append("sdca must be a dict")
+        else:
+            for k in ("runs", "epochs", "fallbacks", "converged"):
+                if k not in sdca:
+                    errors.append(f"sdca missing {k!r}")
     if "cd" in report:  # optional: only parallel-CD training processes
         cd = report["cd"]
         if not isinstance(cd, dict) or not isinstance(

@@ -33,6 +33,11 @@ class ConvergenceReason(enum.IntEnum):
     FUNCTION_VALUES_CONVERGED = 2
     GRADIENT_CONVERGED = 3
     OBJECTIVE_NOT_IMPROVING = 4
+    # TPU-native extension (no reference analog): the stochastic dual
+    # solver (optim/sdca.py) terminates on a duality-gap certificate
+    # rather than value/gradient deltas — the gap bounds the primal
+    # suboptimality directly, so this is a stronger typed stop.
+    DUALITY_GAP_CONVERGED = 5
 
 
 class FailureMode(enum.IntEnum):

@@ -318,6 +318,22 @@ class GlmOptimizationProblem:
         reductions are all-reduces over ICI (the treeAggregate + broadcast
         replacement, SURVEY §5.8)."""
         norm = self.objective.norm
+        if self.config.optimizer.optimizer_type == OptimizerType.SDCA:
+            import numpy as np
+            if mesh is not None:
+                raise ValueError(
+                    "SDCA over a resident batch does not take a mesh — "
+                    "build a meshed ChunkLoader and call run_streamed")
+            if initial is not None and bool(np.any(np.asarray(initial) != 0)):
+                raise ValueError(
+                    "SDCA cannot warm-start from nonzero coefficients "
+                    "(no dual preimage for an arbitrary w); start from "
+                    "zeros or use LBFGS for warm-started re-fits")
+            if dim is None and initial is not None:
+                dim = int(np.shape(initial)[0])
+            return self.run_sdca_resident(
+                batch, dim=dim, dtype=dtype,
+                regularization_weight=regularization_weight)
         if dtype is None:
             # match the batch: a float32 x0 against float64 data would
             # promote mid-solve and break the while_loop carry contract
@@ -493,6 +509,7 @@ class GlmOptimizationProblem:
         regularization_weight: Optional[float] = None,
         checkpoint_path: Optional[str] = None,
         checkpoint_every_chunks: int = 0,
+        sdca_config=None,
     ) -> Tuple[GeneralizedLinearModel, SolverResult]:
         """Out-of-core solve: same contract as ``run`` but the data is a
         ``data.streaming.ChunkLoader`` instead of a resident batch — the
@@ -500,17 +517,29 @@ class GlmOptimizationProblem:
         host->device transfer, so the dataset never needs to fit in HBM.
 
         Only first-order solvers stream (LBFGS; OWLQN when the
-        regularization has an L1 part): second-order solvers would need a
-        streamed pass per Hessian application. The mesh (if any) comes
-        from the loader. ``checkpoint_path`` enables the chunk-cursor
-        checkpoint for bitwise mid-epoch resume after preemption."""
+        regularization has an L1 part; SDCA for one-storage-pass-per-epoch
+        stochastic training — optim/sdca.py): second-order solvers would
+        need a streamed pass per Hessian application. The mesh (if any)
+        comes from the loader. ``checkpoint_path`` enables the
+        chunk-cursor checkpoint for bitwise mid-epoch resume after
+        preemption. ``sdca_config`` (an :class:`optim.sdca.SdcaConfig`)
+        overrides the default OptimizerConfig mapping
+        (max_iterations -> max_epochs, tolerance -> relative
+        gap_tolerance) for the SDCA arm."""
         from photon_tpu.optim import streaming
 
         opt = self.config.optimizer
+        if opt.optimizer_type == OptimizerType.SDCA:
+            return self._run_sdca(
+                loader, initial=initial, dim=dim, dtype=dtype,
+                regularization_weight=regularization_weight,
+                checkpoint_path=checkpoint_path,
+                checkpoint_every_chunks=checkpoint_every_chunks,
+                sdca_config=sdca_config)
         if opt.optimizer_type not in (OptimizerType.LBFGS,
                                       OptimizerType.OWLQN):
             raise ValueError(
-                f"streamed training supports LBFGS/OWLQN only, not "
+                f"streamed training supports LBFGS/OWLQN/SDCA only, not "
                 f"{opt.optimizer_type} (second-order solvers need a full "
                 f"pass per Hessian application)")
         norm = self.objective.norm
@@ -539,6 +568,106 @@ class GlmOptimizationProblem:
             coef = norm.transformed_space_to_model(coef, self.intercept_index)
         model = GeneralizedLinearModel(Coefficients(coef), self.task)
         return model, result
+
+    def _run_sdca(
+        self,
+        loader,
+        *,
+        initial,
+        dim,
+        dtype,
+        regularization_weight,
+        checkpoint_path,
+        checkpoint_every_chunks,
+        sdca_config,
+    ) -> Tuple[GeneralizedLinearModel, SolverResult]:
+        """SDCA arm of ``run_streamed`` (optim/sdca.py): typed refusals at
+        this boundary, then the chunk-local dual solve."""
+        import numpy as np
+
+        from photon_tpu.optim import sdca
+
+        opt = self.config.optimizer
+        lam = (self.config.regularization_weight
+               if regularization_weight is None else regularization_weight)
+        if self.config.regularization.l1_weight(lam) != 0.0:
+            raise ValueError(
+                "SDCA has no dual coordinate step for the L1 term "
+                "(the conjugate of |.| is an indicator, not a smooth box); "
+                "use OWLQN for L1/elastic-net")
+        if initial is not None and bool(np.any(np.asarray(initial) != 0)):
+            raise ValueError(
+                "SDCA cannot warm-start from nonzero coefficients: the "
+                "dual decomposition w = v / l2 requires v = sum alpha_i "
+                "x_i, and an arbitrary w has no dual preimage; start from "
+                "zeros or use the streamed L-BFGS path for warm-started "
+                "sweeps")
+        cfg = sdca_config if sdca_config is not None else sdca.SdcaConfig(
+            max_epochs=opt.max_iterations, gap_tolerance=opt.tolerance)
+        result = sdca.minimize_sdca(
+            self.objective, loader,
+            l2_weight=self.config.regularization.l2_weight(lam),
+            config=cfg, dim=dim, dtype=dtype,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every_chunks=checkpoint_every_chunks)
+        # minimize_sdca refuses non-identity norms, so coef is model space
+        model = GeneralizedLinearModel(Coefficients(result.coef), self.task)
+        return model, result
+
+    def run_sdca_resident(
+        self,
+        batch: DataBatch,
+        dim: Optional[int] = None,
+        dtype=None,
+        regularization_weight: Optional[float] = None,
+        chunk_rows: int = 8192,
+        sdca_config=None,
+    ) -> Tuple[GeneralizedLinearModel, SolverResult]:
+        """SDCA over a RESIDENT batch: re-streams the device arrays
+        through the chunk pipeline (EllSource/DenseSource wrap host
+        views) so the one solver serves both the disk-native and the
+        in-core case. The fixed-effect coordinate passthrough lands here
+        when the configured optimizer is ``OptimizerType.SDCA``."""
+        import numpy as np
+
+        from photon_tpu.data import streaming as dstream
+        from photon_tpu.ops.features import (
+            ModelShardedSparse,
+            SparseFeatures,
+        )
+
+        feats = batch.features
+        if isinstance(feats, ModelShardedSparse):
+            raise ValueError(
+                "SDCA keeps the full primal carry v per sample shard, "
+                "which contradicts model-axis sharding of theta; use the "
+                "streamed L-BFGS path for model-sharded coordinates")
+        np_leaf = lambda a: None if a is None else np.asarray(a)
+        if isinstance(feats, SparseFeatures):
+            if dim is None:
+                raise ValueError(
+                    "run_sdca_resident needs dim for sparse features "
+                    "(ELL indices do not bound the model width)")
+            src = dstream.EllSource(
+                np_leaf(feats.indices), np_leaf(feats.values),
+                np_leaf(batch.labels), dim=int(dim),
+                offsets=np_leaf(batch.offsets),
+                weights=np_leaf(batch.weights))
+        else:
+            src = dstream.DenseSource(
+                np_leaf(feats), np_leaf(batch.labels),
+                offsets=np_leaf(batch.offsets),
+                weights=np_leaf(batch.weights))
+        if dtype is None:
+            dtype = batch.labels.dtype
+        loader = dstream.ChunkLoader(
+            src, dstream.StreamConfig(chunk_rows=chunk_rows,
+                                      dtype=np.dtype(dtype)))
+        return self._run_sdca(
+            loader, initial=None, dim=int(src.dim if dim is None else dim),
+            dtype=dtype, regularization_weight=regularization_weight,
+            checkpoint_path=None, checkpoint_every_chunks=0,
+            sdca_config=sdca_config)
 
     # -- variances (reference: DistributedOptimizationProblem:82-100) -------
 

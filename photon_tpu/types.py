@@ -58,6 +58,11 @@ class OptimizerType(enum.Enum):
     # minimizer the iterative solvers converge to, computed directly
     # (sklearn Ridge's own cholesky solver is the CPU-world equivalent).
     DIRECT = "DIRECT"
+    # TPU-native extension: chunk-local stochastic dual coordinate ascent
+    # over the streaming chunk store (optim/sdca.py) — one storage pass
+    # per outer epoch with a duality-gap stopping certificate, for fits
+    # whose data lives on disk (Snap ML / TPA-SCD, see PAPERS.md).
+    SDCA = "SDCA"
     # TPU-native extension (no reference analog): damped Newton / IRLS
     # with an explicit Hessian Cholesky per outer iteration — DIRECT's
     # batched [E, K, K] machinery extended to logistic/Poisson, replacing

@@ -12,7 +12,11 @@ round-trip per iteration and silently serialize every solve; a
 This script walks ``photon_tpu/optim/`` — including the lane-batched
 sweep solvers in ``optim/batched.py``, whose per-lane convergence
 freezing must stay a ``where``-masked while_loop carry with no host
-reads as lanes finish — (plus ``photon_tpu/game/``, which drives the
+reads as lanes finish, and the chunk-local SDCA arm in
+``optim/sdca.py``, whose per-chunk dual program must complete with
+exactly one deliberate host crossing per OUTER epoch (the np.asarray
+finalize read) so chunk k+1's transfer overlaps chunk k's coordinate
+sweeps — (plus ``photon_tpu/game/``, which drives the
 jitted solves: the parallel-sweep scheduler in ``game/descent.py`` /
 ``game/parallel_cd.py``, whose worker threads must dispatch solves
 asynchronously: one blocking transfer inside a group member would

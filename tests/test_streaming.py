@@ -34,6 +34,7 @@ from photon_tpu.data.streaming import (
     CsrSource,
     DenseSource,
     StreamConfig,
+    epoch_chunk_order,
 )
 from photon_tpu.data.validators import invalid_chunk_mask
 from photon_tpu.function.objective import GLMObjective, Hyper
@@ -702,3 +703,116 @@ class TestBenchSmoke:
         assert rec["aliased_chunks"] == rec["chunks_per_pass"], rec
         assert rec["convert_mb_per_s"] > 0, rec
         assert rec["value"] > 0
+
+
+class TestEpochChunkOrder:
+    """Satellite regression: the counter-derived per-epoch chunk
+    permutation the SDCA arm rides. Identity on epoch 0 (geometry is
+    only learned on a completed ascending pass), splitmix64-keyed
+    Fisher-Yates afterwards — bitwise stable across platforms and numpy
+    releases, so the exact vectors are pinned."""
+
+    def test_epoch0_is_identity(self):
+        np.testing.assert_array_equal(epoch_chunk_order(9, 0, 6),
+                                      np.arange(6))
+
+    def test_degenerate_sizes(self):
+        np.testing.assert_array_equal(epoch_chunk_order(3, 5, 0), [])
+        np.testing.assert_array_equal(epoch_chunk_order(3, 5, 1), [0])
+        with pytest.raises(ValueError, match="num_chunks"):
+            epoch_chunk_order(3, 5, -1)
+
+    def test_is_permutation_and_deterministic(self):
+        for seed in (0, 3, 123456789):
+            for epoch in (1, 2, 17):
+                a = epoch_chunk_order(seed, epoch, 13)
+                np.testing.assert_array_equal(np.sort(a), np.arange(13))
+                np.testing.assert_array_equal(
+                    a, epoch_chunk_order(seed, epoch, 13))
+
+    def test_seed_and_epoch_key_the_stream(self):
+        base = epoch_chunk_order(3, 1, 8)
+        assert not np.array_equal(base, epoch_chunk_order(3, 2, 8))
+        assert not np.array_equal(base, epoch_chunk_order(7, 1, 8))
+
+    def test_pinned_regression_vectors(self):
+        """Checkpoint resume replays the permutation from (seed, epoch)
+        alone, so these exact orders are a forever contract."""
+        np.testing.assert_array_equal(epoch_chunk_order(3, 1, 8),
+                                      [2, 4, 7, 0, 1, 5, 6, 3])
+        np.testing.assert_array_equal(epoch_chunk_order(3, 2, 8),
+                                      [2, 1, 3, 5, 6, 4, 0, 7])
+        np.testing.assert_array_equal(epoch_chunk_order(7, 1, 8),
+                                      [2, 6, 1, 0, 4, 5, 7, 3])
+        np.testing.assert_array_equal(epoch_chunk_order(0, 5, 5),
+                                      [3, 2, 0, 1, 4])
+
+    def test_stream_order_visits_canonical_chunks(self, rng):
+        """stream(order=...) permutes WHICH chunk arrives when, never
+        chunk composition: chunk_id c carries exactly the rows the
+        ascending pass put in chunk c, and index is the visit position."""
+        n, d = 640, 6
+        X, y = _logistic_problem(rng, n=n, d=d)
+        loader = ChunkLoader(DenseSource(X, y),
+                             StreamConfig(chunk_rows=128,
+                                          dtype=np.float64))
+        ascending = {c.chunk_id: (np.asarray(c.batch.features).copy(),
+                                  np.asarray(c.batch.labels).copy(),
+                                  c.rows)
+                     for c in loader.stream()}
+        order = epoch_chunk_order(3, 1, loader.num_chunks)
+        seen = []
+        for pos, chunk in enumerate(loader.stream(order=order)):
+            assert chunk.index == pos
+            assert chunk.chunk_id == int(order[pos])
+            ref_x, ref_y, ref_rows = ascending[chunk.chunk_id]
+            assert chunk.rows == ref_rows
+            np.testing.assert_array_equal(
+                np.asarray(chunk.batch.features), ref_x)
+            np.testing.assert_array_equal(
+                np.asarray(chunk.batch.labels), ref_y)
+            seen.append(chunk.chunk_id)
+        assert seen == list(order)
+
+    def test_stream_order_refuses_non_permutation(self, rng):
+        X, y = _logistic_problem(rng, n=256, d=4)
+        loader = ChunkLoader(DenseSource(X, y),
+                             StreamConfig(chunk_rows=128,
+                                          dtype=np.float64))
+        with pytest.raises(ValueError, match="permutation"):
+            list(loader.stream(order=[0, 0]))
+
+    def test_geometry_roundtrip_enables_permuted_resume(self, rng):
+        """A permuted pass with drop_invalid needs the survivor geometry
+        of a completed ascending pass. geometry()/restore_geometry()
+        moves that across a process boundary: a FRESH loader that never
+        streamed ascending serves the identical permuted pass."""
+        n, d = 700, 6
+        X, y = _logistic_problem(rng, n=n, d=d)
+        y[rng.choice(n, size=40, replace=False)] = np.nan
+        cfg = StreamConfig(chunk_rows=128, dtype=np.float64,
+                           drop_invalid=True,
+                           task=TaskType.LOGISTIC_REGRESSION)
+
+        loader = ChunkLoader(DenseSource(X, y), cfg)
+        assert loader.geometry() is None  # unknown before a full pass
+        for _ in loader.stream():
+            pass
+        geom = loader.geometry()
+        assert geom is not None and "block_cum" in geom
+        order = epoch_chunk_order(3, 1, geom["num_chunks"])
+        ref = [(c.chunk_id, c.rows,
+                np.asarray(c.batch.labels).copy())
+               for c in loader.stream(order=order)]
+
+        fresh = ChunkLoader(DenseSource(X, y), cfg)
+        with pytest.raises(ValueError, match="ascending"):
+            list(fresh.stream(order=order))  # no geometry yet
+        fresh.restore_geometry(geom)
+        got = [(c.chunk_id, c.rows,
+                np.asarray(c.batch.labels).copy())
+               for c in fresh.stream(order=order)]
+        assert len(got) == len(ref)
+        for (ri, rr, ry), (gi, gr, gy) in zip(ref, got):
+            assert ri == gi and rr == gr
+            np.testing.assert_array_equal(ry, gy)
