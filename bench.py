@@ -2904,6 +2904,242 @@ def run_sweep_bench(scale: float, quick: bool = False):
     return rec
 
 
+def run_re_sweep_bench(scale: float, quick: bool = False):
+    """Random-effect λ-lane sweep throughput (ISSUE 17): HBM footprint
+    planner + double-buffered entity-block pipeline + lane solves.
+
+    Measured gates (the acceptance contract):
+
+      * data passes — a K-point sweep over the bucket ladder stages each
+        bucket ONCE (prefetcher ``blocks_staged``), vs K stagings per
+        bucket for K sequential ``update_model_blocked`` fits:
+        swept passes <= (1/K) * sequential + 1 ladder pass;
+      * bitwise parity — every λ lane's coefficients equal its
+        sequential scalar fit bit-for-bit (the flattened-lane program,
+        game/coordinate._make_block_solver_swept), at the suite's f64;
+      * planner honesty — the BlockPlan's per-bucket planned peak bytes
+        >= the measured staging+tile accounting on EVERY bucket
+        (process RSS high-water is recorded as the CPU proxy);
+      * typed degradation — a forced small budget engages chunked lanes
+        (strategy recorded in the plan and the RunReport ``re_plan``
+        section) with final models identical to the full-K run;
+      * pipeline overlap — reader-busy/stall clocks from the block
+        prefetcher, plus a recompile check across a second λ grid.
+
+    ``quick`` is the tier-1 smoke shape: tiny ladder, one timed run, NO
+    artifact write."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+
+    import dataclasses as _dc
+    import resource
+
+    # optim.problem first: importing function.objective before the
+    # data/ package closes a circular-import chain
+    from photon_tpu.optim.problem import (
+        GLMOptimizationConfiguration,
+        OptimizerConfig,
+    )
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.coordinate import RandomEffectCoordinate
+    from photon_tpu.game.dataset import (EntityVocabulary, FeatureShard,
+                                         GameDataFrame)
+    from photon_tpu.game.random_effect import (
+        RandomEffectDataConfiguration,
+        build_random_effect_dataset,
+    )
+    from photon_tpu.parallel import memory as hbm
+    from photon_tpu.types import TaskType
+    from photon_tpu.obs.metrics import registry as _registry
+
+    n = max(int((2_500 if quick else 40_000) * scale), 600)
+    d = 4 if quick else 8
+    ents = max(int((80 if quick else 1_500) * scale), 40)
+    K = 4 if quick else 8
+    max_buckets = 3 if quick else 5
+    grid = np.logspace(-1.0, 1.0, K)
+    rng = np.random.default_rng(23)
+
+    ent = rng.zipf(1.35, size=n) % ents
+    idx = np.arange(d, dtype=np.int32)
+    rows = [(idx, rng.normal(size=d)) for _ in range(n)]
+    y = (rng.random(n) > 0.5).astype(np.float64)
+    df = GameDataFrame(num_samples=n, response=y,
+                       feature_shards={"u": FeatureShard(rows, d)},
+                       id_tags={"userId": [str(e) for e in ent]})
+    vocab = EntityVocabulary()
+    cfg = RandomEffectDataConfiguration("userId", "u",
+                                        max_entity_buckets=max_buckets)
+    ds = build_random_effect_dataset(df, cfg, vocab, dtype=np.float64)
+    coord = RandomEffectCoordinate(
+        ds, n, "userId", "u", TaskType.LOGISTIC_REGRESSION,
+        GLMOptimizationConfiguration(
+            optimizer=OptimizerConfig(max_iterations=25, tolerance=1e-8),
+            regularization=L2Regularization, regularization_weight=1.0))
+    n_blocks = len(ds.blocks)
+
+    # sequential baseline: one blocked fit per λ (the workflow the lane
+    # sweep replaces), stagings counted by the prefetcher
+    def _sequential():
+        out, passes = [], 0
+        for w in grid:
+            coord.config = _dc.replace(coord.config,
+                                       regularization_weight=float(w))
+            m = coord.update_model_blocked(None)
+            out.append(np.asarray(m.coefficients))
+            passes += coord.last_blocks_staged
+        return out, passes
+
+    def _swept():
+        models = coord.update_model_blocked_swept(None, grid)
+        return ([np.asarray(m.coefficients) for m in models],
+                coord.last_blocks_staged)
+
+    # warmup: compile every program off the clock
+    _sequential()
+    _swept()
+
+    k_timed = 1 if quick else 3
+    t_seq, (seq_coefs, seq_passes), seq_times = timed_median(
+        _sequential, k=k_timed, budget_s=600.0)
+    t_swept, (swept_coefs, swept_passes), swept_times = timed_median(
+        _swept, k=k_timed, budget_s=600.0)
+    overlap = dict(coord.last_block_overlap or {})
+    measured = list(coord.last_block_measured)
+    plan = coord.last_block_plan
+
+    lane_bitwise = [bool(np.array_equal(swept_coefs[i], seq_coefs[i]))
+                    for i in range(K)]
+    # all-at-once swept vs sequential update_model — same contract on
+    # the non-blocked path
+    coord.config = _dc.replace(coord.config, regularization_weight=1.0)
+    flat_refs = []
+    for w in grid:
+        coord.config = _dc.replace(coord.config,
+                                   regularization_weight=float(w))
+        flat_refs.append(np.asarray(
+            coord.update_model(None, None).coefficients))
+    flat_models = coord.update_model_swept(None, None, grid)
+    flat_bitwise = [bool(np.array_equal(
+        np.asarray(flat_models[i].coefficients), flat_refs[i]))
+        for i in range(K)]
+
+    # data-pass gate: swept <= (1/K) * sequential + one ladder pass
+    passes_bound = seq_passes / K + n_blocks
+    passes_ok = bool(swept_passes <= passes_bound)
+
+    planner_honest = [bool(m["planned_peak_bytes"] >= m["measured_peak_bytes"])
+                      for m in measured]
+    rss_peak_bytes = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+    # forced-small-budget degradation: chunked lanes engage (typed,
+    # recorded), final models identical to the full-K run
+    tiny = max(2 * b.data_bytes + b.data_bytes + b.lane_bytes
+               for b in plan.buckets)
+    small_models = coord.update_model_blocked_swept(
+        None, grid, hbm_budget_bytes=tiny)
+    small_plan = coord.last_block_plan
+    degraded_identical = [bool(np.array_equal(
+        np.asarray(small_models[i].coefficients), swept_coefs[i]))
+        for i in range(K)]
+    report_section = hbm.report_section() or {}
+
+    # recompile check: a second grid (same K, different λs) must reuse
+    # every compiled lane program
+    dense = coord._dense_local_blocks
+    solvers = {coord._block_solve_swept_fn(bool(f)) for f in set(dense)}
+    cache_before = sum(s._cache_size() for s in solvers)
+    recompiles_before = _registry.snapshot()["counters"].get(
+        "jitcache.recompiles", 0)
+    coord.update_model_blocked_swept(None, np.logspace(-2.0, 2.0, K))
+    new_traces = sum(s._cache_size() for s in solvers) - cache_before
+    new_recompiles = (_registry.snapshot()["counters"].get(
+        "jitcache.recompiles", 0) - recompiles_before)
+
+    speedup = t_seq / t_swept if t_swept > 0 else 0.0
+    rec = {
+        "metric": "re_sweep_data_passes",
+        "value": int(swept_passes),
+        "unit": (f"bucket stagings for a {K}-point λ sweep "
+                 f"(sequential: {seq_passes}; bound: "
+                 f"{passes_bound:.0f})"),
+        "data_passes": {
+            "swept": int(swept_passes),
+            "sequential": int(seq_passes),
+            "bound_1_over_k_plus_ladder": passes_bound,
+            "within_bound": passes_ok,
+        },
+        "wall_clock": {
+            "swept_s": round(t_swept, 3),
+            "sequential_s": round(t_seq, 3),
+            "speedup": round(speedup, 3),
+            "swept_runs_s": swept_times,
+            "sequential_runs_s": seq_times,
+        },
+        "lane_vs_scalar_bitwise_blocked": lane_bitwise,
+        "lane_vs_scalar_bitwise_all_at_once": flat_bitwise,
+        "bitwise_all_lanes": bool(all(lane_bitwise) and all(flat_bitwise)),
+        "planner": {
+            "budget_bytes": plan.budget_bytes,
+            "budget_source": plan.budget_source,
+            "lane_chunk": plan.lane_chunk,
+            "strategies": [b.strategy for b in plan.buckets],
+            "planned_vs_measured": measured,
+            "planned_ge_measured_all_buckets": bool(all(planner_honest)),
+            "rss_peak_bytes": int(rss_peak_bytes),
+        },
+        "degradation": {
+            "forced_budget_bytes": int(tiny),
+            "lane_chunk": small_plan.lane_chunk,
+            "strategies": [b.strategy for b in small_plan.buckets],
+            "degraded": bool(small_plan.degraded),
+            "models_identical_to_full_k": degraded_identical,
+            "report_plans": report_section.get("plans", 0),
+            "report_buckets_degraded": report_section.get(
+                "buckets_degraded", 0),
+        },
+        "overlap": overlap,
+        "new_traces_across_grids": int(new_traces),
+        "jitcache_recompiles": int(new_recompiles),
+        "zero_recompiles": bool(new_traces == 0 and new_recompiles == 0),
+        "workload": {"n": n, "d": d, "entities": ents, "K": K,
+                     "buckets": n_blocks,
+                     "l2_grid": [float(w) for w in grid]},
+        "quick": quick,
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "tpu_unavailable": _STATE["tpu_unavailable"],
+    }
+    if not quick:
+        assert passes_ok, (
+            f"swept sweep staged {swept_passes} buckets, bound "
+            f"{passes_bound:.0f} (sequential {seq_passes})")
+        assert rec["bitwise_all_lanes"], (
+            f"lane-vs-scalar parity broken: blocked {lane_bitwise}, "
+            f"all-at-once {flat_bitwise}")
+        assert all(planner_honest), (
+            f"planner under-estimated a bucket: {measured}")
+        assert small_plan.degraded and all(degraded_identical), (
+            f"forced-budget degradation: degraded={small_plan.degraded}, "
+            f"identical={degraded_identical}")
+        assert rec["zero_recompiles"], (
+            f"{new_traces} new traces / {new_recompiles} recompiles "
+            "across λ grids")
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_RE_SWEEP_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"re_sweep: {K}-λ sweep {swept_passes} stagings vs {seq_passes} "
+        f"sequential (bound {passes_bound:.0f}), wall {t_swept:.3f}s vs "
+        f"{t_seq:.3f}s ({speedup:.2f}x), bitwise "
+        f"{rec['bitwise_all_lanes']}, overlap "
+        f"{overlap.get('overlap_efficiency', 0.0):.2f}, chunked-degrade "
+        f"identical {all(degraded_identical)}")
+    return rec
+
+
 # --------------------------------------------------------------------------
 # nearline mode: --mode nearline -> BENCH_NEARLINE_r01.json
 # --------------------------------------------------------------------------
@@ -4722,7 +4958,8 @@ def main():
     ap.add_argument("--mode", default=os.environ.get("BENCH_MODE", "train"),
                     choices=("train", "serving", "game_cd", "coldtier",
                              "nearline", "hier", "fused", "stream", "fleet",
-                             "tenant", "ingest", "sweep", "sdca"),
+                             "tenant", "ingest", "sweep", "sdca",
+                             "re_sweep"),
                     help="train = the solver configs (default); serving = "
                          "the online-serving bench -> BENCH_SERVING_r01.json; "
                          "game_cd = parallel-vs-sequential CD sweeps "
@@ -4746,11 +4983,14 @@ def main():
                          "multi-lambda grid vs sequential solves + "
                          "warm-started GP tuning -> BENCH_SWEEP_r01.json; "
                          "sdca = chunk-local SDCA vs streamed L-BFGS "
-                         "storage passes to AUC -> BENCH_SDCA_r01.json")
+                         "storage passes to AUC -> BENCH_SDCA_r01.json; "
+                         "re_sweep = random-effect λ-lane sweep data "
+                         "passes + HBM planner honesty "
+                         "-> BENCH_RE_SWEEP_r01.json")
     ap.add_argument("--quick", action="store_true",
                     help="game_cd/coldtier/nearline/hier/fused/stream/"
-                         "fleet/tenant/ingest/sweep/sdca: tiny tier-1 "
-                         "smoke shape (no artifact write)")
+                         "fleet/tenant/ingest/sweep/sdca/re_sweep: tiny "
+                         "tier-1 smoke shape (no artifact write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -4965,6 +5205,22 @@ def main():
                   "unit": "x (sum of sequential solves / one batched "
                           "solve)", "error": repr(e)})
         _DONE.set()     # sweep mode: the record above IS the summary
+        return
+
+    if args.mode == "re_sweep":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/re_sweep"):
+                emit(run_re_sweep_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"re_sweep bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "re_sweep_data_passes", "value": 0,
+                  "unit": "bucket stagings for a K-point λ sweep",
+                  "error": repr(e)})
+        _DONE.set()     # re_sweep mode: the record above IS the summary
         return
 
     if args.mode == "game_cd":

@@ -372,11 +372,14 @@ class GameEstimator:
         validation_df: Optional[GameDataFrame] = None,
         weights: Sequence[float] = (),
     ) -> List[GameResult]:
-        """Fit an l2 grid over a single fixed-effect model as ONE
-        lane-batched solve (``cli/train --sweep-l2``): one compiled
-        program, one shared data pass per iteration, one
-        :class:`GameResult` per lane with lane-batched validation
-        scoring. Multi-coordinate / random-effect / model-sharded
+        """Fit an l2 grid over a single fixed-effect OR single
+        random-effect model as ONE lane-batched solve
+        (``cli/train --sweep-l2``): one compiled program, one shared
+        data pass per iteration, one :class:`GameResult` per lane. The
+        fixed path scores validation lanes batched; the random path
+        (:meth:`RandomEffectCoordinate.update_model_swept`) reads its
+        bucket ladder once for all λ points and scores per lane through
+        the ordinary scorer. Multi-coordinate / entity- or model-sharded
         estimators fall back to :meth:`fit` with one configuration per
         weight — identical results, sequential solves."""
         from photon_tpu.optim import batched
@@ -386,10 +389,16 @@ class GameEstimator:
         cids = list(self.coordinate_configs.keys())
         vocab, coordinates, re_datasets = self._prepare_cached(df)
         only = coordinates[cids[0]] if len(cids) == 1 else None
-        if not (isinstance(only, FixedEffectCoordinate)
-                and not only._model_sharded
-                and self.coordinate_configs[cids[0]].optimization.optimizer
-                    .optimizer_type.name in ("LBFGS", "OWLQN")):
+        opt_ok = (only is not None
+                  and self.coordinate_configs[cids[0]].optimization.optimizer
+                      .optimizer_type.name in ("LBFGS", "OWLQN"))
+        if (opt_ok and isinstance(only, RandomEffectCoordinate)
+                and only.mesh is None):
+            return self._fit_swept_random_effect(
+                cids[0], only, lams, validation_df, vocab, coordinates,
+                re_datasets)
+        if not (opt_ok and isinstance(only, FixedEffectCoordinate)
+                and not only._model_sharded):
             return self.fit(df, validation_df=validation_df,
                             configurations=[{cid: float(w) for cid in cids}
                                             for w in lams])
@@ -428,6 +437,38 @@ class GameEstimator:
                 tracker_summaries={cid: (
                     f"{int(iters[i])} iters, "
                     f"{ConvergenceReason(int(reasons[i])).name}")},
+            ))
+        self._vocab = vocab
+        self._re_datasets = re_datasets
+        self._coordinates = coordinates
+        return results
+
+    def _fit_swept_random_effect(self, cid, coord, lams, validation_df,
+                                 vocab, coordinates, re_datasets
+                                 ) -> List[GameResult]:
+        """The random-effect arm of :meth:`fit_swept`: all λ lanes of
+        the per-entity solves ride one swept program per lane-chunk
+        (bitwise-equal per lane to the sequential fits), then each
+        lane's model is validated through the ordinary scorer."""
+        models = coord.update_model_swept(None, None, lams)
+        validation_fn = None
+        if validation_df is not None:
+            scorer = self._build_scorer(validation_df, vocab, re_datasets)
+            validation_fn = self._validation_fn(scorer, validation_df)
+        results: List[GameResult] = []
+        for i, w in enumerate(lams):
+            gm = GameModel({cid: models[i]})
+            ev = validation_fn(gm) if validation_fn is not None else None
+            tracker = coord.last_lane_trackers[i]
+            results.append(GameResult(
+                model=gm,
+                config={cid: self.coordinate_configs[cid]
+                        .with_regularization_weight(float(w))},
+                evaluation=ev,
+                descent=CoordinateDescentResult(
+                    model=gm, best_model=gm,
+                    validation_history=[ev] if ev is not None else []),
+                tracker_summaries={cid: tracker.summary()},
             ))
         self._vocab = vocab
         self._re_datasets = re_datasets

@@ -41,7 +41,7 @@ from photon_tpu.optim.problem import (
     solver_cache_key,
 )
 from photon_tpu.types import OptimizerType, TaskType
-from photon_tpu.obs.spans import annotate as _obs_annotate
+from photon_tpu.obs.spans import annotate as _obs_annotate, span as _obs_span
 from photon_tpu.utils import jitcache
 
 Array = jax.Array
@@ -641,26 +641,23 @@ class RandomEffectCoordinate:
 
         return solve_sparse, solve_dense
 
-    @functools.cached_property
-    def _solve_fn(self):
-        self._validate_solver()
-        opt = self.config.optimizer
+    def _make_ladder_solver(self):
+        """The whole-ladder solve body, UNJITTED — the scalar program
+        (``_solve_fn``). The λ-lane program (``_solve_swept_fn``) shares
+        the per-entity solvers (``_make_entity_solvers``) and flattens
+        its lanes into this body's one entity-vmap axis, which is what
+        keeps every lane bitwise-equal to this scalar solve."""
         dense_flags = self._dense_local_blocks
-        has_norm = self._norm_local is not None
-        has_shifts = has_norm and self._norm_local[1] is not None
+        solve_sparse, solve_dense = self._make_entity_solvers()
 
-        def build():
-            solve_sparse, solve_dense = self._make_entity_solvers()
-
-            # the dataset enters as a pytree argument, never a closure (a
-            # closed-over array would be baked into the HLO as a constant);
-            # the Python loop over size buckets unrolls into one program
-            @jax.jit
-            def solve_all(ds: RandomEffectDataset, residual_flat: Optional[Array],
-                          coef0: Array, l2: Array, l1: Array,
-                          norm_f: Optional[Array] = None,
-                          norm_s: Optional[Array] = None,
-                          norm_islot: Optional[Array] = None):
+        # the dataset enters as a pytree argument, never a closure (a
+        # closed-over array would be baked into the HLO as a constant);
+        # the Python loop over size buckets unrolls into one program
+        def solve_all(ds: RandomEffectDataset, residual_flat: Optional[Array],
+                      coef0: Array, l2: Array, l1: Array,
+                      norm_f: Optional[Array] = None,
+                      norm_s: Optional[Array] = None,
+                      norm_islot: Optional[Array] = None):
                 out = coef0  # entities with no active data keep warm start
                 E = coef0.shape[0]
                 # per-entity solver stats (-1 = entity never trained)
@@ -707,9 +704,83 @@ class RandomEffectCoordinate:
                     fails = fails.at[blk.entity_rows].set(fail_b, mode="drop")
                 return out, iters, reasons, fails
 
-            return solve_all
+        return solve_all
+
+    @functools.cached_property
+    def _solve_fn(self):
+        self._validate_solver()
+        opt = self.config.optimizer
+        dense_flags = self._dense_local_blocks
+        has_norm = self._norm_local is not None
+        has_shifts = has_norm and self._norm_local[1] is not None
+
+        def build():
+            return jax.jit(self._make_ladder_solver())
 
         key = ("re_solve", self.task, solver_cache_key(opt),
+               has_norm, has_shifts, dense_flags)
+        return jitcache.get_or_build(key, build)
+
+    def _make_ladder_solver_swept(self):
+        """The whole-ladder λ-lane solve body, UNJITTED. Lanes are
+        FLATTENED into the entity axis per bucket (see
+        ``_make_block_solver_swept`` for why — it is the bitwise
+        contract), so per bucket the c lanes' virtual entities solve
+        under the scalar body's single entity-vmap against one shared
+        staging of the ladder, and results scatter back to the
+        ``[K, E_pad, ...]`` lane tables."""
+        dense_flags = self._dense_local_blocks
+        core_dense = self._make_block_solver_swept(True)
+        core_sparse = self._make_block_solver_swept(False)
+
+        def solve_all_lanes(ds: RandomEffectDataset,
+                            residual_flat: Optional[Array],
+                            coef0_lanes: Array, l2_lanes: Array,
+                            l1_lanes: Array,
+                            norm_f: Optional[Array] = None,
+                            norm_s: Optional[Array] = None,
+                            norm_islot: Optional[Array] = None):
+            out = coef0_lanes  # entities with no active data keep warm start
+            K, E = coef0_lanes.shape[0], coef0_lanes.shape[1]
+            iters = jnp.full((K, E), -1, jnp.int32)
+            reasons = jnp.full((K, E), -1, jnp.int32)
+            fails = jnp.zeros((K, E), jnp.int32)
+            for blk, dense in zip(ds.blocks, dense_flags):
+                x0 = coef0_lanes.at[:, blk.entity_rows].get(
+                    mode="fill", fill_value=0.0)
+                core = core_dense if dense else core_sparse
+                solved, it_b, reason_b, fail_b = core(
+                    blk, residual_flat, x0, l2_lanes, l1_lanes,
+                    norm_f, norm_s, norm_islot)
+                out = out.at[:, blk.entity_rows].set(solved, mode="drop")
+                iters = iters.at[:, blk.entity_rows].set(it_b, mode="drop")
+                reasons = reasons.at[:, blk.entity_rows].set(
+                    reason_b, mode="drop")
+                fails = fails.at[:, blk.entity_rows].set(fail_b, mode="drop")
+            return out, iters, reasons, fails
+
+        return solve_all_lanes
+
+    @functools.cached_property
+    def _solve_swept_fn(self):
+        """λ-lane variant of ``_solve_fn``: c lanes of
+        ``(coef0 [c, E, d], l2 [c], l1 [c])`` solved in one program per
+        lane-chunk width, reading the bucket ladder's data once for all
+        lanes (the dataset stays a shared jit argument — the
+        ``minimize_lanes`` data-pass economics applied to the per-entity
+        vmap). Per-entity failure isolation carries over per lane, and
+        EVERY lane — not just K=1 — is bitwise its scalar solve (see
+        ``_make_block_solver_swept``)."""
+        self._validate_solver()
+        opt = self.config.optimizer
+        dense_flags = self._dense_local_blocks
+        has_norm = self._norm_local is not None
+        has_shifts = has_norm and self._norm_local[1] is not None
+
+        def build():
+            return jax.jit(self._make_ladder_solver_swept())
+
+        key = ("re_solve_swept", self.task, solver_cache_key(opt),
                has_norm, has_shifts, dense_flags)
         return jitcache.get_or_build(key, build)
 
@@ -776,6 +847,190 @@ class RandomEffectCoordinate:
             variances=variances,
         )
 
+    def update_model_swept(
+        self,
+        prev: Optional[RandomEffectModel],
+        residual_scores: Optional[Array],
+        weights,
+        *,
+        initial_lanes=None,
+        plan=None,
+        hbm_budget_bytes: Optional[int] = None,
+    ):
+        """Fit the whole regularization grid ``weights`` over the entity
+        ladder as lane-batched programs — K λ points in ONE data pass
+        over every bucket, instead of K sequential ``update_model``
+        calls (the random-effect half of the PR 15 sweep machinery).
+
+        The K per-entity theta tables stack to ``[K, E, d]`` and the
+        existing entity-vmap body batches over (entity-lane × λ-lane);
+        per-entity failure isolation carries over per lane, and K=1 is
+        bitwise ``update_model``. Device footprint is governed by a
+        ``parallel/memory.BlockPlan`` (computed here unless ``plan`` is
+        passed; budget from the backend unless ``hbm_budget_bytes``
+        overrides): when the full-K stack exceeds the budget the grid
+        degrades to ⌈K/c⌉ chunked passes — typed in the plan, recorded
+        in the RunReport ``re_plan`` section, never a runtime OOM.
+        Chunking never changes results (each chunk is the same
+        lane-vmapped program at width c).
+
+        ``initial_lanes [K, E, d]`` warm-starts each lane independently;
+        otherwise every lane starts from ``prev``'s coefficients.
+        Returns a list of K :class:`RandomEffectModel`s (variances are
+        not computed on the sweep path); per-lane telemetry lands in
+        ``last_lane_trackers`` / ``last_lane_failed_entities`` /
+        ``last_lane_failures`` and the ``sweep.*`` metrics."""
+        from photon_tpu.obs.metrics import registry
+        from photon_tpu.optim import batched
+        from photon_tpu.parallel import memory as hbm
+
+        lams = batched.validate_lane_weights(weights)
+        K = int(lams.size)
+        ds = self.dataset
+        dtype = (prev.coefficients.dtype if prev is not None
+                 else (ds.blocks[0].labels.dtype if ds.blocks
+                       else jnp.float32))
+        base = (prev.coefficients if prev is not None
+                else jnp.zeros((ds.num_entities, ds.projected_dim), dtype))
+        base = self._pad_entity_rows(jnp.asarray(base, dtype))
+        if initial_lanes is not None:
+            init = jnp.asarray(initial_lanes, dtype)
+            if init.ndim != 3 or init.shape[0] != K:
+                raise ValueError(
+                    f"initial_lanes must be [K={K}, E, d], got "
+                    f"{init.shape}")
+            lanes0 = jnp.stack(
+                [self._pad_entity_rows(init[k]) for k in range(K)])
+        else:
+            lanes0 = jnp.broadcast_to(base, (K,) + base.shape)
+        if plan is None:
+            plan = hbm.plan_for_dataset(
+                ds, lanes=K,
+                history=self.config.optimizer.solver_config()
+                .num_corrections,
+                hbm_budget_bytes=hbm_budget_bytes,
+                coordinate=self.random_effect_type)
+        hbm.record_plan(plan)
+        self.last_block_plan = plan
+        chunk = max(1, min(plan.lane_chunk, K))
+        reg = self.config.regularization
+        norm_args = ()
+        if self._norm_local is not None:
+            f, s, islot = self._norm_local
+            norm_args = (f,) if s is None else (f, s, islot)
+        if getattr(self, "_chaos_poison_once", False):
+            # fault injection (resilience/chaos.py): poisons every lane's
+            # shared residual, like a corrupt upstream score pass
+            self._chaos_poison_once = False
+            residual_scores = jnp.full((self.n,), jnp.nan, dtype)
+        coefs: list = [None] * K
+        iters: list = [None] * K
+        reasons: list = [None] * K
+        fails: list = [None] * K
+        for idx, n_real in batched.pad_lane_grid(lams, chunk):
+            l2c = jnp.asarray([reg.l2_weight(float(lams[i])) for i in idx],
+                              dtype)
+            l1c = jnp.asarray([reg.l1_weight(float(lams[i])) for i in idx],
+                              dtype)
+            x0c = jnp.take(lanes0, jnp.asarray(idx), axis=0)
+            with _obs_annotate("re/solve_swept"):
+                co, it_c, re_c, fa_c = self._solve_swept_fn(
+                    ds, residual_scores, x0c, l2c, l1c, *norm_args)
+            # padded tail lanes (repeated last λ) are dropped, never
+            # published
+            for j in range(n_real):
+                k = int(idx[j])
+                coefs[k], iters[k] = co[j], it_c[j]
+                reasons[k], fails[k] = re_c[j], fa_c[j]
+        # host boundary: per-lane scalars for telemetry + failure typing
+        from photon_tpu.optim.tracking import RandomEffectOptimizationTracker
+        e_orig = self._num_entities_orig
+        self.last_lane_trackers = [
+            RandomEffectOptimizationTracker(iterations=iters[k][:e_orig],
+                                            reasons=reasons[k][:e_orig])
+            for k in range(K)]
+        fails_np = [np.asarray(fails[k][:e_orig]) for k in range(K)]
+        self.last_lane_failed_entities = [
+            int(np.sum(f != 0)) for f in fails_np]
+        self.last_lane_failures = []
+        lane_medians = []
+        for k in range(K):
+            n_failed = self.last_lane_failed_entities[k]
+            self.last_lane_failures.append(
+                FailureMode(int(fails_np[k].max()))
+                if n_failed and e_orig and n_failed == e_orig else None)
+            it_np = np.asarray(iters[k][:e_orig])
+            trained = it_np[it_np >= 0]
+            lane_medians.append(
+                float(np.median(trained)) if trained.size else 0.0)
+        registry.gauge("sweep.lanes_active").set(
+            sum(1 for lf in self.last_lane_failures if lf is None))
+        hist = registry.histogram(
+            "sweep.lane_iterations",
+            buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500))
+        for med in lane_medians:
+            hist.observe(med)
+        batched.record_sweep_run([
+            {"weight": float(lams[k]),
+             "entities_failed": self.last_lane_failed_entities[k],
+             "iterations": lane_medians[k],
+             "failure": 0 if self.last_lane_failures[k] is None
+             else int(self.last_lane_failures[k])}
+            for k in range(K)])
+        return [
+            RandomEffectModel(
+                coefficients=coefs[k][:e_orig],
+                random_effect_type=self.random_effect_type,
+                feature_shard_id=self.feature_shard_id,
+                task=self.task,
+                variances=None,
+            )
+            for k in range(K)
+        ]
+
+    def _make_block_solver(self, dense: bool):
+        """One size bucket's solve body, UNJITTED — the scalar blocked
+        program (``_block_solve_fn``). The λ-lane blocked program
+        (``_block_solve_swept_fn``) shares the per-entity solvers and
+        the exact vmap structure via ``_make_block_solver_swept``."""
+        solve_sparse, solve_dense = self._make_entity_solvers()
+
+        def solve_block(blk: EntityBlock, residual_flat: Optional[Array],
+                        x0: Array, l2: Array, l1: Array,
+                        norm_f: Optional[Array] = None,
+                        norm_s: Optional[Array] = None,
+                        norm_islot: Optional[Array] = None):
+            offsets = blk.offsets
+            if residual_flat is not None:
+                offsets = offsets + residual_flat.at[blk.sample_rows].get(
+                    mode="fill", fill_value=0.0)
+            if dense:
+                fn = solve_dense
+                args = [blk.features.values,
+                        blk.labels, offsets, blk.weights, x0, l2, l1]
+                axes = [0, 0, 0, 0, 0, None, None]
+            else:
+                fn = solve_sparse
+                args = [blk.features.indices, blk.features.values,
+                        blk.labels, offsets, blk.weights, x0, l2, l1]
+                axes = [0, 0, 0, 0, 0, 0, None, None]
+            if norm_f is not None:
+                args.append(norm_f.at[blk.entity_rows].get(
+                    mode="fill", fill_value=1.0))
+                axes.append(0)
+                if norm_s is not None:
+                    args.append(norm_s.at[blk.entity_rows].get(
+                        mode="fill", fill_value=0.0))
+                    args.append(norm_islot.at[blk.entity_rows].get(
+                        mode="fill", fill_value=-1))
+                    axes.extend([0, 0])
+            solved, it_b, reason_b, fail_b = jax.vmap(
+                fn, in_axes=tuple(axes))(*args)
+            solved = jnp.where((fail_b != 0)[:, None], x0, solved)
+            return solved, it_b, reason_b, fail_b
+
+        return solve_block
+
     def _block_solve_fn(self, dense: bool):
         """One size bucket's per-entity solves as a standalone program —
         the streaming unit of ``update_model_blocked``. Two cached
@@ -787,46 +1042,99 @@ class RandomEffectCoordinate:
         has_shifts = has_norm and self._norm_local[1] is not None
 
         def build():
-            solve_sparse, solve_dense = self._make_entity_solvers()
-
-            @jax.jit
-            def solve_block(blk: EntityBlock, residual_flat: Optional[Array],
-                            x0: Array, l2: Array, l1: Array,
-                            norm_f: Optional[Array] = None,
-                            norm_s: Optional[Array] = None,
-                            norm_islot: Optional[Array] = None):
-                offsets = blk.offsets
-                if residual_flat is not None:
-                    offsets = offsets + residual_flat.at[blk.sample_rows].get(
-                        mode="fill", fill_value=0.0)
-                if dense:
-                    fn = solve_dense
-                    args = [blk.features.values,
-                            blk.labels, offsets, blk.weights, x0, l2, l1]
-                    axes = [0, 0, 0, 0, 0, None, None]
-                else:
-                    fn = solve_sparse
-                    args = [blk.features.indices, blk.features.values,
-                            blk.labels, offsets, blk.weights, x0, l2, l1]
-                    axes = [0, 0, 0, 0, 0, 0, None, None]
-                if norm_f is not None:
-                    args.append(norm_f.at[blk.entity_rows].get(
-                        mode="fill", fill_value=1.0))
-                    axes.append(0)
-                    if norm_s is not None:
-                        args.append(norm_s.at[blk.entity_rows].get(
-                            mode="fill", fill_value=0.0))
-                        args.append(norm_islot.at[blk.entity_rows].get(
-                            mode="fill", fill_value=-1))
-                        axes.extend([0, 0])
-                solved, it_b, reason_b, fail_b = jax.vmap(
-                    fn, in_axes=tuple(axes))(*args)
-                solved = jnp.where((fail_b != 0)[:, None], x0, solved)
-                return solved, it_b, reason_b, fail_b
-
-            return solve_block
+            return jax.jit(self._make_block_solver(dense))
 
         key = ("re_solve_block", self.task, solver_cache_key(opt),
+               has_norm, has_shifts, bool(dense))
+        return jitcache.get_or_build(key, build)
+
+    def _make_block_solver_swept(self, dense: bool):
+        """One size bucket's λ-lane solve body, UNJITTED — the c lanes
+        are FLATTENED into the entity axis: the bucket's arrays are
+        tiled c× inside the program (lane-major virtual entities) and
+        the per-entity solver is vmapped over ONE ``c*E``-wide batch
+        axis, exactly the scalar body's vmap structure.
+
+        Flattening — not a nested ``vmap`` over lanes — is the bitwise
+        contract. The entity-vmap is width-insensitive on every backend
+        we pin (solving a tiled ``2E`` batch reproduces the ``E`` batch
+        bit-for-bit), but NESTING a second vmap re-lowers the batched
+        reductions with an extra batch dimension and reassociates their
+        FP order: lane results then drift ~1e-9 from the scalar solve at
+        f64, and a lane sitting at a convergence-threshold knife edge
+        (observed at strong regularization) splits its ITERATION COUNT.
+        With flattening, every lane of every chunk width — padded tails
+        included — is bitwise-equal to its sequential scalar solve.
+
+        The tile costs ``c×`` block data on device; parallel/memory's
+        planner charges each lane ``data + lane_state`` bytes and chunks
+        the grid when the budget can't carry full K. The block still
+        STAGES once — tiling is a device-side op, so storage→device
+        traffic stays one pass per bucket regardless of K."""
+        solve_sparse, solve_dense = self._make_entity_solvers()
+
+        def solve_block_lanes(blk: EntityBlock,
+                              residual_flat: Optional[Array],
+                              x0_lanes: Array, l2_lanes: Array,
+                              l1_lanes: Array,
+                              norm_f: Optional[Array] = None,
+                              norm_s: Optional[Array] = None,
+                              norm_islot: Optional[Array] = None):
+            c, E = x0_lanes.shape[0], x0_lanes.shape[1]
+            tile = ((lambda a: jnp.concatenate([a] * c, axis=0)) if c > 1
+                    else (lambda a: a))
+            offsets = blk.offsets
+            if residual_flat is not None:
+                offsets = offsets + residual_flat.at[blk.sample_rows].get(
+                    mode="fill", fill_value=0.0)
+            x0 = x0_lanes.reshape((c * E,) + x0_lanes.shape[2:])
+            l2e = jnp.repeat(l2_lanes, E)
+            l1e = jnp.repeat(l1_lanes, E)
+            if dense:
+                fn = solve_dense
+                args = [tile(blk.features.values), tile(blk.labels),
+                        tile(offsets), tile(blk.weights), x0, l2e, l1e]
+            else:
+                fn = solve_sparse
+                args = [tile(blk.features.indices),
+                        tile(blk.features.values), tile(blk.labels),
+                        tile(offsets), tile(blk.weights), x0, l2e, l1e]
+            if norm_f is not None:
+                args.append(tile(norm_f.at[blk.entity_rows].get(
+                    mode="fill", fill_value=1.0)))
+                if norm_s is not None:
+                    args.append(tile(norm_s.at[blk.entity_rows].get(
+                        mode="fill", fill_value=0.0)))
+                    args.append(tile(norm_islot.at[blk.entity_rows].get(
+                        mode="fill", fill_value=-1)))
+            solved, it_b, reason_b, fail_b = jax.vmap(fn)(*args)
+            # per-entity isolation, per lane: a failed virtual entity
+            # keeps its lane's warm start
+            solved = jnp.where((fail_b != 0)[:, None], x0, solved)
+
+            def unflatten(a):
+                return a.reshape((c, E) + a.shape[1:])
+
+            return (unflatten(solved), unflatten(it_b),
+                    unflatten(reason_b), unflatten(fail_b))
+
+        return solve_block_lanes
+
+    def _block_solve_swept_fn(self, dense: bool):
+        """λ-lane variant of ``_block_solve_fn``: one program per
+        (bucket flavor, lane-chunk width) solving c λ points against ONE
+        staging of the bucket (the tile to ``c*E`` virtual entities is a
+        device-side op inside the program). Every lane is bitwise the
+        scalar blocked program (see ``_make_block_solver_swept``)."""
+        self._validate_solver()
+        opt = self.config.optimizer
+        has_norm = self._norm_local is not None
+        has_shifts = has_norm and self._norm_local[1] is not None
+
+        def build():
+            return jax.jit(self._make_block_solver_swept(dense))
+
+        key = ("re_solve_block_swept", self.task, solver_cache_key(opt),
                has_norm, has_shifts, bool(dense))
         return jitcache.get_or_build(key, build)
 
@@ -838,6 +1146,7 @@ class RandomEffectCoordinate:
         entity_names: Optional[Tuple[str, ...]] = None,
         start_block: int = 0,
         on_block=None,
+        prefetch: bool = True,
     ) -> RandomEffectModel:
         """Larger-than-HBM training: sequential per-bucket solves with the
         coefficient table resident in HOST RAM, warm starts streamed from
@@ -860,7 +1169,15 @@ class RandomEffectCoordinate:
         preempted run must pass the checkpointed coefficients (schema v4
         records the cursor per coordinate; game/checkpoint.py).
         ``on_block(next_block, num_blocks)`` fires after each bucket —
-        the checkpoint hook."""
+        the checkpoint hook — OUTSIDE the per-bucket solve span, so
+        checkpoint I/O never pollutes ``re/solve_block`` phase timings.
+
+        With ``prefetch`` (default), a reader thread
+        (game/block_stream.BlockPrefetcher) stages bucket b+1 while
+        bucket b solves — staging order, solve math, and the v4 cursor
+        contract are unchanged (results stay bitwise with
+        ``prefetch=False``); overlap telemetry lands in
+        ``last_block_overlap`` / the ``perf.re_block_overlap`` gauge."""
         ds = self.dataset
         n_blocks = len(ds.blocks)
         if not 0 <= start_block <= n_blocks:
@@ -902,26 +1219,67 @@ class RandomEffectCoordinate:
         iters = np.full((E_pad,), -1, np.int32)
         reasons = np.full((E_pad,), -1, np.int32)
         fails = np.zeros((E_pad,), np.int32)
-        for bi, (blk, dense) in enumerate(
-                zip(ds.blocks, self._dense_local_blocks)):
-            if bi < start_block:
-                continue
-            ents = np.asarray(blk.entity_rows)
-            valid = (ents >= 0) & (ents < E_pad)
-            x0 = np.zeros((ents.shape[0], K), dtype)
-            x0[valid] = out[ents[valid]]
-            with _obs_annotate("re/solve_block"):
-                solved, it_b, reason_b, fail_b = self._block_solve_fn(dense)(
-                    blk, residual_scores, jnp.asarray(x0), l2, l1,
-                    *norm_args)
-            # the sequential host round-trip IS the design here: device
-            # peak memory stays one bucket, results land in host RAM
-            out[ents[valid]] = np.asarray(solved)[valid]
-            iters[ents[valid]] = np.asarray(it_b)[valid]
-            reasons[ents[valid]] = np.asarray(reason_b)[valid]
-            fails[ents[valid]] = np.asarray(fail_b)[valid]
-            if on_block is not None:
-                on_block(bi + 1, n_blocks)
+        from photon_tpu.game.block_stream import BlockPrefetcher
+        from photon_tpu.resilience import chaos
+        stream = None
+        if prefetch and n_blocks - start_block > 1:
+            stream = BlockPrefetcher(ds.blocks, start_block=start_block)
+        try:
+            with _obs_span("re/solve_blocked",
+                           blocks=n_blocks - start_block):
+                for bi, (blk, dense) in enumerate(
+                        zip(ds.blocks, self._dense_local_blocks)):
+                    if bi < start_block:
+                        continue
+                    ents = np.asarray(blk.entity_rows)
+                    valid = (ents >= 0) & (ents < E_pad)
+                    x0 = np.zeros((ents.shape[0], K), dtype)
+                    x0[valid] = out[ents[valid]]
+                    # bucket b+1 is already staging on the reader thread
+                    # while this bucket solves; values are identical to
+                    # the unstaged block, so parity stays bitwise
+                    staged = stream.get(bi) if stream is not None else blk
+                    with _obs_span("re/solve_block", block=bi):
+                        with _obs_annotate("re/solve_block"):
+                            solved, it_b, reason_b, fail_b = \
+                                self._block_solve_fn(dense)(
+                                    staged, residual_scores,
+                                    jnp.asarray(x0), l2, l1, *norm_args)
+                        # the per-bucket host round-trip IS the design
+                        # here: device peak memory stays one staged
+                        # bucket (+ one in flight), results land in
+                        # host RAM
+                        out[ents[valid]] = np.asarray(solved)[valid]
+                        iters[ents[valid]] = np.asarray(it_b)[valid]
+                        reasons[ents[valid]] = np.asarray(reason_b)[valid]
+                        fails[ents[valid]] = np.asarray(fail_b)[valid]
+                    if stream is not None:
+                        # results are on the host: the staged buffer is
+                        # consumed — return its token to the reader
+                        stream.release()
+                    if on_block is not None:
+                        on_block(bi + 1, n_blocks)
+                    if chaos.should_kill_re_block(bi):
+                        # after on_block: the cursor is durable, resume
+                        # must be bitwise (the v4 contract)
+                        raise chaos.SimulatedKill(
+                            f"chaos: killed after re block {bi} "
+                            f"checkpoint")
+        finally:
+            if stream is not None:
+                stream.close()
+        self.last_block_overlap = None
+        # storage->device data passes this run (the bench's accounting
+        # unit): one staging per bucket whether prefetched or inline
+        self.last_blocks_staged = (stream.blocks_staged
+                                   if stream is not None
+                                   else n_blocks - start_block)
+        if stream is not None:
+            from photon_tpu.utils import flops
+            self.last_block_overlap = flops.re_block_overlap(
+                stream.reader_busy_s, stream.consumer_stall_s,
+                stream.wall_s, stream.bytes_staged,
+                coordinate=self.random_effect_type)
         from photon_tpu.optim.tracking import RandomEffectOptimizationTracker
         e_orig = self._num_entities_orig
         self.last_tracker = RandomEffectOptimizationTracker(
@@ -941,6 +1299,236 @@ class RandomEffectCoordinate:
             task=self.task,
             variances=None,
         )
+
+    def update_model_blocked_swept(
+        self,
+        residual_scores: Optional[Array],
+        weights,
+        *,
+        warm_start=None,
+        entity_names: Optional[Tuple[str, ...]] = None,
+        start_block: int = 0,
+        on_block=None,
+        plan=None,
+        hbm_budget_bytes: Optional[int] = None,
+        prefetch: bool = True,
+    ):
+        """``update_model_blocked`` × λ lanes: the K coefficient tables
+        live in HOST RAM as ``[K, E, d]`` while each staged bucket is
+        solved for all K λ points — one storage→device staging per
+        bucket for the whole grid (the sequential sweep staged every
+        bucket K times). Per-bucket lane chunking follows the
+        ``parallel/memory`` plan: a bucket whose full-K lane stack
+        exceeds the budget re-solves the SAME staged copy in ⌈K/c⌉
+        compute passes, so degradation costs FLOPs dispatches, never
+        extra staging traffic, and never changes results.
+
+        ``warm_start``: ``None`` (zeros), ``[E, d]`` (broadcast to all
+        lanes), ``[K, E, d]`` (per-lane — the resume shape), or a
+        ``ColdStore`` (broadcast; requires ``entity_names``). The
+        ``start_block`` cursor and ``on_block(next_block, num_blocks)``
+        hook keep the v4 ``re_block_cursor`` contract — kill after
+        bucket b's hook, resume at ``start_block=b+1`` with the
+        checkpointed ``[K, E, d]`` table, and the result is bitwise.
+        Returns a list of K :class:`RandomEffectModel`s (host-resident
+        coefficients, like ``update_model_blocked``); the plan and
+        per-bucket planned-vs-measured footprints land in
+        ``last_block_plan`` / ``last_block_measured`` and the
+        ``perf.re_peak_hbm_bytes`` gauges."""
+        from photon_tpu.game import block_stream
+        from photon_tpu.optim import batched
+        from photon_tpu.parallel import memory as hbm
+        from photon_tpu.resilience import chaos
+        from photon_tpu.utils import flops
+
+        lams = batched.validate_lane_weights(weights)
+        K_lanes = int(lams.size)
+        ds = self.dataset
+        n_blocks = len(ds.blocks)
+        if not 0 <= start_block <= n_blocks:
+            raise ValueError(
+                f"start_block {start_block} outside [0, {n_blocks}]")
+        E_pad = ds.num_entities
+        D = ds.projected_dim
+        dtype = np.dtype(ds.blocks[0].labels.dtype) if ds.blocks \
+            else np.dtype(np.float32)
+        # K host-resident coefficient tables
+        if warm_start is None:
+            out = np.zeros((K_lanes, E_pad, D), dtype)
+        elif isinstance(warm_start, np.ndarray) or isinstance(
+                warm_start, jax.Array):
+            w = np.asarray(warm_start, dtype)
+            out = np.zeros((K_lanes, E_pad, D), dtype)
+            if w.ndim == 2:
+                out[:, : min(E_pad, w.shape[0])] = w[None, :E_pad]
+            elif w.ndim == 3:
+                if w.shape[0] != K_lanes:
+                    raise ValueError(
+                        f"per-lane warm_start must be [K={K_lanes}, E, d], "
+                        f"got {w.shape}")
+                out[:, : min(E_pad, w.shape[1])] = w[:, :E_pad]
+            else:
+                raise ValueError(
+                    f"warm_start must be [E, d] or [K, E, d], got "
+                    f"{w.shape}")
+        else:  # ColdStore, broadcast to every lane
+            if entity_names is None:
+                raise ValueError(
+                    "ColdStore warm_start requires entity_names (entity id "
+                    "per dataset row, vocabulary order)")
+            from photon_tpu.game.random_effect import (
+                warm_start_from_cold_store,
+            )
+            w = warm_start_from_cold_store(
+                warm_start, entity_names, ds.projection).astype(dtype)
+            extra = E_pad - w.shape[0]
+            if extra > 0:
+                w = np.pad(w, [(0, extra), (0, 0)])
+            out = np.repeat(w[None, :E_pad], K_lanes, axis=0)
+        if plan is None:
+            plan = hbm.plan_for_dataset(
+                ds, lanes=K_lanes,
+                history=self.config.optimizer.solver_config()
+                .num_corrections,
+                hbm_budget_bytes=hbm_budget_bytes,
+                coordinate=self.random_effect_type)
+        hbm.record_plan(plan)
+        self.last_block_plan = plan
+        reg = self.config.regularization
+        l2_all = np.asarray([reg.l2_weight(float(w)) for w in lams], dtype)
+        l1_all = np.asarray([reg.l1_weight(float(w)) for w in lams], dtype)
+        norm_args = ()
+        if self._norm_local is not None:
+            f, s, islot = self._norm_local
+            norm_args = (f,) if s is None else (f, s, islot)
+        iters = np.full((K_lanes, E_pad), -1, np.int32)
+        reasons = np.full((K_lanes, E_pad), -1, np.int32)
+        fails = np.zeros((K_lanes, E_pad), np.int32)
+        measured: list = []
+        stream = None
+        if prefetch and n_blocks - start_block > 1:
+            stream = block_stream.BlockPrefetcher(
+                ds.blocks, start_block=start_block)
+        try:
+            with _obs_span("re/solve_blocked",
+                           blocks=n_blocks - start_block, lanes=K_lanes):
+                for bi, (blk, dense) in enumerate(
+                        zip(ds.blocks, self._dense_local_blocks)):
+                    if bi < start_block:
+                        continue
+                    bplan = plan.buckets[bi] if bi < len(plan.buckets) \
+                        else None
+                    chunk = max(1, min(
+                        bplan.lane_chunk if bplan is not None else K_lanes,
+                        K_lanes))
+                    ents = np.asarray(blk.entity_rows)
+                    valid = (ents >= 0) & (ents < E_pad)
+                    staged = stream.get(bi) if stream is not None else blk
+                    bucket_peak = 0
+                    with _obs_span("re/solve_block", block=bi):
+                        for idx, n_real in batched.pad_lane_grid(
+                                lams, chunk):
+                            x0 = np.zeros(
+                                (idx.size, ents.shape[0], D), dtype)
+                            for j, k in enumerate(idx):
+                                x0[j, valid] = out[k][ents[valid]]
+                            x0j = jnp.asarray(x0)
+                            l2c = jnp.asarray(l2_all[idx])
+                            l1c = jnp.asarray(l1_all[idx])
+                            with _obs_annotate("re/solve_block_swept"):
+                                solved, it_b, reason_b, fail_b = \
+                                    self._block_solve_swept_fn(dense)(
+                                        staged, residual_scores, x0j,
+                                        l2c, l1c, *norm_args)
+                            solved_np = np.asarray(solved)
+                            it_np = np.asarray(it_b)
+                            re_np = np.asarray(reason_b)
+                            fa_np = np.asarray(fail_b)
+                            # padded tail lanes (repeated last λ) are
+                            # dropped, never written back
+                            for j in range(n_real):
+                                k = int(idx[j])
+                                out[k][ents[valid]] = solved_np[j][valid]
+                                iters[k][ents[valid]] = it_np[j][valid]
+                                reasons[k][ents[valid]] = re_np[j][valid]
+                                fails[k][ents[valid]] = fa_np[j][valid]
+                            # staging copies + the c×-tiled batch the
+                            # flattened-lane program materializes
+                            sb = block_stream.staged_bytes(staged)
+                            tiled = sb * idx.size if idx.size > 1 else 0
+                            bucket_peak = max(
+                                bucket_peak,
+                                sb * (2 if stream is not None else 1)
+                                + tiled
+                                + int(x0j.nbytes) + int(solved_np.nbytes))
+                    measured.append({
+                        "bucket": bi,
+                        "lane_chunk": chunk,
+                        "strategy": bplan.strategy if bplan is not None
+                        else hbm.STRATEGY_FULL,
+                        "planned_peak_bytes": bplan.peak_bytes
+                        if bplan is not None else 0,
+                        "measured_peak_bytes": int(bucket_peak),
+                    })
+                    if stream is not None:
+                        stream.release()
+                    if on_block is not None:
+                        # checkpoint hook OUTSIDE the timed solve span
+                        on_block(bi + 1, n_blocks)
+                    if chaos.should_kill_re_block(bi):
+                        raise chaos.SimulatedKill(
+                            f"chaos: killed after re block {bi} "
+                            f"checkpoint")
+        finally:
+            if stream is not None:
+                stream.close()
+        self.last_block_measured = measured
+        if measured:
+            flops.re_peak_hbm(
+                self.random_effect_type,
+                max(m["planned_peak_bytes"] for m in measured),
+                max(m["measured_peak_bytes"] for m in measured))
+        self.last_block_overlap = None
+        # one staging per bucket serves EVERY lane chunk — this is the
+        # (1/K)-data-passes economics the bench records
+        self.last_blocks_staged = (stream.blocks_staged
+                                   if stream is not None
+                                   else n_blocks - start_block)
+        if stream is not None:
+            self.last_block_overlap = flops.re_block_overlap(
+                stream.reader_busy_s, stream.consumer_stall_s,
+                stream.wall_s, stream.bytes_staged,
+                coordinate=self.random_effect_type)
+        # host boundary: per-lane telemetry + failure typing
+        from photon_tpu.optim.tracking import RandomEffectOptimizationTracker
+        e_orig = self._num_entities_orig
+        self.last_lane_trackers = [
+            RandomEffectOptimizationTracker(iterations=iters[k][:e_orig],
+                                            reasons=reasons[k][:e_orig])
+            for k in range(K_lanes)]
+        self.last_lane_failed_entities = [
+            int(np.sum(fails[k][:e_orig] != 0)) for k in range(K_lanes)]
+        self.last_lane_failures = [
+            FailureMode(int(fails[k][:e_orig].max()))
+            if self.last_lane_failed_entities[k] and e_orig
+            and self.last_lane_failed_entities[k] == e_orig else None
+            for k in range(K_lanes)]
+        batched.record_sweep_run([
+            {"weight": float(lams[k]),
+             "entities_failed": self.last_lane_failed_entities[k],
+             "failure": 0 if self.last_lane_failures[k] is None
+             else int(self.last_lane_failures[k])}
+            for k in range(K_lanes)])
+        return [
+            RandomEffectModel(
+                coefficients=out[k][:e_orig],
+                random_effect_type=self.random_effect_type,
+                feature_shard_id=self.feature_shard_id,
+                task=self.task,
+                variances=None,
+            )
+            for k in range(K_lanes)
+        ]
 
     @functools.cached_property
     def _variance_fn(self):

@@ -97,6 +97,9 @@ def build_run_report(driver: str,
     sdca = _sdca_section()
     if sdca is not None:
         report["sdca"] = sdca
+    re_plan = _re_plan_section()
+    if re_plan is not None:
+        report["re_plan"] = re_plan
     if extra:
         report["extra"] = extra
     return report
@@ -155,6 +158,21 @@ def _sweep_section() -> Optional[Dict[str, Any]]:
         section = mod.report_section()
         # an imported-but-idle batched module stays out of the report
         return section if section.get("runs") else None
+    except Exception:  # noqa: BLE001 — reporting must not kill a run
+        return None
+
+
+def _re_plan_section() -> Optional[Dict[str, Any]]:
+    """Random-effect sweep HBM planning (plans emitted, degraded /
+    over-budget bucket counts, the last plan) — a refused or degraded
+    sweep shape is DATA in the report, not a crash. Same ``sys.modules``
+    pattern as :func:`_serving_section`; the section itself returns None
+    while no sweep has been planned."""
+    mod = sys.modules.get("photon_tpu.parallel.memory")
+    if mod is None:
+        return None
+    try:
+        return mod.report_section()
     except Exception:  # noqa: BLE001 — reporting must not kill a run
         return None
 
@@ -310,6 +328,15 @@ def validate_run_report(report: Dict[str, Any]) -> List[str]:
             for k in ("runs", "epochs", "fallbacks", "converged"):
                 if k not in sdca:
                     errors.append(f"sdca missing {k!r}")
+    if "re_plan" in report:  # optional: only RE-sweep planning processes
+        re_plan = report["re_plan"]
+        if not isinstance(re_plan, dict):
+            errors.append("re_plan must be a dict")
+        else:
+            for k in ("plans", "buckets_degraded", "buckets_over_budget",
+                      "last_plan"):
+                if k not in re_plan:
+                    errors.append(f"re_plan missing {k!r}")
     if "cd" in report:  # optional: only parallel-CD training processes
         cd = report["cd"]
         if not isinstance(cd, dict) or not isinstance(

@@ -89,6 +89,32 @@ def validate_lane_weights(weights: Sequence[float],
     return arr
 
 
+def pad_lane_grid(weights: Sequence[float],
+                  chunk: int) -> List[Tuple[np.ndarray, int]]:
+    """Split a K-point λ grid into ⌈K/c⌉ fixed-shape lane chunks for the
+    planner's chunked-lanes degradation (parallel/memory.BlockPlan).
+
+    Returns ``[(lane_indices [c], n_real), ...]`` where ``lane_indices``
+    index into the validated grid. Every chunk has EXACTLY ``c`` lanes —
+    the tail is padded by repeating its last index, so one compiled
+    program per (bucket, c) shape serves the whole grid; callers write
+    back only the first ``n_real`` lanes of each chunk's results (the
+    padded duplicates are dropped, never published).
+    """
+    arr = validate_lane_weights(weights)
+    k = int(arr.size)
+    c = max(1, min(int(chunk), k))
+    out: List[Tuple[np.ndarray, int]] = []
+    for lo in range(0, k, c):
+        idx = np.arange(lo, min(lo + c, k), dtype=np.int64)
+        n_real = int(idx.size)
+        if n_real < c:
+            idx = np.concatenate(
+                [idx, np.full((c - n_real,), idx[-1], np.int64)])
+        out.append((idx, n_real))
+    return out
+
+
 def minimize_lanes(value_and_gradient: LaneValueAndGradient,
                    x0_lanes: Array,
                    *,

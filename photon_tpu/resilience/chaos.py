@@ -53,6 +53,18 @@ hook points consult it:
   at the configured ``stream_kill_at`` writes the chunk-cursor
   checkpoint and raises ``SimulatedKill`` (fires once), the mid-epoch
   preemption the bitwise-resume test replays.
+- ``re_block_read_delay()`` / ``re_block_read_error()`` — the blocked
+  random-effect trainer's prefetch thread asks before staging each
+  entity bucket; the delay simulates a slow cold-tier / host-RAM read
+  while bucket b solves (overlap, not stall), the error raises
+  ``ChaosIOError`` for the first ``re_block_read_errors`` stagings
+  (retried under the ``resilience/retry`` budget).
+- ``should_kill_re_block(block_idx)`` — the blocked random-effect
+  trainer asks after each bucket's checkpoint hook (``on_block``) has
+  fired; a hit at the configured ``re_block_kill_at`` raises
+  ``SimulatedKill`` (fires once) — the durable v4 ``re_block_cursor``
+  plus the checkpointed table must resume bitwise, including with K>1
+  λ lanes.
 - ``should_kill_convert(unit_idx)`` — io/data_store.py's writer asks
   after fsyncing each input unit's section bytes, BEFORE advancing the
   conversion cursor; a hit raises ``SimulatedKill`` at that harshest
@@ -157,6 +169,20 @@ class ChaosConfig:
     # streamed solver: (pass index, chunk index) after whose accumulation
     # the consumer checkpoints its chunk cursor and dies (fires once)
     stream_kill_at: Optional[Tuple[int, int]] = None
+    # blocked random-effect training: seconds of injected entity-block
+    # staging latency, applied on the prefetch thread to the first
+    # re_block_read_delays block stagings (then off)
+    re_block_read_delay_s: float = 0.0
+    re_block_read_delays: int = 0
+    # blocked random-effect training: number of transient block-staging
+    # errors (ChaosIOError; the prefetch thread retries under the
+    # resilience/retry budget) — separate from before_io so a blocked
+    # test can fail stagings without touching checkpoint writes
+    re_block_read_errors: int = 0
+    # blocked random-effect training: bucket index after whose on_block
+    # checkpoint hook the trainer dies (fires once) — the cursor is
+    # durable, the resume must be bitwise
+    re_block_kill_at: Optional[int] = None
     # data-store conversion: unit index after whose data write (fsynced,
     # cursor NOT yet advanced) the converter dies (fires once) — resume
     # must re-convert that unit and land on a byte-identical store
@@ -196,6 +222,9 @@ class _State:
         self.chunk_read_delays_done = 0
         self.chunk_read_errors_done = 0
         self.stream_kill_fired = False
+        self.re_block_read_delays_done = 0
+        self.re_block_read_errors_done = 0
+        self.re_block_kill_fired = False
         self.convert_kill_fired = False
         self.shard_slow_done = 0
         self.tenant_floods_done = 0
@@ -360,6 +389,58 @@ def should_kill_stream(pass_idx: int, chunk_idx: int) -> bool:
         if s.config.stream_kill_at != (pass_idx, chunk_idx):
             return False
         s.stream_kill_fired = True
+    return True
+
+
+def re_block_read_delay() -> float:
+    """Seconds of injected entity-block staging latency for this read
+    (0 when inactive or the budget is spent). Applied on the blocked
+    random-effect trainer's PREFETCH thread only — a correctly
+    double-buffered consumer keeps solving the already-staged bucket
+    while the reader sleeps."""
+    s = _active
+    if s is None or s.config.re_block_read_delay_s <= 0:
+        return 0.0
+    with s.lock:
+        if s.re_block_read_delays_done >= s.config.re_block_read_delays:
+            return 0.0
+        s.re_block_read_delays_done += 1
+    return s.config.re_block_read_delay_s
+
+
+def re_block_read_error() -> None:
+    """Raise ``ChaosIOError`` for the first ``re_block_read_errors``
+    entity-block stagings, then succeed. Budgeted separately from
+    ``before_io`` so a blocked-training test can fail stagings without
+    also failing the checkpoint writes that share the retry machinery."""
+    s = _active
+    if s is None or s.config.re_block_read_errors <= 0:
+        return
+    with s.lock:
+        if s.re_block_read_errors_done >= s.config.re_block_read_errors:
+            return
+        s.re_block_read_errors_done += 1
+        n = s.re_block_read_errors_done
+    raise ChaosIOError(f"chaos: injected transient re-block staging "
+                       f"error #{n}")
+
+
+def should_kill_re_block(block_idx: int) -> bool:
+    """True exactly once when the blocked random-effect trainer has
+    fired bucket ``block_idx``'s checkpoint hook and the installed
+    config names that bucket — the caller raises ``SimulatedKill``
+    AFTER the cursor is durable, so resume from ``start_block =
+    block_idx + 1`` with the checkpointed table must be bitwise (the v4
+    ``re_block_cursor`` contract, K>1 lanes included)."""
+    s = _active
+    if s is None or s.config.re_block_kill_at is None:
+        return False
+    with s.lock:
+        if s.re_block_kill_fired:
+            return False
+        if s.config.re_block_kill_at != block_idx:
+            return False
+        s.re_block_kill_fired = True
     return True
 
 

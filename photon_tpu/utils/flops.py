@@ -159,6 +159,67 @@ def stream_overlap_utilization(reader_busy_s: float, consumer_stall_s: float,
     }
 
 
+def re_block_overlap(reader_busy_s: float, consumer_stall_s: float,
+                     wall_s: float, bytes_staged: int,
+                     device=None, coordinate: str = "re") -> dict:
+    """Stage-vs-solve overlap efficiency of a blocked random-effect pass
+    — ``stream_overlap_utilization``'s sibling for the entity-bucket
+    pipeline (game/block_stream.BlockPrefetcher): the prefetch thread
+    stages bucket b+1 while bucket b solves; the only staging time the
+    solver ever saw was its own stalls waiting on the queue. Lands as
+    ``perf.re_block_overlap{coordinate}`` / ``perf.re_h2d_bw_util
+    {coordinate}`` gauges and a dict for bench records."""
+    import jax
+
+    from photon_tpu.obs.metrics import registry
+
+    if device is None:
+        device = jax.devices()[0]
+    peak_bw, kind = peak_h2d_bw(device)
+    wall_s = max(float(wall_s), 1e-12)
+    reader_busy_s = max(float(reader_busy_s), 0.0)
+    hidden_s = max(reader_busy_s - max(float(consumer_stall_s), 0.0), 0.0)
+    overlap = hidden_s / reader_busy_s if reader_busy_s > 1e-9 else 1.0
+    h2d_util = bytes_staged / wall_s / peak_bw
+    registry.gauge("perf.re_block_overlap", coordinate=coordinate).set(overlap)
+    registry.gauge("perf.re_h2d_bw_util", coordinate=coordinate).set(h2d_util)
+    return {
+        "coordinate": coordinate,
+        "device_kind": kind,
+        "reader_busy_s": float(reader_busy_s),
+        "consumer_stall_s": float(consumer_stall_s),
+        "hidden_s": float(hidden_s),
+        "wall_s": float(wall_s),
+        "bytes_staged": int(bytes_staged),
+        "overlap_efficiency": float(overlap),
+        "h2d_bw_utilization": float(h2d_util),
+        "peak_h2d_bw": float(peak_bw),
+    }
+
+
+def re_peak_hbm(coordinate: str, planned_bytes: int,
+                measured_bytes: int) -> dict:
+    """Publish a blocked/swept random-effect pass's peak device
+    footprint: the ``parallel/memory`` planner's prediction next to the
+    measured peak (on CPU backends the measurement is an array-bytes /
+    RSS proxy — see bench.py --mode re_sweep). Both land as
+    ``perf.re_peak_hbm_bytes{coordinate, kind}`` gauges so every
+    RunReport snapshot carries the planned-vs-measured pair; the
+    acceptance contract is planned >= measured on every bucket."""
+    from photon_tpu.obs.metrics import registry
+
+    registry.gauge("perf.re_peak_hbm_bytes", coordinate=coordinate,
+                   kind="planned").set(int(planned_bytes))
+    registry.gauge("perf.re_peak_hbm_bytes", coordinate=coordinate,
+                   kind="measured").set(int(measured_bytes))
+    return {
+        "coordinate": coordinate,
+        "planned_peak_bytes": int(planned_bytes),
+        "measured_peak_bytes": int(measured_bytes),
+        "within_plan": bool(int(measured_bytes) <= int(planned_bytes)),
+    }
+
+
 def _nnz_slots(features) -> int:
     """Feature slots touched per objective pass (dense: n*d; ELL: n*K)."""
     if isinstance(features, F.SparseFeatures):

@@ -67,6 +67,12 @@ SCAN_DIRS = (
     # alias path directly — a host sync here would fence every chunk's
     # transfer behind the previous chunk's compute
     os.path.join(REPO, "photon_tpu", "io", "data_store.py"),
+    # RE-sweep HBM planner: pure byte arithmetic consulted from inside
+    # the swept-block solve loop — it must never touch the device (the
+    # block prefetcher's only block_until_ready is its reader thread's
+    # staging fence, marked; game/ walk covers block_stream.py and the
+    # swept solve loops in coordinate.py)
+    os.path.join(REPO, "photon_tpu", "parallel", "memory.py"),
 )
 MARKER = "host-sync-ok"
 
@@ -166,7 +172,8 @@ def main() -> int:
         return 1
     print("ok: no host-sync primitives in photon_tpu/optim, "
           "photon_tpu/game, photon_tpu/function, the streaming chunk "
-          "loop, the mmap data store, or the serving hot path")
+          "loop, the mmap data store, the RE-sweep HBM planner, or the "
+          "serving hot path")
     return 0
 
 
