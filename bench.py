@@ -4919,6 +4919,371 @@ def run_fleet_bench(scale: float, quick: bool = False):
     return rec
 
 
+def _replay_game_models(E, d_global, K, num_shards, seed):
+    """The replay fleet's model set, built once and shared across replay
+    stacks: a fixed-effect front model plus ``num_shards`` RE-only shard
+    models with FULLY RESIDENT coefficient tables (no two-tier store —
+    cold-miss promotion timing is wall-clock state the bitwise-timeline
+    contract cannot admit). Entity ownership uses the canonical
+    partitioner over the real id strings, exactly what the router
+    hashes."""
+    from photon_tpu.io.index_map import IndexMap, feature_key
+    from photon_tpu.io.model_io import (
+        ServingFixedEffect,
+        ServingGameModel,
+        ServingRandomEffect,
+    )
+    from photon_tpu.parallel.partition import entity_shards
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    imap = IndexMap({feature_key(f"f{j}", ""): j for j in range(d_global)})
+    theta = rng.normal(size=d_global).astype(np.float32)
+    coef = rng.normal(size=(E, K)).astype(np.float32)
+    lo = rng.integers(0, d_global - 1, size=E)
+    hi = rng.integers(lo + 1, d_global)
+    proj = np.stack([lo, hi], axis=1).astype(np.int32)
+    owners = entity_shards(_fleet_row_ids(np.arange(E)), num_shards)
+
+    front_model = ServingGameModel(
+        TaskType.LINEAR_REGRESSION,
+        [ServingFixedEffect("fixed", "g", theta)], [], {"g": imap}, {})
+    shard_models = []
+    for s in range(num_shards):
+        rows_idx = np.flatnonzero(owners == s)
+        entity_rows = {f"e{i:09d}": j for j, i in enumerate(rows_idx)}
+        re = ServingRandomEffect(
+            "per_user", "userId", "g",
+            coefficients=np.ascontiguousarray(coef[rows_idx]),
+            projection=np.ascontiguousarray(proj[rows_idx]),
+            entity_rows=entity_rows)
+        shard_models.append(ServingGameModel(
+            TaskType.LINEAR_REGRESSION, [], [re], {"g": imap}, {}))
+    return front_model, shard_models
+
+
+def _replay_build_fleet(front_model, shard_models, clock, max_batch):
+    """One replay stack: front + shard engines + router, ALL on the one
+    virtual clock (MicroBatcher coalescing, breaker windows, swap
+    probation, router deadlines and shard-stats timestamps)."""
+    from photon_tpu.serving import (
+        DeviceResidentModel,
+        FleetConfig,
+        LocalShardClient,
+        ServingConfig,
+        ServingEngine,
+        ShardedServingFleet,
+    )
+
+    cfg = ServingConfig(max_batch=max_batch, max_wait_s=0.001)
+    front = ServingEngine(DeviceResidentModel(front_model), cfg,
+                          clock=clock, obs_labels={"shard": "front"})
+    clients = []
+    for s, m in enumerate(shard_models):
+        clients.append(LocalShardClient(s, ServingEngine(
+            DeviceResidentModel(m), cfg, clock=clock,
+            obs_labels={"shard": str(s)})))
+    fleet = ShardedServingFleet(front, clients, [("per_user", "userId")],
+                                FleetConfig(serving=cfg), clock=clock)
+    fleet.warmup()
+    return fleet
+
+
+def _replay_compile_monitors(fleet):
+    """The three zero-compile monitors over EVERY engine in the stack
+    (front + shards): steady-state compile events, jitcache misses,
+    per-program re-trace counts."""
+    from photon_tpu.obs.metrics import registry as _registry
+    from photon_tpu.serving.scorer import get_scorer, serving_modes
+    from photon_tpu.utils import compile_cache
+
+    engines = [fleet.front] + [c.engine for c in fleet.clients]
+    programs = [get_scorer(e.model, mode, b)
+                for e in engines
+                for mode in serving_modes(e.model)
+                for b in e.ladder.buckets]
+    jitted = [p if hasattr(p, "_cache_size")
+              else getattr(p, "__wrapped__", p) for p in programs]
+    jitted = [f for f in jitted if hasattr(f, "_cache_size")]
+    return {
+        "steady_state": compile_cache.compile_counts()["steady_state"],
+        "misses": _registry.counter("jitcache.misses").value,
+        "traces": [f._cache_size() for f in jitted],
+        "_jitted": jitted,
+    }
+
+
+def _replay_timeline(snapshot, interval):
+    """Per-window qps/p99 rows for the artifact (and the log line)."""
+    ts = snapshot.get("timeseries", {})
+    resp = {int(w["idx"]): float(w["value"])
+            for w in ts.get("replay.responses", {}).get("windows", [])}
+    lat = {int(w["idx"]): w.get("p99")
+           for w in ts.get("replay.latency", {}).get("windows", [])}
+    return [{"idx": i, "qps": round(resp[i] / interval, 1),
+             "p99_s": lat.get(i)} for i in sorted(resp)]
+
+
+def run_replay_bench(scale: float, quick: bool = False):
+    """Traffic capture & deterministic replay harness (ISSUE 18): a
+    Zipf+burst profile is generated counter-derived, captured to a
+    crc32-framed JSONL file, read back, and replayed TWICE through two
+    independently built sharded serving fleets on fresh virtual clocks —
+    gating on bitwise-identical response digests and per-window qps/p99
+    timeline digests. A third replay schedules a mid-replay live model
+    swap on the front engine plus a shard kill/revive, and the
+    declarative SLO rules must localize the typed-degradation breach to
+    exactly the kill windows while every survivor shard's verdict stays
+    PASS — with zero steady-state compiles across the whole incident
+    (the three existing compile monitors feed the compile-SLO rule).
+
+    ``quick`` is the tier-1 smoke shape: tiny stream, 2 shards, no
+    artifact write."""
+    import tempfile
+
+    import jax
+
+    from photon_tpu.obs import slo
+    from photon_tpu.obs import timeseries as _tsmod
+    from photon_tpu.obs.report import build_run_report, validate_run_report
+    from photon_tpu.serving.replay import (
+        Replayer,
+        TrafficProfile,
+        VirtualClock,
+        generate,
+        read_capture,
+        record_capture,
+        stream_digest,
+        timeline_digest,
+    )
+
+    if quick:
+        E, K, d_global = 3_000, 2, 16
+        num_shards, max_batch = 2, 32
+        n_requests, base_qps = 300, 150.0
+        burst_at, burst_len, burst_factor = 1.0, 0.6, 3.0
+        t_swap, t_kill, t_revive = 0.4, 0.6, 1.1
+    else:
+        E = int(1_000_000 * scale) or 1000
+        K, d_global = 2, 32
+        num_shards, max_batch = 4, 64
+        n_requests, base_qps = 8_000, 2_000.0
+        burst_at, burst_len, burst_factor = 1.5, 1.0, 3.0
+        t_swap, t_kill, t_revive = 0.8, 1.0, 1.9
+    interval, tick = 0.25, 0.05
+    seed = _FLEET_SEED + 18
+
+    # every windowed series in this process (engine-side serving.*,
+    # router-side fleet.*, replayer-side replay.*) shares one window grid
+    _tsmod.series.interval_s = interval
+    _tsmod.clear()
+    slo.clear()
+
+    profile = TrafficProfile(
+        kind="burst", n_requests=n_requests, entities=E, zipf_a=1.5,
+        base_qps=base_qps, feature_dim=d_global, nnz=4,
+        burst_at_s=burst_at, burst_len_s=burst_len,
+        burst_factor=burst_factor)
+
+    # -- generate + capture round-trip ------------------------------------
+    t0 = time.perf_counter()
+    records = generate(profile, seed)
+    sdig = stream_digest(records)
+    g_stream = stream_digest(generate(profile, seed)) == sdig
+    tdir = tempfile.mkdtemp(prefix="replay_bench_")
+    cap_path = os.path.join(tdir, "capture.jsonl")
+    record_capture(cap_path, records)
+    cap_bytes = os.path.getsize(cap_path)
+    cap_records, cap_stats = read_capture(cap_path)
+    g_capture = (len(cap_records) == n_requests
+                 and cap_stats["capture_truncated"] == 0
+                 and stream_digest([(r.t, r.request)
+                                    for r in cap_records]) == sdig)
+    gen_s = time.perf_counter() - t0
+    log(f"replay: {n_requests} requests over {E} entities generated + "
+        f"captured ({cap_bytes / 1e6:.1f}MB) in {gen_s:.1f}s, stream "
+        f"digest {sdig}, capture round-trip ok: {g_capture}")
+
+    t0 = time.perf_counter()
+    front_model, shard_models = _replay_game_models(
+        E, d_global, K, num_shards, seed)
+    log(f"replay: {num_shards}-shard resident model set built in "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    # -- segment A: replay the capture twice, bitwise gates ---------------
+    runs = []
+    for i in (1, 2):
+        clk = VirtualClock()
+        fleet = _replay_build_fleet(front_model, shard_models, clk,
+                                    max_batch)
+        reg = _tsmod.WindowedRegistry(interval_s=interval)
+        t0 = time.perf_counter()
+        res = Replayer(fleet, clk, registry=reg, tick_s=tick).run(
+            cap_records)
+        wall = time.perf_counter() - t0
+        snap = reg.snapshot()
+        runs.append({
+            "result": res.to_json(),
+            "timeline_digest": timeline_digest(snap),
+            "timeline": _replay_timeline(snap, interval),
+            "replay_wall_s": round(wall, 2),
+        })
+        fleet.shutdown()
+        log(f"replay: run {i}: {res.responses} responses over "
+            f"{res.virtual_seconds:.2f} virtual s in {wall:.1f}s wall, "
+            f"response digest {res.response_digest}, timeline digest "
+            f"{runs[-1]['timeline_digest']}")
+    g_response = (runs[0]["result"]["response_digest"]
+                  == runs[1]["result"]["response_digest"])
+    g_timeline = runs[0]["timeline_digest"] == runs[1]["timeline_digest"]
+
+    # -- segment B: mid-replay shard kill + live front swap ---------------
+    from photon_tpu.serving import DeviceResidentModel
+    from photon_tpu.serving.scorer import warmup_scorers
+
+    _tsmod.clear()
+    clk = VirtualClock()
+    fleet = _replay_build_fleet(front_model, shard_models, clk, max_batch)
+    staged = DeviceResidentModel(front_model)
+    warmup_scorers(staged, fleet.front.ladder.buckets)   # pre-warmed copy
+    victim = num_shards // 2
+    mon0 = _replay_compile_monitors(fleet)
+    swap_info = {}
+    actions = [
+        (t_swap, lambda: swap_info.update(fleet.front.publish_model(
+            staged, "replay-live-swap"))),
+        (t_kill, lambda: fleet.kill_shard(victim)),
+        (t_revive, lambda: fleet.revive_shard(victim)),
+    ]
+    t0 = time.perf_counter()
+    res_kill = Replayer(fleet, clk, tick_s=tick).run(cap_records, actions)
+    kill_wall = time.perf_counter() - t0
+    mon1 = _replay_compile_monitors(fleet)
+    compile_delta = (
+        (mon1["steady_state"] - mon0["steady_state"])
+        + (mon1["misses"] - mon0["misses"])
+        + sum(max(0, b - a) for a, b in zip(mon0["traces"],
+                                            mon1["traces"])))
+    snap_kill = _tsmod.series.snapshot()
+    fleet.shutdown()
+
+    # kill windows: every window the victim could have been dead in
+    kill_idx = set(range(int(t_kill // interval),
+                         int((t_revive + tick) // interval) + 1))
+    rules = [
+        slo.P99Ceiling(
+            rule_id="replay_p99_under_load", series="replay.latency",
+            ceiling_s=4 * tick, qps_series="replay.responses",
+            qps_floor=0.25 * base_qps),
+        slo.MaxDegradationRate(
+            rule_id="no_typed_degradation",
+            degraded_series="replay.degraded",
+            total_series="replay.responses", max_rate=0.0,
+            degraded_labels={"reason": "shard_unavailable"}),
+        slo.ZeroSteadyStateCompiles(rule_id="zero_steady_state_compiles"),
+    ]
+    for s in range(num_shards):
+        rules.append(slo.MaxDegradationRate(
+            rule_id=f"shard{s}_availability",
+            degraded_series="fleet.shard.unavailable",
+            total_series="replay.responses", max_rate=0.0,
+            degraded_labels={"shard": str(s)}))
+    verdicts = slo.evaluate(slo.SLOSpec(rules), snap_kill,
+                            compile_delta=compile_delta)
+    by_rule = {v.rule_id: v for v in verdicts}
+
+    deg = by_rule["no_typed_degradation"]
+    vic = by_rule[f"shard{victim}_availability"]
+    g_kill_registered = (deg.status == slo.BREACH
+                         and vic.status == slo.BREACH
+                         and res_kill.degraded_reasons.get(
+                             "shard_unavailable", 0) > 0)
+    g_localized = (
+        {w["idx"] for w in deg.offending_windows} <= kill_idx
+        and {w["idx"] for w in vic.offending_windows} <= kill_idx)
+    g_survivors = all(
+        by_rule[f"shard{s}_availability"].status == slo.PASS
+        for s in range(num_shards) if s != victim)
+    g_p99 = by_rule["replay_p99_under_load"].status != slo.BREACH
+    g_compiles = by_rule["zero_steady_state_compiles"].status == slo.PASS
+    g_swap = swap_info.get("version") == 2
+    log(f"replay: kill segment ({kill_wall:.1f}s wall): "
+        f"{res_kill.degraded_reasons.get('shard_unavailable', 0)} typed "
+        f"shard_unavailable in windows "
+        f"{sorted(w['idx'] for w in deg.offending_windows)} "
+        f"(allowed {sorted(kill_idx)}), survivors PASS: {g_survivors}, "
+        f"swap v{swap_info.get('version')}, compile delta {compile_delta}")
+
+    # -- RunReport round-trip + machine-readable verdict file -------------
+    report = build_run_report("bench-replay")
+    report_errors = validate_run_report(report)
+    g_report = (report_errors == []
+                and "timeline" in report and "slo" in report)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    verdict_doc = slo.write_verdicts(
+        os.path.join(tdir if quick else here, "REPLAY_SLO_VERDICTS.json"),
+        verdicts)
+
+    gates = {
+        "stream_digest_stable": bool(g_stream),
+        "capture_roundtrip": bool(g_capture),
+        "response_digest_identical": bool(g_response),
+        "timeline_digest_identical": bool(g_timeline),
+        "kill_breach_registered": bool(g_kill_registered),
+        "breach_localized_to_kill_windows": bool(g_localized),
+        "survivor_shards_pass": bool(g_survivors),
+        "p99_slo_held": bool(g_p99),
+        "zero_steady_state_compiles": bool(g_compiles),
+        "live_swap_published": bool(g_swap),
+        "runreport_roundtrip": bool(g_report),
+    }
+    rec = {
+        "metric": "replay_harness_gates_passed",
+        "value": round(sum(gates.values()) / len(gates), 4),
+        "unit": "fraction",
+        "gates": gates,
+        "profile": {"kind": profile.kind, "n_requests": n_requests,
+                    "entities": E, "zipf_a": profile.zipf_a,
+                    "base_qps": base_qps, "burst_factor": burst_factor,
+                    "seed": seed},
+        "stream_digest": sdig,
+        "capture": {"records": len(cap_records), "bytes": cap_bytes,
+                    "truncated": cap_stats["capture_truncated"],
+                    "bad_records": cap_stats["bad_records"]},
+        "window_interval_s": interval,
+        "replay_1": runs[0],
+        "replay_2": runs[1],
+        "kill_swap": {
+            "num_shards": num_shards,
+            "victim": victim,
+            "t_swap": t_swap, "t_kill": t_kill, "t_revive": t_revive,
+            "kill_windows": sorted(kill_idx),
+            "result": res_kill.to_json(),
+            "swap": swap_info,
+            "compile_delta": compile_delta,
+            "slo_status": verdict_doc["status"],
+            "verdicts": verdict_doc["verdicts"],
+            "timeline": _replay_timeline(snap_kill, interval),
+        },
+        "runreport_errors": report_errors,
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "tpu_unavailable": _STATE["tpu_unavailable"],
+        "quick": quick,
+    }
+    import shutil as _sh
+    _sh.rmtree(tdir, ignore_errors=True)
+    if not quick:
+        with open(os.path.join(here, "BENCH_REPLAY_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"replay: {sum(gates.values())}/{len(gates)} gates passed "
+        f"({', '.join(k for k, v in gates.items() if not v) or 'all'}"
+        f"{' failing' if not all(gates.values()) else ''})")
+    return rec
+
+
 # Order = on-chip capture priority (each config emits its JSON line the
 # moment it completes, so when the flaky relay dies mid-run the most
 # decision-relevant numbers are already on disk): the NEWTON flagship,
@@ -4959,7 +5324,7 @@ def main():
                     choices=("train", "serving", "game_cd", "coldtier",
                              "nearline", "hier", "fused", "stream", "fleet",
                              "tenant", "ingest", "sweep", "sdca",
-                             "re_sweep"),
+                             "re_sweep", "replay"),
                     help="train = the solver configs (default); serving = "
                          "the online-serving bench -> BENCH_SERVING_r01.json; "
                          "game_cd = parallel-vs-sequential CD sweeps "
@@ -4986,11 +5351,13 @@ def main():
                          "storage passes to AUC -> BENCH_SDCA_r01.json; "
                          "re_sweep = random-effect λ-lane sweep data "
                          "passes + HBM planner honesty "
-                         "-> BENCH_RE_SWEEP_r01.json")
+                         "-> BENCH_RE_SWEEP_r01.json; replay = traffic "
+                         "capture + deterministic replay + SLO gates "
+                         "-> BENCH_REPLAY_r01.json")
     ap.add_argument("--quick", action="store_true",
                     help="game_cd/coldtier/nearline/hier/fused/stream/"
-                         "fleet/tenant/ingest/sweep/sdca/re_sweep: tiny "
-                         "tier-1 smoke shape (no artifact write)")
+                         "fleet/tenant/ingest/sweep/sdca/re_sweep/replay: "
+                         "tiny tier-1 smoke shape (no artifact write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -5064,6 +5431,21 @@ def main():
             emit({"metric": "fleet_aggregate_qps_speedup", "value": 0.0,
                   "unit": "x_single_host", "error": repr(e)})
         _DONE.set()     # fleet mode: the record above IS the summary
+        return
+
+    if args.mode == "replay":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/replay"):
+                emit(run_replay_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"replay bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "replay_harness_gates_passed", "value": 0.0,
+                  "unit": "fraction", "error": repr(e)})
+        _DONE.set()     # replay mode: the record above IS the summary
         return
 
     if args.mode == "tenant":

@@ -134,6 +134,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-warmup", action="store_true",
                    help="skip ladder pre-compilation (debugging only; "
                         "steady-state requests will compile)")
+    p.add_argument("--capture", default=None, metavar="PATH",
+                   help="record every admitted request into a crc32-framed "
+                        "JSONL traffic capture (serving/replay) with "
+                        "engine-clock offsets, for deterministic replay")
     p.add_argument("--stats-output", default=None,
                    help="write engine stats() JSON here at stream end")
     p.add_argument("--runreport-output", default=None,
@@ -370,6 +374,13 @@ def run(args: argparse.Namespace,
     serving_pkg.set_active_engine(engine)
     shutdown.install()
 
+    capture = None
+    capture_t0 = 0.0
+    if args.capture:
+        from photon_tpu.serving.replay import CaptureWriter
+        capture = CaptureWriter(args.capture)
+        capture_t0 = engine.clock()
+
     def _on_shutdown(reason: str) -> None:
         engine.begin_drain(reason)
 
@@ -417,6 +428,9 @@ def run(args: argparse.Namespace,
             rejected = engine.submit(req)
             if rejected is not None:
                 emit(rejected)
+            elif capture is not None:
+                # admitted: one capture record at the engine-clock offset
+                capture.append(engine.clock() - capture_t0, req)
             for resp in engine.pump():
                 emit(resp)
 
@@ -451,6 +465,8 @@ def run(args: argparse.Namespace,
                 emit(resp)
     finally:
         stdout.flush()
+        if capture is not None:
+            capture.close()
         shutdown.remove_callback(_on_shutdown)
         shutdown.uninstall()
 

@@ -62,12 +62,20 @@ def validate_run_report(rep):
 
 def reset() -> None:
     """Clear every telemetry buffer and the enabled-override (tests)."""
+    import sys as _sys
+
     from photon_tpu.obs import _config, spans
     _config.reset()
     metrics.clear()
     spans.clear()
     memory.clear()
     _solver_mod.clear()
+    # windowed series + SLO verdicts: lazy (sys.modules) so offline
+    # drivers that never touched them pay nothing here either
+    for name in ("photon_tpu.obs.timeseries", "photon_tpu.obs.slo"):
+        mod = _sys.modules.get(name)
+        if mod is not None:
+            mod.clear()
 
 
 __all__ = [

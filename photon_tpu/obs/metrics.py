@@ -246,11 +246,18 @@ def merge_snapshots(snapshots: Iterable[Dict[str, Dict[str, object]]]
     """Merge per-process ``snapshot()`` dicts into one cluster view:
     counters sum, gauges take the max (they are used as watermarks/flags),
     histograms sum bucket-wise when bucket layouts agree (first layout
-    wins otherwise). Used by the RunReport's process-0 aggregation — runs
-    once at report time, never in a hot path."""
+    wins otherwise). Snapshots carrying a ``timeseries`` section
+    (obs/timeseries.py WindowedRegistry.snapshot()) merge those series
+    window-by-window too, and the output gains a ``timeseries`` section
+    only in that case — plain MetricsRegistry merges keep the old shape.
+    Used by the RunReport's process-0 aggregation — runs once at report
+    time, never in a hot path."""
     out: Dict[str, Dict[str, object]] = {
         "counters": {}, "gauges": {}, "histograms": {}}
+    ts_groups: Dict[str, list] = {}
     for snap in snapshots:
+        for k, s in snap.get("timeseries", {}).items():
+            ts_groups.setdefault(k, []).append(s)
         for k, v in snap.get("counters", {}).items():
             out["counters"][k] = out["counters"].get(k, 0.0) + v
         for k, v in snap.get("gauges", {}).items():
@@ -270,4 +277,8 @@ def merge_snapshots(snapshots: Iterable[Dict[str, Dict[str, object]]]
         if h["count"]:  # cluster-level quantiles over the merged buckets
             for name_q, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
                 h[name_q] = bucket_quantile(h["buckets"], h["counts"], q)
+    if ts_groups:
+        from photon_tpu.obs import timeseries as _ts  # lazy: avoid cycle
+        out["timeseries"] = {k: _ts.merge_series(v)
+                             for k, v in sorted(ts_groups.items())}
     return out

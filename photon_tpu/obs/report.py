@@ -100,6 +100,12 @@ def build_run_report(driver: str,
     re_plan = _re_plan_section()
     if re_plan is not None:
         report["re_plan"] = re_plan
+    timeline = _timeline_section()
+    if timeline is not None:
+        report["timeline"] = timeline
+    slo = _slo_section()
+    if slo is not None:
+        report["slo"] = slo
     if extra:
         report["extra"] = extra
     return report
@@ -169,6 +175,34 @@ def _re_plan_section() -> Optional[Dict[str, Any]]:
     pattern as :func:`_serving_section`; the section itself returns None
     while no sweep has been planned."""
     mod = sys.modules.get("photon_tpu.parallel.memory")
+    if mod is None:
+        return None
+    try:
+        return mod.report_section()
+    except Exception:  # noqa: BLE001 — reporting must not kill a run
+        return None
+
+
+def _timeline_section() -> Optional[Dict[str, Any]]:
+    """Windowed time-series telemetry (obs/timeseries.py), when this
+    process recorded any. Same ``sys.modules`` pattern as
+    :func:`_serving_section` — offline drivers that never touch the
+    windowed registry pay nothing; the section itself returns None
+    while it is empty."""
+    mod = sys.modules.get("photon_tpu.obs.timeseries")
+    if mod is None:
+        return None
+    try:
+        return mod.report_section()
+    except Exception:  # noqa: BLE001 — reporting must not kill a run
+        return None
+
+
+def _slo_section() -> Optional[Dict[str, Any]]:
+    """SLO verdicts (obs/slo.py) recorded by any evaluation this run.
+    Same ``sys.modules`` pattern as :func:`_serving_section`; the
+    section itself returns None while nothing was evaluated."""
+    mod = sys.modules.get("photon_tpu.obs.slo")
     if mod is None:
         return None
     try:
@@ -337,6 +371,64 @@ def validate_run_report(report: Dict[str, Any]) -> List[str]:
                       "last_plan"):
                 if k not in re_plan:
                     errors.append(f"re_plan missing {k!r}")
+    if "timeline" in report:  # optional: only windowed-telemetry runs
+        timeline = report["timeline"]
+        if not isinstance(timeline, dict):
+            errors.append("timeline must be a dict")
+        else:
+            if not isinstance(timeline.get("interval_s"), (int, float)) \
+                    or timeline.get("interval_s", 0) <= 0:
+                errors.append("timeline.interval_s must be positive")
+            series_map = timeline.get("series")
+            if not isinstance(series_map, dict):
+                errors.append("timeline.series must be a dict")
+            else:
+                for key, s in series_map.items():
+                    if not isinstance(s, dict):
+                        errors.append(f"timeline.series[{key!r}] not a dict")
+                        continue
+                    if s.get("kind") not in ("counter", "gauge", "quantile"):
+                        errors.append(
+                            f"timeline.series[{key!r}] bad kind "
+                            f"{s.get('kind')!r}")
+                    windows = s.get("windows")
+                    if not isinstance(windows, list):
+                        errors.append(
+                            f"timeline.series[{key!r}].windows not a list")
+                        continue
+                    idxs = [w.get("idx") for w in windows
+                            if isinstance(w, dict)]
+                    if len(idxs) != len(windows) or idxs != sorted(idxs):
+                        errors.append(
+                            f"timeline.series[{key!r}] windows must carry "
+                            f"sorted idx fields")
+    if "slo" in report:  # optional: only runs that evaluated SLO specs
+        slo = report["slo"]
+        if not isinstance(slo, dict):
+            errors.append("slo must be a dict")
+        else:
+            if slo.get("status") not in ("PASS", "WARN", "BREACH"):
+                errors.append(f"slo.status invalid: {slo.get('status')!r}")
+            verdicts = slo.get("verdicts")
+            if not isinstance(verdicts, list):
+                errors.append("slo.verdicts must be a list")
+            else:
+                for i, v in enumerate(verdicts):
+                    if not isinstance(v, dict):
+                        errors.append(f"slo.verdicts[{i}] not a dict")
+                        continue
+                    for k in ("rule_id", "kind", "status",
+                              "offending_windows"):
+                        if k not in v:
+                            errors.append(f"slo.verdicts[{i}] missing {k!r}")
+                    if v.get("status") not in ("PASS", "WARN", "BREACH"):
+                        errors.append(
+                            f"slo.verdicts[{i}] bad status "
+                            f"{v.get('status')!r}")
+                    if not isinstance(v.get("offending_windows", []), list):
+                        errors.append(
+                            f"slo.verdicts[{i}].offending_windows "
+                            f"must be a list")
     if "cd" in report:  # optional: only parallel-CD training processes
         cd = report["cd"]
         if not isinstance(cd, dict) or not isinstance(

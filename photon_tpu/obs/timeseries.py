@@ -1,0 +1,407 @@
+"""Windowed time-series telemetry: behavior over time, not just run totals.
+
+The cumulative registry (obs/metrics.py) answers "what happened this
+run"; it cannot answer "WHEN did p99 degrade during the shard kill".
+This module adds fixed-interval windowed series — counters, gauges, and
+streaming quantile sketches — keyed by (name, labels) exactly like the
+cumulative registry, ring-buffered so memory stays bounded no matter how
+long a serving process lives.
+
+Design points:
+
+  * **explicit timestamps** — every observation carries its own ``t``
+    (seconds, any monotone clock). Window index is ``floor(t /
+    interval_s)``, so a replay driven on a virtual clock produces
+    bitwise-identical timelines run to run; nothing here ever reads the
+    wall clock.
+  * **per-label quantiles** — each (name, labels) series owns its own
+    per-window sketch, so two tenants' (or two shards') latencies can no
+    longer pollute each other's p99 the way the process-global
+    histograms of PR 12 did. The cumulative histograms stay untouched as
+    the run-total shim.
+  * **geometric-bucket sketches** — a value ``v`` lands in bucket
+    ``ceil(log_gamma(v))`` and is estimated as ``2·γ^i/(γ+1)``, so every
+    quantile estimate is within relative error ``α = (γ-1)/(γ+1)`` of a
+    true sample value of that rank, and two sketches with the same γ
+    merge EXACTLY (bucket-count sums) — the property the multi-process
+    ``merge_snapshots`` path and its pinned-error-bound test rely on.
+  * **ring-buffered** — at most ``capacity`` windows per series; older
+    windows are evicted (counted), and observations older than the ring
+    are dropped (counted), never resurrected.
+
+``snapshot()`` emits the cross-process unit: a dict shaped like
+``MetricsRegistry.snapshot()`` plus a ``"timeseries"`` section, which
+``obs.metrics.merge_snapshots`` aligns window-by-window across
+processes. ``report_section()`` is the RunReport ``timeline`` section.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from photon_tpu.obs.metrics import LabelItems, _label_items, _label_suffix
+
+#: default window width (seconds on whatever clock the caller stamps with)
+DEFAULT_INTERVAL_S = 1.0
+#: default ring size: windows retained per (name, labels) series
+DEFAULT_CAPACITY = 256
+#: default sketch resolution: relative error (γ-1)/(γ+1) ≈ 4.8%
+DEFAULT_GAMMA = 1.1
+#: hard per-sketch bucket ceiling (γ=1.1 spans 1e-9..1e9 in ~435 buckets;
+#: past the cap the smallest buckets collapse together, which can only
+#: bias the extreme LOW quantiles, never the p95/p99 the SLO gates read)
+MAX_SKETCH_BUCKETS = 512
+
+QUANTILES = (("p50", 0.5), ("p95", 0.95), ("p99", 0.99))
+
+
+class QuantileSketch:
+    """Mergeable geometric-bucket quantile sketch (DDSketch-style).
+
+    Positive values map to bucket ``i = ceil(ln(v)/ln(γ))`` and are
+    estimated by the bucket midpoint-in-ratio ``2·γ^i/(γ+1)``; values
+    ``<= 0`` (a virtual-clock latency can be exactly 0.0) count in a
+    dedicated zero bucket estimated as 0.0. The rank-q estimate is
+    within relative error ``alpha()`` of the true sample of that rank.
+    """
+
+    __slots__ = ("gamma", "_log_gamma", "zeros", "counts", "count", "sum")
+
+    def __init__(self, gamma: float = DEFAULT_GAMMA):
+        if gamma <= 1.0:
+            raise ValueError(f"sketch gamma must be > 1, got {gamma}")
+        self.gamma = float(gamma)
+        self._log_gamma = math.log(self.gamma)
+        self.zeros = 0
+        self.counts: Dict[int, int] = {}
+        self.count = 0
+        self.sum = 0.0
+
+    def alpha(self) -> float:
+        """Guaranteed relative-error bound of ``quantile`` estimates."""
+        return (self.gamma - 1.0) / (self.gamma + 1.0)
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        if value <= 0.0:
+            self.zeros += 1
+            return
+        i = math.ceil(math.log(value) / self._log_gamma)
+        # v == γ^i exactly can round to i or i+1 across libm versions;
+        # normalize so the bucket invariant γ^(i-1) < v <= γ^i holds
+        if self.gamma ** (i - 1) >= value:
+            i -= 1
+        self.counts[i] = self.counts.get(i, 0) + 1
+        if len(self.counts) > MAX_SKETCH_BUCKETS:
+            lo = sorted(self.counts)[:2]
+            self.counts[lo[1]] = self.counts.pop(lo[0]) + self.counts[lo[1]]
+
+    def _estimate(self, i: int) -> float:
+        return 2.0 * self.gamma ** i / (self.gamma + 1.0)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile estimate: the bucket holding the
+        ``floor(q·(n-1))``-th (0-based) smallest sample."""
+        if self.count == 0:
+            return None
+        rank = math.floor(q * (self.count - 1))
+        if rank < self.zeros:
+            return 0.0
+        cum = self.zeros
+        for i in sorted(self.counts):
+            cum += self.counts[i]
+            if cum > rank:
+                return self._estimate(i)
+        return self._estimate(max(self.counts)) if self.counts else 0.0
+
+    def merge(self, other: "QuantileSketch") -> None:
+        if abs(other.gamma - self.gamma) > 1e-12:
+            raise ValueError(
+                f"cannot merge sketches with gamma {self.gamma} vs "
+                f"{other.gamma}")
+        self.zeros += other.zeros
+        self.count += other.count
+        self.sum += other.sum
+        for i, c in other.counts.items():
+            self.counts[i] = self.counts.get(i, 0) + c
+
+    def to_json(self) -> dict:
+        return {"gamma": self.gamma, "zeros": self.zeros,
+                "counts": {str(i): c for i, c in sorted(self.counts.items())}}
+
+    @staticmethod
+    def from_json(obj: dict) -> "QuantileSketch":
+        s = QuantileSketch(float(obj["gamma"]))
+        s.zeros = int(obj.get("zeros", 0))
+        s.counts = {int(i): int(c)
+                    for i, c in dict(obj.get("counts", {})).items()}
+        s.count = s.zeros + sum(s.counts.values())
+        return s
+
+
+class _Window:
+    __slots__ = ("value", "max", "sketch")
+
+    def __init__(self):
+        self.value = 0.0         # counter sum / gauge last-write
+        self.max = float("-inf")  # gauge watermark
+        self.sketch: Optional[QuantileSketch] = None
+
+
+class _Series:
+    __slots__ = ("kind", "windows", "evicted", "late_dropped")
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.windows: Dict[int, _Window] = {}
+        self.evicted = 0
+        self.late_dropped = 0
+
+
+class _Handle:
+    """One (name, labels) series bound to its registry; the object call
+    sites hold (``series.counter("replay.requests", shard="3")``)."""
+
+    __slots__ = ("_reg", "_series")
+
+    def __init__(self, reg: "WindowedRegistry", series: _Series):
+        self._reg = reg
+        self._series = series
+
+    def inc(self, t: float, delta: float = 1.0) -> None:
+        if delta < 0:
+            raise ValueError(f"windowed counter delta must be >= 0, "
+                             f"got {delta}")
+        w = self._reg._window(self._series, t)
+        if w is not None:
+            w.value += delta
+
+    def set(self, t: float, value: float) -> None:
+        w = self._reg._window(self._series, t)
+        if w is not None:
+            w.value = float(value)
+            w.max = max(w.max, float(value))
+
+    def observe(self, t: float, value: float) -> None:
+        w = self._reg._window(self._series, t)
+        if w is not None:
+            if w.sketch is None:
+                w.sketch = QuantileSketch(self._reg.gamma)
+            w.sketch.observe(value)
+
+    @property
+    def num_windows(self) -> int:
+        with self._reg._lock:
+            return len(self._series.windows)
+
+
+class WindowedRegistry:
+    """Thread-safe (name, labels) -> windowed series registry."""
+
+    def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
+                 capacity: int = DEFAULT_CAPACITY,
+                 gamma: float = DEFAULT_GAMMA):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be positive")
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.interval_s = float(interval_s)
+        self.capacity = int(capacity)
+        self.gamma = float(gamma)
+        self._lock = threading.RLock()
+        self._series: Dict[Tuple[str, LabelItems], _Series] = {}
+        self._kinds: Dict[str, str] = {}
+
+    # -- registration ----------------------------------------------------
+
+    def _get(self, kind: str, name: str, labels: Dict[str, str]) -> _Handle:
+        key = (name, _label_items(labels))
+        with self._lock:
+            existing = self._kinds.get(name)
+            if existing is not None and existing != kind:
+                raise ValueError(
+                    f"windowed series {name!r} already registered as "
+                    f"{existing}, requested {kind}")
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _Series(kind)
+                self._kinds[name] = kind
+            return _Handle(self, s)
+
+    def counter(self, name: str, **labels: str) -> _Handle:
+        return self._get("counter", name, labels)
+
+    def gauge(self, name: str, **labels: str) -> _Handle:
+        return self._get("gauge", name, labels)
+
+    def quantile(self, name: str, **labels: str) -> _Handle:
+        return self._get("quantile", name, labels)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+            self._kinds.clear()
+
+    # -- windowing -------------------------------------------------------
+
+    def window_index(self, t: float) -> int:
+        return int(math.floor(float(t) / self.interval_s))
+
+    def _window(self, s: _Series, t: float) -> Optional[_Window]:
+        idx = self.window_index(t)
+        with self._lock:
+            w = s.windows.get(idx)
+            if w is not None:
+                return w
+            if s.windows and idx < max(s.windows) - self.capacity + 1:
+                s.late_dropped += 1  # older than the ring can ever hold
+                return None
+            w = s.windows[idx] = _Window()
+            while len(s.windows) > self.capacity:
+                del s.windows[min(s.windows)]
+                s.evicted += 1
+            return w
+
+    # -- export ----------------------------------------------------------
+
+    def _series_json(self, s: _Series) -> dict:
+        windows: List[dict] = []
+        for idx in sorted(s.windows):
+            w = s.windows[idx]
+            if s.kind == "counter":
+                windows.append({"idx": idx, "value": w.value})
+            elif s.kind == "gauge":
+                windows.append({"idx": idx, "value": w.value, "max": w.max})
+            else:
+                sk = w.sketch or QuantileSketch(self.gamma)
+                rec = {"idx": idx, "count": sk.count, "sum": sk.sum,
+                       "sketch": sk.to_json()}
+                for qn, q in QUANTILES:
+                    rec[qn] = sk.quantile(q)
+                windows.append(rec)
+        out = {"kind": s.kind, "interval_s": self.interval_s,
+               "windows": windows}
+        if s.evicted:
+            out["evicted"] = s.evicted
+        if s.late_dropped:
+            out["late_dropped"] = s.late_dropped
+        return out
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Mergeable snapshot: the ``MetricsRegistry.snapshot()`` shape
+        plus a ``timeseries`` section, so one dict per process feeds
+        straight into ``obs.metrics.merge_snapshots``."""
+        out: Dict[str, Dict[str, object]] = {
+            "counters": {}, "gauges": {}, "histograms": {},
+            "timeseries": {}}
+        with self._lock:
+            items = list(self._series.items())
+        for (name, labels), s in sorted(items, key=lambda kv: kv[0]):
+            sdict = self._series_json(s)
+            if labels:
+                sdict["labels"] = dict(labels)
+            out["timeseries"][name + _label_suffix(labels)] = sdict
+        return out
+
+    def cumulative(self, name: str, **labels: str) -> Optional[dict]:
+        """All-windows run total for one series — the shim that keeps the
+        old cumulative view answerable from windowed data. Counters sum,
+        gauges report last/max, quantile series merge every window's
+        sketch into run-level p50/p95/p99."""
+        key = (name, _label_items(labels))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                return None
+            if s.kind == "counter":
+                return {"kind": "counter",
+                        "value": sum(w.value for w in s.windows.values())}
+            if s.kind == "gauge":
+                if not s.windows:
+                    return {"kind": "gauge", "value": 0.0}
+                last = s.windows[max(s.windows)]
+                return {"kind": "gauge", "value": last.value,
+                        "max": max(w.max for w in s.windows.values())}
+            merged = QuantileSketch(self.gamma)
+            for w in s.windows.values():
+                if w.sketch is not None:
+                    merged.merge(w.sketch)
+            out = {"kind": "quantile", "count": merged.count,
+                   "sum": merged.sum}
+            for qn, q in QUANTILES:
+                out[qn] = merged.quantile(q)
+            return out
+
+
+def merge_series(series_list) -> dict:
+    """Merge same-key series dicts (``snapshot()['timeseries']`` values)
+    window-by-window: counters sum, gauges keep the watermark, quantile
+    sketches merge exactly; per-window quantiles are recomputed on the
+    merged sketch. First interval wins on a layout mismatch, mirroring
+    the histogram rule in ``merge_snapshots``."""
+    series_list = [s for s in series_list if s is not None]
+    if not series_list:
+        return {}
+    first = series_list[0]
+    out = {"kind": first["kind"], "interval_s": first["interval_s"],
+           "windows": []}
+    if "labels" in first:
+        out["labels"] = dict(first["labels"])
+    evicted = late = 0
+    by_idx: Dict[int, dict] = {}
+    for s in series_list:
+        if (s["kind"] != first["kind"]
+                or abs(s["interval_s"] - first["interval_s"]) > 1e-12):
+            continue
+        evicted += int(s.get("evicted", 0))
+        late += int(s.get("late_dropped", 0))
+        for w in s["windows"]:
+            idx = int(w["idx"])
+            cur = by_idx.get(idx)
+            if cur is None:
+                by_idx[idx] = dict(w)
+            elif first["kind"] == "counter":
+                cur["value"] += w["value"]
+            elif first["kind"] == "gauge":
+                cur["value"] = max(cur["value"], w["value"])
+                cur["max"] = max(cur.get("max", cur["value"]),
+                                 w.get("max", w["value"]))
+            else:
+                merged = QuantileSketch.from_json(cur["sketch"])
+                merged.merge(QuantileSketch.from_json(w["sketch"]))
+                merged.sum = cur["sum"] + w["sum"]
+                cur["sketch"] = merged.to_json()
+                cur["count"] = merged.count
+                cur["sum"] = merged.sum
+                for qn, q in QUANTILES:
+                    cur[qn] = merged.quantile(q)
+    out["windows"] = [by_idx[i] for i in sorted(by_idx)]
+    if evicted:
+        out["evicted"] = evicted
+    if late:
+        out["late_dropped"] = late
+    return out
+
+
+#: process-wide default windowed registry — the serving engine and the
+#: replay harness both record here
+series = WindowedRegistry()
+
+
+def clear() -> None:
+    series.clear()
+
+
+def report_section() -> Optional[dict]:
+    """The RunReport ``timeline`` section; None while nothing windowed
+    has been recorded (offline drivers' reports stay unchanged)."""
+    snap = series.snapshot()["timeseries"]
+    if not snap:
+        return None
+    return {"interval_s": series.interval_s,
+            "capacity": series.capacity,
+            "series": snap}

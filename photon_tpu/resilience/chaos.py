@@ -100,6 +100,23 @@ hook points consult it:
   tear: truncates ``fleet-manifest.json`` to half its bytes (a kill
   mid-publish). ``read_fleet_manifest``'s crc gate must refuse the
   torn document; a router must never boot on guessed shard ownership.
+- ``should_kill_capture(record_idx)`` — serving/replay.py's traffic
+  recorder asks before flushing each capture record; a hit at the
+  configured ``capture_kill_at`` writes HALF the record's bytes and
+  raises ``SimulatedKill`` (fires once) — a recorder killed mid-append.
+  The capture reader must hold back the torn tail and report a typed
+  ``CAPTURE_TRUNCATED`` count, never parse a partial record.
+- ``replay_torn_capture(capture_path)`` — post-hoc variant of the same
+  failure: tears the final record of an on-disk capture file exactly
+  like ``torn_tail_write`` does for event shards.
+- ``replay_clock_skew(record_idx)`` — serving/replay.py's replayer asks
+  per record; returns the seconds of virtual-clock skew to add to the
+  record's recorded offset for the first ``replay_skew_records`` records
+  at/after ``replay_skew_from`` (a capture whose recorder clock drifted
+  or jumped). A NEGATIVE skew can drive a record's timestamp before the
+  replayer's current virtual now; the replayer must clamp it monotone
+  and count the clamp as typed ``CLOCK_SKEW_CLAMPED`` — a virtual clock
+  never runs backwards.
 
 Everything is counter-based off the installed config — two runs with the
 same config and workload inject identically. ``seed`` feeds the optional
@@ -204,6 +221,18 @@ class ChaosConfig:
     tenant_hot_loop: Optional[str] = None
     tenant_hot_loop_burst: int = 0
     tenant_hot_loop_total: int = 0
+    # traffic capture: record index whose append is killed midway — half
+    # the record's bytes land on disk, then SimulatedKill (fires once);
+    # the capture reader must hold the torn tail back as a typed
+    # CAPTURE_TRUNCATED count
+    capture_kill_at: Optional[int] = None
+    # traffic replay: add replay_skew_s of virtual-clock skew to the
+    # recorded offsets of the first replay_skew_records records at/after
+    # index replay_skew_from (0 records disables). Negative skew forces
+    # the replayer's monotone clamp (typed CLOCK_SKEW_CLAMPED).
+    replay_skew_s: float = 0.0
+    replay_skew_from: int = 0
+    replay_skew_records: int = 0
 
 
 class _State:
@@ -228,6 +257,7 @@ class _State:
         self.convert_kill_fired = False
         self.shard_slow_done = 0
         self.tenant_floods_done = 0
+        self.capture_kill_fired = False
 
 
 _active: Optional[_State] = None
@@ -681,6 +711,51 @@ def manifest_torn_write(fleet_dir: str) -> int:
     with open(path, "r+b") as f:
         f.truncate(size // 2)
     return size - size // 2
+
+
+def should_kill_capture(record_idx: int) -> bool:
+    """True exactly once when the traffic recorder is about to append
+    record ``record_idx`` and the installed config names that index —
+    the recorder writes HALF the record's bytes (flushed, no newline)
+    and raises ``SimulatedKill``, the torn tail a real kill mid-append
+    leaves. The capture reader must stop before it with a typed
+    ``CAPTURE_TRUNCATED`` count."""
+    s = _active
+    if s is None or s.config.capture_kill_at is None:
+        return False
+    with s.lock:
+        if s.capture_kill_fired:
+            return False
+        if s.config.capture_kill_at != record_idx:
+            return False
+        s.capture_kill_fired = True
+    return True
+
+
+def replay_clock_skew(record_idx: int) -> float:
+    """Seconds of virtual-clock skew to add to record ``record_idx``'s
+    recorded offset (0 when inactive / outside the configured record
+    range). Deterministic — the skewed replay is itself replayable. The
+    replayer must clamp any resulting non-monotone timestamp and count
+    it as typed ``CLOCK_SKEW_CLAMPED``."""
+    s = _active
+    if (s is None or s.config.replay_skew_records <= 0
+            or s.config.replay_skew_s == 0.0):
+        return 0.0
+    lo = s.config.replay_skew_from
+    if lo <= record_idx < lo + s.config.replay_skew_records:
+        return s.config.replay_skew_s
+    return 0.0
+
+
+def replay_torn_capture(capture_path: str) -> int:
+    """Tear the final record of an on-disk traffic capture: cut the file
+    mid-way through its last line (no trailing newline) — the post-hoc
+    twin of ``should_kill_capture``, for captures that already exist.
+    Returns the number of bytes removed. ``serving/replay.read_capture``
+    must consume every complete record before the tear and report the
+    partial tail as a typed ``CAPTURE_TRUNCATED`` count."""
+    return torn_tail_write(capture_path)
 
 
 def at_publish(op: str) -> None:

@@ -54,6 +54,19 @@ from photon_tpu.serving.programs import (
     export_program_bundle,
     load_program_bundle,
 )
+from photon_tpu.serving.replay import (
+    CaptureRecord,
+    CaptureWriter,
+    Replayer,
+    ReplayResult,
+    TrafficProfile,
+    VirtualClock,
+    generate,
+    read_capture,
+    record_capture,
+    stream_digest,
+    timeline_digest,
+)
 from photon_tpu.serving.scorer import MODES, get_scorer, warmup_scorers
 from photon_tpu.serving.tenants import MultiTenantEngine
 from photon_tpu.serving.swap import (
@@ -79,6 +92,8 @@ from photon_tpu.serving.types import (
 __all__ = [
     "BreakerConfig",
     "BucketLadder",
+    "CaptureRecord",
+    "CaptureWriter",
     "CoeffStoreConfig",
     "CircuitBreaker",
     "DeadlineConfig",
@@ -93,6 +108,8 @@ __all__ = [
     "MicroBatcher",
     "MultiTenantEngine",
     "QueueClosedError",
+    "Replayer",
+    "ReplayResult",
     "ScoreRequest",
     "ScoreResponse",
     "ServingConfig",
@@ -100,13 +117,20 @@ __all__ = [
     "SLOConfig",
     "SwapConfig",
     "SwapResult",
+    "TrafficProfile",
     "TwoTierCoeffStore",
+    "VirtualClock",
     "export_program_bundle",
+    "generate",
     "get_scorer",
     "load_program_bundle",
+    "read_capture",
+    "record_capture",
     "serving_report_section",
+    "stream_digest",
     "swap_from_dir",
     "swap_staged",
+    "timeline_digest",
     "verify_swap_manifest",
     "warmup_scorers",
     "write_swap_manifest",

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_tpu.obs.metrics import registry as _metrics
+from photon_tpu.obs.timeseries import series as _series
 from photon_tpu.resilience import chaos as _chaos
 from photon_tpu.resilience.failures import record_failure
 from photon_tpu.serving.batching import (
@@ -189,6 +190,10 @@ class ServingEngine:
     def _refuse(self, request: ScoreRequest, reason: FallbackReason,
                 detail: str = "") -> ScoreResponse:
         _metrics.counter("serving.degraded", reason=reason.value).inc()
+        # windowed + labeled: per-engine typed-degradation rate over time
+        # (the cumulative counter above stays as the run-total shim)
+        _series.counter("serving.degraded", reason=reason.value,
+                        **self.obs_labels).inc(self.clock())
         return ScoreResponse(
             request.uid, score=None, degraded=True,
             fallbacks=(Fallback(reason, detail=detail),))
@@ -199,6 +204,8 @@ class ServingEngine:
         deadline, queue past the reject threshold), else None (the
         response arrives from a later ``pump``)."""
         _metrics.counter("serving.requests").inc()
+        _series.counter("serving.requests",
+                        **self.obs_labels).inc(self.clock())
         if self._draining:
             return self._refuse(request, FallbackReason.SHUTTING_DOWN,
                                 detail=self._drain_reason or "draining")
@@ -355,6 +362,9 @@ class ServingEngine:
                     detail="circuit breaker shed"))
 
         responses = []
+        lat_series = _series.quantile("serving.latency", mode=mode,
+                                      **self.obs_labels)
+        resp_series = _series.counter("serving.responses", **self.obs_labels)
         for i, (pending, req) in enumerate(zip(items, requests)):
             fbs = tuple(fallbacks[i])
             responses.append(ScoreResponse(
@@ -367,6 +377,11 @@ class ServingEngine:
                                stage="queue").observe(q)
             _metrics.histogram("serving.latency_seconds", LATENCY_BUCKETS,
                                stage="total").observe(q + t_assemble + t_score)
+            # per-label windowed quantiles: THIS engine's latency in THIS
+            # window, so tenant/shard tails never pollute each other the
+            # way the process-global histograms above do
+            lat_series.observe(t_start, q + t_assemble + t_score)
+            resp_series.inc(t_start)
 
         _metrics.counter("serving.responses").inc(len(responses))
         _metrics.counter("serving.batches", bucket=str(bucket),

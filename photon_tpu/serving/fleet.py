@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from photon_tpu.obs.metrics import merge_snapshots, registry as _metrics
+from photon_tpu.obs.timeseries import series as _series
 from photon_tpu.resilience import chaos
 from photon_tpu.serving.engine import LATENCY_BUCKETS, ServingEngine
 from photon_tpu.serving.model_state import DeviceResidentModel
@@ -158,8 +159,12 @@ class _ShardStats:
     """Router-side per-shard window: qps, latency quantiles, counts, and
     a LATENCY_BUCKETS histogram (snapshot-shaped for merge_snapshots)."""
 
-    def __init__(self, window: int):
+    def __init__(self, window: int, shard_id: int = -1, clock=None):
         self.lock = threading.Lock()
+        # injectable clock (the fleet's): windows/qps spans computed on a
+        # virtual clock replay the same way every run
+        self.clock = clock or time.monotonic
+        self.shard_id = int(shard_id)
         self.requests = 0
         self.unavailable = 0
         self.hedges = 0
@@ -171,13 +176,18 @@ class _ShardStats:
     def record(self, seconds: float, n_requests: int) -> None:
         with self.lock:
             self.requests += n_requests
-            now = time.monotonic()
+            now = self.clock()
             for _ in range(n_requests):
                 self.lat.append(seconds)
                 self.times.append(now)
             self.bucket_counts[int(np.searchsorted(
                 LATENCY_BUCKETS, seconds))] += n_requests
             self.lat_sum += seconds * n_requests
+        shard = str(self.shard_id)
+        _series.quantile("fleet.shard.latency", shard=shard).observe(
+            now, seconds)
+        _series.counter("fleet.shard.responses", shard=shard).inc(
+            now, n_requests)
 
     def view(self) -> dict:
         with self.lock:
@@ -235,7 +245,7 @@ def _load_base(manifest: dict, model_dir: Optional[str] = None):
 
 def build_front_engine(manifest: dict, config: FleetConfig,
                        model_dir: Optional[str] = None,
-                       base=None) -> ServingEngine:
+                       base=None, clock=None) -> ServingEngine:
     """Fixed-effects-only engine — the replicated front every router
     instance scores locally before fanning random effects out."""
     from photon_tpu.io.model_io import ServingGameModel
@@ -248,14 +258,14 @@ def build_front_engine(manifest: dict, config: FleetConfig,
                                    base.index_maps, base.metadata)
     return ServingEngine(
         DeviceResidentModel(front_model, feature_pad=front_cfg.feature_pad),
-        front_cfg, obs_labels={"shard": "front"})
+        front_cfg, clock=clock, obs_labels={"shard": "front"})
 
 
 def build_shard_engine(fleet_dir: str, shard_id: int,
                        serving: Optional[ServingConfig] = None,
                        manifest: Optional[dict] = None,
                        model_dir: Optional[str] = None,
-                       base=None) -> ServingEngine:
+                       base=None, clock=None) -> ServingEngine:
     """Random-effects-only engine over ONE shard's split cold stores —
     the unit a shard host runs (``cli/serve --fleet-manifest --shard-id``
     boots exactly this)."""
@@ -283,7 +293,7 @@ def build_shard_engine(fleet_dir: str, shard_id: int,
     return ServingEngine(
         DeviceResidentModel(m, feature_pad=serving.feature_pad,
                             coeff_store=serving.coeff_store),
-        serving, obs_labels={"shard": str(shard_id)})
+        serving, clock=clock, obs_labels={"shard": str(shard_id)})
 
 
 class ShardedServingFleet:
@@ -294,11 +304,18 @@ class ShardedServingFleet:
     def __init__(self, front: ServingEngine,
                  clients: Sequence[LocalShardClient],
                  coordinates: Sequence[Tuple[str, str]],
-                 config: Optional[FleetConfig] = None):
+                 config: Optional[FleetConfig] = None,
+                 clock=None):
         """``coordinates`` is the model-order list of
         (coordinate_id, random_effect_type) the fleet routes — the order
         fixes the float accumulation chain, so it must match the
-        single-host model's ``random`` order."""
+        single-host model's ``random`` order.
+
+        ``clock`` (None = ``time.monotonic``) drives request deadlines
+        and per-shard stats timestamps, so a replay on a virtual clock
+        is wall-clock-independent at the router too. Hedge racing in
+        ``_supervised_call`` deliberately stays on the wall clock — it
+        supervises REAL thread liveness, which no virtual clock can."""
         self.front = front
         self.clients = list(clients)
         self.num_shards = len(self.clients)
@@ -306,7 +323,10 @@ class ShardedServingFleet:
             raise ValueError("fleet needs at least one shard")
         self.coordinates = list(coordinates)
         self.config = config or FleetConfig()
-        self._stats = {c.shard_id: _ShardStats(self.config.stats_window)
+        self.clock = clock or time.monotonic
+        self._stats = {c.shard_id: _ShardStats(self.config.stats_window,
+                                               shard_id=c.shard_id,
+                                               clock=self.clock)
                        for c in self.clients}
         self._by_id = {c.shard_id: c for c in self.clients}
         # supervisors (<= shards) + two attempts each can be in flight
@@ -321,26 +341,29 @@ class ShardedServingFleet:
     def from_fleet_dir(cls, fleet_dir: str,
                        config: Optional[FleetConfig] = None,
                        model_dir: Optional[str] = None,
+                       clock=None,
                        ) -> "ShardedServingFleet":
         """Build the whole fleet from a split directory
         (`io/fleet_store.build_fleet_dir`): front engine from the source
         model's fixed effects, one shard engine per manifest shard over
         its per-shard cold stores. Refuses a torn/corrupt manifest
-        (``FleetManifestError``) — routing never boots on guesses."""
+        (``FleetManifestError``) — routing never boots on guesses.
+        ``clock`` threads one injectable clock through the router, the
+        front engine, and every shard engine (replay determinism)."""
         from photon_tpu.io.fleet_store import read_fleet_manifest
 
         config = config or FleetConfig()
         manifest = read_fleet_manifest(fleet_dir)
         base, ordered = _load_base(manifest, model_dir)
-        front = build_front_engine(manifest, config, base=base)
+        front = build_front_engine(manifest, config, base=base, clock=clock)
         clients = [
             LocalShardClient(sh["shard_id"], build_shard_engine(
                 fleet_dir, sh["shard_id"], config.serving,
-                manifest=manifest, base=base))
+                manifest=manifest, base=base, clock=clock))
             for sh in manifest["shards"]]
         coords = [(re.coordinate_id, re.random_effect_type)
                   for re in ordered]
-        return cls(front, clients, coords, config)
+        return cls(front, clients, coords, config, clock=clock)
 
     # ---------------------------------------------------------- routing
 
@@ -383,7 +406,7 @@ class ShardedServingFleet:
     def serve(self, requests: Sequence[ScoreRequest]
               ) -> List[ScoreResponse]:
         cfg = self.config
-        t_in = time.monotonic()
+        t_in = self.clock()
         deadlines = [t_in + r.timeout_s if r.timeout_s is not None else None
                      for r in requests]
         # fixed effects local: ids stripped (the front model has no
@@ -418,7 +441,7 @@ class ShardedServingFleet:
             futs = {}
             for shard, members in groups.items():
                 subreqs, idxs, budget = [], [], None
-                now = time.monotonic()
+                now = self.clock()
                 for i, ids in members:
                     remaining = None if deadlines[i] is None \
                         else deadlines[i] - now
@@ -442,6 +465,9 @@ class ShardedServingFleet:
                         st.unavailable += len(idxs)
                     _metrics.counter("fleet.shard_unavailable",
                                      shard=str(shard)).inc(len(idxs))
+                    _series.counter("fleet.shard.unavailable",
+                                    shard=str(shard)).inc(self.clock(),
+                                                          len(idxs))
                     for i in idxs:
                         fallbacks[i].append(Fallback(
                             FallbackReason.SHARD_UNAVAILABLE, None,
@@ -463,6 +489,8 @@ class ShardedServingFleet:
                             st.unavailable += 1
                         _metrics.counter("fleet.shard_unavailable",
                                          shard=str(shard)).inc()
+                        _series.counter("fleet.shard.unavailable",
+                                        shard=str(shard)).inc(self.clock())
                     else:
                         totals[i] = np.float32(resp.score)
             depth += 1

@@ -1,0 +1,659 @@
+"""Traffic capture and deterministic replay for the serving stack.
+
+Three pieces:
+
+* **Recorder** (:class:`CaptureWriter` / :func:`read_capture`) — an
+  append-only JSONL capture of admitted requests. Each line carries a
+  monotone sequence number, the request's VIRTUAL-clock offset from
+  capture start, the request in the exact wire shape
+  ``ScoreRequest.from_json`` accepts, and a crc32 frame over the
+  record's canonical bytes. The reader is torn-tail tolerant the same
+  way ``nearline/events.py`` is: a record whose final line is
+  incomplete (a recorder killed mid-append — ``chaos.capture_kill_at``
+  or :func:`chaos.replay_torn_capture`) is held back and reported as a
+  typed ``CAPTURE_TRUNCATED`` count, never parsed or guessed at.
+
+* **Generators** (:class:`TrafficProfile` / :func:`generate`) —
+  counter-derived synthetic traffic at millions-of-entities scale.
+  Entity choice is Zipf-skewed (inverse-CDF on a splitmix64 stream, so
+  an "entities=10_000_000" profile costs O(n_requests), not O(entities));
+  the arrival rate is shaped per profile kind: constant (``zipf``),
+  sinusoidal (``diurnal``), step (``burst``), or a ramping flash crowd
+  that also CONCENTRATES traffic onto a hot entity subset
+  (``flash_crowd``). Everything is integer/float arithmetic off
+  splitmix64 counters — no RNG object, no platform-dependent library
+  sampling — so identical (seed, profile) is bitwise-identical request
+  streams, across runs and across hosts. ``stream_digest`` pins that.
+
+* **Replayer** (:class:`Replayer`) — drives any engine kind on an
+  injectable :class:`VirtualClock`. Targets with an async admission
+  protocol (``submit``/``pump``: ServingEngine, MultiTenantEngine) get
+  per-record virtual arrival: the clock advances to each record's
+  offset, the request is submitted, and micro-batches form exactly as
+  the coalescing rules dictate in virtual time. Serve-only targets
+  (ShardedServingFleet) get tick-grouped arrivals. Scheduled actions
+  (kill a shard, publish a model) fire when the virtual clock crosses
+  their time, so an incident scenario replays identically run to run.
+  Per-request latency is accounted in VIRTUAL time (completion minus
+  arrival on the virtual clock) into windowed ``replay.*`` series —
+  which is what makes two replays of one capture produce identical
+  qps/p99 timelines, something wall-clock latencies can never do.
+
+``chaos.replay_clock_skew`` injects per-record recorded-offset skew;
+the replayer clamps any resulting non-monotone timestamp (a virtual
+clock never runs backwards) and reports the clamps as a typed
+``CLOCK_SKEW_CLAMPED`` count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import threading
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from photon_tpu.obs.metrics import registry as _metrics
+from photon_tpu.obs.timeseries import WindowedRegistry, series as _series
+from photon_tpu.resilience import chaos as _chaos
+from photon_tpu.serving.types import ScoreRequest, ScoreResponse
+
+__all__ = [
+    "CAPTURE_SCHEMA",
+    "CaptureRecord",
+    "CaptureWriter",
+    "Replayer",
+    "ReplayResult",
+    "TrafficProfile",
+    "VirtualClock",
+    "generate",
+    "read_capture",
+    "stream_digest",
+    "timeline_digest",
+]
+
+CAPTURE_SCHEMA = "photon_tpu.capture.v1"
+
+#: typed accounting keys (mirrors FallbackReason's style: string values
+#: that land verbatim in counters and result dicts)
+CAPTURE_TRUNCATED = "capture_truncated"
+CLOCK_SKEW_CLAMPED = "clock_skew_clamped"
+
+
+class VirtualClock:
+    """Injectable monotone clock for deterministic replay.
+
+    Drop-in for the ``clock`` seams that already exist across serving
+    (``MicroBatcher``, ``CircuitBreaker``, swap probation,
+    ``ShardedServingFleet``): calling the instance returns virtual
+    seconds. Time only moves via ``advance``/``advance_to`` — never by
+    itself — so everything driven by it is wall-clock-independent."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+        self._lock = threading.Lock()
+
+    def __call__(self) -> float:
+        return self._now
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError(f"virtual clock cannot go backwards (dt={dt})")
+        with self._lock:
+            self._now += float(dt)
+            return self._now
+
+    def advance_to(self, t: float) -> float:
+        """Move to ``t`` if it is in the future; no-op otherwise (the
+        monotone clamp callers rely on under injected skew)."""
+        with self._lock:
+            if t > self._now:
+                self._now = float(t)
+            return self._now
+
+
+# --------------------------------------------------------------------------
+# capture: crc32-framed append-only JSONL
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CaptureRecord:
+    seq: int
+    t: float                     # virtual-clock offset from capture start
+    request: ScoreRequest
+
+
+def _request_wire(req: ScoreRequest) -> dict:
+    """The exact shape ``ScoreRequest.from_json`` round-trips."""
+    out: Dict[str, object] = {
+        "uid": req.uid,
+        "features": {sid: [[n, term, v] for n, term, v in rows]
+                     for sid, rows in req.features.items()},
+        "ids": dict(req.entity_ids),
+        "offset": req.offset,
+    }
+    if req.timeout_s is not None:
+        out["timeout_ms"] = req.timeout_s * 1000.0
+    if req.tenant is not None:
+        out["tenant"] = req.tenant
+    return out
+
+
+def _frame(record: dict) -> bytes:
+    """One capture line: the record plus a crc32 over its canonical
+    (sorted-key, tight-separator) JSON bytes — the same envelope idiom
+    the nearline checkpoints use."""
+    body = json.dumps(record, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    record = dict(record)
+    record["crc"] = zlib.crc32(body) & 0xFFFFFFFF
+    return json.dumps(record, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8") + b"\n"
+
+
+def _check_frame(obj: dict) -> bool:
+    crc = obj.pop("crc", None)
+    if crc is None:
+        return False
+    body = json.dumps(obj, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return (zlib.crc32(body) & 0xFFFFFFFF) == crc
+
+
+class CaptureWriter:
+    """Append-only traffic recorder. ``append`` flushes+fsyncs per
+    record (the event-log durability contract: a record either fully
+    exists or is a detectable torn tail, never a silent half)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._f = open(self.path, "ab")
+        self.seq = 0
+
+    def append(self, t: float, request: ScoreRequest) -> int:
+        record = {"schema": CAPTURE_SCHEMA, "seq": self.seq,
+                  "t": float(t), "req": _request_wire(request)}
+        line = _frame(record)
+        if _chaos.should_kill_capture(self.seq):
+            # a kill mid-append: half the bytes land, no newline
+            self._f.write(line[:max(1, len(line) // 2)])
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._f.close()
+            raise _chaos.SimulatedKill(
+                f"chaos: capture writer killed mid-append of record "
+                f"{self.seq}")
+        self._f.write(line)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self.seq += 1
+        _metrics.counter("replay.capture_records").inc()
+        return self.seq - 1
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self) -> "CaptureWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def record_capture(path: str, records: Sequence[Tuple[float, ScoreRequest]]
+                   ) -> int:
+    """Record a whole (t, request) stream; returns records written."""
+    with CaptureWriter(path) as w:
+        for t, req in records:
+            w.append(t, req)
+        return w.seq
+
+
+def read_capture(path: str) -> Tuple[List[CaptureRecord], dict]:
+    """Read a capture, holding back the torn tail.
+
+    Returns ``(records, stats)`` where stats carries the typed counts:
+    ``capture_truncated`` (1 when the final record is incomplete or
+    fails its crc/parse — the mid-append kill shape; also counted into
+    the ``replay.capture_truncated`` registry counter) and
+    ``bad_records`` (interior lines that fail parse/crc — skipped,
+    like the event reader's interior-corruption handling)."""
+    records: List[CaptureRecord] = []
+    stats = {CAPTURE_TRUNCATED: 0, "bad_records": 0}
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except FileNotFoundError:
+        return records, stats
+
+    if not data:
+        return records, stats
+    complete = data.endswith(b"\n")
+    lines = data.split(b"\n")
+    if lines and lines[-1] == b"":
+        lines.pop()
+    tail_torn = not complete
+    if tail_torn and lines:
+        lines.pop()                      # the partial final line
+    n = len(lines)
+    for i, line in enumerate(lines):
+        ok = False
+        try:
+            obj = json.loads(line)
+            if _check_frame(dict(obj)):
+                records.append(CaptureRecord(
+                    seq=int(obj["seq"]), t=float(obj["t"]),
+                    request=ScoreRequest.from_json(obj["req"])))
+                ok = True
+        except (ValueError, KeyError, TypeError):
+            ok = False
+        if not ok:
+            if i == n - 1:
+                # an unparseable FINAL complete record is indistinguishable
+                # from a torn append whose newline made it out: held back
+                # as truncation, same as the event reader
+                tail_torn = True
+            else:
+                stats["bad_records"] += 1
+    if tail_torn:
+        stats[CAPTURE_TRUNCATED] = 1
+        _metrics.counter("replay.capture_truncated").inc()
+    return records, stats
+
+
+# --------------------------------------------------------------------------
+# synthetic traffic: counter-derived, bitwise deterministic
+# --------------------------------------------------------------------------
+
+_U64 = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """Pure-integer splitmix64 (same finalizer the streaming shuffle
+    uses) — platform-independent, no RNG object state."""
+    x = (x + 0x9E3779B97F4A7C15) & _U64
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
+    return (z ^ (z >> 31)) & _U64
+
+
+def _u(seed: int, stream: str, i: int) -> float:
+    """Uniform in (0, 1): splitmix64 over (seed, named stream, counter).
+    Never exactly 0 (log-safe) or 1."""
+    key = (seed * 0x9E3779B97F4A7C15
+           + zlib.crc32(stream.encode()) * 0xD1342543DE82EF95 + i) & _U64
+    return (_splitmix64(key) + 1) / (2.0 ** 64 + 2)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficProfile:
+    """One synthetic traffic shape. Frozen: the profile (with the seed)
+    IS the identity of the stream — the bitwise-determinism contract is
+    ``generate(profile, seed)`` equal byte for byte, run to run.
+
+    ``kind`` shapes the arrival RATE; entity skew is always Zipf:
+
+      * ``zipf``        — constant ``base_qps``
+      * ``diurnal``     — ``base_qps * (1 + amp * sin(2π t / period))``
+      * ``burst``       — ``base_qps * burst_factor`` inside
+                          ``[burst_at_s, burst_at_s + burst_len_s)``
+      * ``flash_crowd`` — rate ramps to ``flash_factor ×`` over
+                          ``flash_ramp_s`` from ``flash_at_s`` AND
+                          traffic concentrates onto the hottest
+                          ``flash_entity_frac`` of the entity space
+    """
+
+    kind: str = "zipf"
+    n_requests: int = 1000
+    #: entity-space size — a modulus, not an allocation: 10M is free
+    entities: int = 1_000_000
+    zipf_a: float = 1.5
+    base_qps: float = 1000.0
+    feature_dim: int = 8
+    nnz: int = 4
+    feature_shard: str = "g"
+    re_type: str = "userId"
+    entity_format: str = "e{:09d}"
+    timeout_ms: Optional[float] = None
+    tenant: Optional[str] = None
+    # diurnal
+    diurnal_period_s: float = 60.0
+    diurnal_amplitude: float = 0.5
+    # burst
+    burst_at_s: float = 2.0
+    burst_len_s: float = 2.0
+    burst_factor: float = 4.0
+    # flash crowd
+    flash_at_s: float = 2.0
+    flash_ramp_s: float = 2.0
+    flash_factor: float = 8.0
+    flash_entity_frac: float = 1e-4
+
+    def __post_init__(self):
+        if self.kind not in ("zipf", "diurnal", "burst", "flash_crowd"):
+            raise ValueError(f"unknown traffic kind {self.kind!r}")
+        if self.zipf_a <= 1.0:
+            raise ValueError("zipf_a must be > 1")
+        if self.n_requests < 1 or self.entities < 1 or self.base_qps <= 0:
+            raise ValueError("n_requests/entities/base_qps must be positive")
+
+    def rate(self, t: float) -> float:
+        if self.kind == "diurnal":
+            return self.base_qps * max(
+                1e-6, 1.0 + self.diurnal_amplitude
+                * math.sin(2.0 * math.pi * t / self.diurnal_period_s))
+        if self.kind == "burst":
+            in_burst = self.burst_at_s <= t < self.burst_at_s \
+                + self.burst_len_s
+            return self.base_qps * (self.burst_factor if in_burst else 1.0)
+        if self.kind == "flash_crowd":
+            ramp = min(max((t - self.flash_at_s) / self.flash_ramp_s, 0.0),
+                       1.0)
+            return self.base_qps * (1.0 + (self.flash_factor - 1.0) * ramp)
+        return self.base_qps
+
+
+def _zipf_rank(u: float, a: float) -> int:
+    """Inverse-CDF Zipf over an unbounded rank space: rank 1 is the
+    hottest entity. Power-law tail ``P(rank > r) ~ r^(1-a)``."""
+    return int(u ** (-1.0 / (a - 1.0)))
+
+
+def generate(profile: TrafficProfile, seed: int
+             ) -> List[Tuple[float, ScoreRequest]]:
+    """The bitwise-deterministic stream: ``[(t, request), ...]`` with
+    strictly increasing ``t`` (exponential inter-arrivals under the
+    profile's rate shape)."""
+    out: List[Tuple[float, ScoreRequest]] = []
+    t = 0.0
+    hot = max(1, int(profile.entities * profile.flash_entity_frac))
+    for i in range(profile.n_requests):
+        rate = profile.rate(t)
+        t += -math.log(_u(seed, "arrival", i)) / rate
+        # entity: Zipf rank folded into the entity space
+        ue = _u(seed, "entity", i)
+        idx = (_zipf_rank(ue, profile.zipf_a) - 1) % profile.entities
+        if profile.kind == "flash_crowd" and t >= profile.flash_at_s:
+            ramp = min((t - profile.flash_at_s) / profile.flash_ramp_s, 1.0)
+            if _u(seed, "flash", i) < 0.9 * ramp:
+                idx = int(_u(seed, "flash_pick", i) * hot) % hot
+        eid = profile.entity_format.format(idx)
+        # features: nnz DISTINCT (index, gaussian value) pairs, Box-Muller
+        # off the counter streams — library-free, so bitwise across
+        # platforms (distinct: the assembler's slot packing expects one
+        # column per feature per request)
+        rows = []
+        used = set()
+        for j in range(min(profile.nnz, profile.feature_dim)):
+            fidx = int(_u(seed, f"feat{j}", i) * profile.feature_dim) \
+                % profile.feature_dim
+            while fidx in used:
+                fidx = (fidx + 1) % profile.feature_dim
+            used.add(fidx)
+            u1 = _u(seed, f"val_a{j}", i)
+            u2 = _u(seed, f"val_b{j}", i)
+            val = math.sqrt(-2.0 * math.log(u1)) \
+                * math.cos(2.0 * math.pi * u2)
+            rows.append((f"f{fidx}", "", val))
+        req = ScoreRequest(
+            uid=f"r{i:08d}",
+            features={profile.feature_shard: rows},
+            entity_ids={profile.re_type: eid},
+            timeout_s=(profile.timeout_ms / 1000.0
+                       if profile.timeout_ms is not None else None),
+            tenant=profile.tenant)
+        out.append((t, req))
+    return out
+
+
+def stream_digest(records: Sequence[Tuple[float, ScoreRequest]]) -> str:
+    """crc32 chain over the stream's canonical bytes — the cheap bitwise
+    identity two generated (or captured) streams are compared by."""
+    crc = 0
+    for t, req in records:
+        body = json.dumps({"t": t, "req": _request_wire(req)},
+                          sort_keys=True,
+                          separators=(",", ":")).encode("utf-8")
+        crc = zlib.crc32(body, crc)
+    return f"{crc & 0xFFFFFFFF:08x}"
+
+
+# --------------------------------------------------------------------------
+# replay
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ReplayResult:
+    requests: int = 0
+    responses: int = 0
+    refusals: int = 0
+    degraded: int = 0
+    clock_skew_clamped: int = 0
+    virtual_seconds: float = 0.0
+    #: crc32 chain over (uid, repr(score), sorted fallback reasons) in
+    #: completion order — bitwise identity of the replay's OUTPUT
+    response_digest: str = "00000000"
+    degraded_reasons: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Replayer:
+    """Deterministic replay of a (t, request) stream into a target.
+
+    ``target`` is either an async engine (``submit``/``pump``/``drain``:
+    ServingEngine, MultiTenantEngine) or a serve-only router
+    (ShardedServingFleet). The target must have been built on the SAME
+    ``clock`` instance passed here — the existing injectable-clock seams
+    (MicroBatcher coalescing, breaker cooldowns, swap probation, fleet
+    deadlines) then all advance in virtual time and the whole replay is
+    wall-clock-independent.
+
+    ``actions`` to :meth:`run` is a list of ``(t, callable)`` incident
+    hooks (kill a shard, publish a model, flip chaos) fired exactly when
+    the virtual clock first reaches ``t``.
+    """
+
+    def __init__(self, target, clock: VirtualClock,
+                 registry: Optional[WindowedRegistry] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 tick_s: float = 0.05):
+        if tick_s <= 0:
+            raise ValueError("tick_s must be positive")
+        self.target = target
+        self.clock = clock
+        self.registry = registry if registry is not None else _series
+        self.labels = dict(labels or {})
+        self.tick_s = float(tick_s)
+        self._async = hasattr(target, "submit") and hasattr(target, "pump")
+
+    # -- telemetry helpers ------------------------------------------------
+
+    def _observe(self, resp: ScoreResponse, t_arrival: Optional[float],
+                 t_done: float, result: ReplayResult, crc: int) -> int:
+        reg = self.registry
+        result.responses += 1
+        reg.counter("replay.responses", **self.labels).inc(t_done)
+        if t_arrival is not None:
+            reg.quantile("replay.latency", **self.labels).observe(
+                t_done, max(t_done - t_arrival, 0.0))
+        reasons = sorted({f.reason.value for f in resp.fallbacks})
+        if resp.degraded or resp.score is None:
+            result.degraded += 1
+            for r in reasons:
+                result.degraded_reasons[r] = \
+                    result.degraded_reasons.get(r, 0) + 1
+                reg.counter("replay.degraded", reason=r,
+                            **self.labels).inc(t_done)
+        if resp.score is None:
+            result.refusals += 1
+        body = f"{resp.uid}|{resp.score!r}|{','.join(reasons)}".encode()
+        return zlib.crc32(body, crc)
+
+    # -- main entry -------------------------------------------------------
+
+    def run(self, records: Sequence, actions: Sequence[Tuple[float,
+            Callable[[], None]]] = ()) -> ReplayResult:
+        """Replay ``records`` — either ``CaptureRecord``s or plain
+        ``(t, request)`` pairs — against the target."""
+        norm: List[Tuple[int, float, ScoreRequest]] = []
+        for i, rec in enumerate(records):
+            if isinstance(rec, CaptureRecord):
+                norm.append((rec.seq, rec.t, rec.request))
+            else:
+                t, req = rec
+                norm.append((i, float(t), req))
+        pending_actions = sorted(actions, key=lambda a: a[0])
+        result = ReplayResult(requests=len(norm))
+        t0 = self.clock.now()
+        if self._async:
+            crc = self._run_async(norm, pending_actions, result)
+        else:
+            crc = self._run_sync(norm, pending_actions, result)
+        result.response_digest = f"{crc & 0xFFFFFFFF:08x}"
+        result.virtual_seconds = self.clock.now() - t0
+        if result.clock_skew_clamped:
+            _metrics.counter("replay.clock_skew_clamped").inc(
+                result.clock_skew_clamped)
+        return result
+
+    def _fire_actions(self, pending: List[Tuple[float, Callable]],
+                      upto: float) -> None:
+        while pending and pending[0][0] <= upto:
+            t_act, fn = pending.pop(0)
+            self.clock.advance_to(t_act)
+            fn()
+
+    def _arrival_time(self, seq: int, t: float, base: float,
+                      result: ReplayResult) -> float:
+        """Record offset -> absolute virtual time, with injected skew
+        applied and the monotone clamp (typed) enforced."""
+        t_abs = base + t + _chaos.replay_clock_skew(seq)
+        now = self.clock.now()
+        if t_abs < now:
+            result.clock_skew_clamped += 1
+            self.registry.counter("replay.clock_skew_clamped",
+                                  **self.labels).inc(now)
+            return now
+        return t_abs
+
+    def _run_async(self, norm, pending_actions, result: ReplayResult) -> int:
+        target, reg = self.target, self.registry
+        base = self.clock.now()
+        submits: Dict[str, List[float]] = {}
+        crc = 0
+        for seq, t, req in norm:
+            t_abs = self._arrival_time(seq, t, base, result)
+            self._fire_actions(pending_actions, t_abs)
+            self.clock.advance_to(t_abs)
+            reg.counter("replay.requests", **self.labels).inc(t_abs)
+            submits.setdefault(req.uid, []).append(t_abs)
+            refusal = target.submit(req)
+            if refusal is not None:
+                submits[req.uid].pop()
+                crc = self._observe(refusal, t_abs, t_abs, result, crc)
+            while True:
+                got = target.pump()
+                if not got:
+                    break
+                t_done = self.clock.now()
+                for resp in got:
+                    ts = submits.get(resp.uid)
+                    t_arr = ts.pop(0) if ts else None
+                    crc = self._observe(resp, t_arr, t_done, result, crc)
+        # drain: step virtual time forward so coalescing windows expire,
+        # then flush whatever remains
+        self._fire_actions(pending_actions, float("inf"))
+        for _ in range(64):
+            self.clock.advance(self.tick_s)
+            got = target.pump()
+            while got:
+                t_done = self.clock.now()
+                for resp in got:
+                    ts = submits.get(resp.uid)
+                    t_arr = ts.pop(0) if ts else None
+                    crc = self._observe(resp, t_arr, t_done, result, crc)
+                got = target.pump()
+            if not self._target_depth():
+                break
+        if self._target_depth() and hasattr(target, "drain"):
+            t_done = self.clock.now()
+            for resp in target.drain():
+                ts = submits.get(resp.uid)
+                t_arr = ts.pop(0) if ts else None
+                crc = self._observe(resp, t_arr, t_done, result, crc)
+        return crc
+
+    def _target_depth(self) -> int:
+        batcher = getattr(self.target, "batcher", None)
+        if batcher is not None:
+            return batcher.depth()
+        depth_fn = getattr(self.target, "depth", None)
+        if callable(depth_fn):
+            try:
+                return int(depth_fn())
+            except Exception:
+                return 0
+        return 0
+
+    def _run_sync(self, norm, pending_actions, result: ReplayResult) -> int:
+        """Serve-only targets (the fleet router): arrivals grouped into
+        ``tick_s`` ticks, each tick served synchronously at its virtual
+        end time; per-request latency = tick end − arrival (queueing
+        delay in virtual time — the synchronous service itself is
+        instantaneous on the virtual clock)."""
+        target, reg = self.target, self.registry
+        base = self.clock.now()
+        crc = 0
+        i = 0
+        n = len(norm)
+        while i < n:
+            seq, t, req = norm[i]
+            t_abs = self._arrival_time(seq, t, base, result)
+            tick_end = (math.floor((t_abs - base) / self.tick_s) + 1) \
+                * self.tick_s + base
+            batch: List[ScoreRequest] = []
+            arrivals: List[float] = []
+            while i < n:
+                seq, t, req = norm[i]
+                t_abs = self._arrival_time(seq, t, base, result)
+                if t_abs >= tick_end:
+                    break
+                reg.counter("replay.requests", **self.labels).inc(t_abs)
+                batch.append(req)
+                arrivals.append(t_abs)
+                i += 1
+            self._fire_actions(pending_actions, tick_end)
+            self.clock.advance_to(tick_end)
+            responses = target.serve(batch)
+            t_done = self.clock.now()
+            for resp, t_arr in zip(responses, arrivals):
+                crc = self._observe(resp, t_arr, t_done, result, crc)
+        self._fire_actions(pending_actions, float("inf"))
+        return crc
+
+
+def timeline_digest(snapshot: dict,
+                    prefixes: Tuple[str, ...] = ("replay.",)) -> str:
+    """crc32 over the canonical bytes of the snapshot's deterministic
+    timeline series (default: the ``replay.*`` family, whose counts AND
+    latencies live purely in virtual time). Series carrying wall-clock
+    durations (``serving.latency``, ``fleet.shard.latency``) are
+    excluded by default — their per-window counts replay identically
+    but their sketch contents are genuine measured seconds."""
+    ts = snapshot.get("timeseries", {})
+    picked = {k: v for k, v in sorted(ts.items())
+              if any(k.startswith(p) for p in prefixes)}
+    body = json.dumps(picked, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+    return f"{zlib.crc32(body) & 0xFFFFFFFF:08x}"

@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Bench-artifact schema guard + typed regression gate.
+
+Every ``BENCH_*.json`` the bench drivers commit is a machine contract:
+downstream sessions (and the replay SLO gates) read them blind. This
+script validates all of them against the two artifact schemas and, given
+a baseline, compares metric values under TYPED tolerance bands — each
+violation carries a type, a file, and the offending values, so a failed
+gate says exactly what regressed, never just "nonzero exit".
+
+Artifact schemas:
+
+  * **mode record** (``BENCH_SERVING_r01.json`` etc.): ``metric`` (str),
+    ``value`` (finite number), ``unit`` (str) — the record one
+    ``bench.py --mode X`` run emits.
+  * **run envelope** (``BENCH_r01.json``..): ``n`` (int), ``cmd`` (str),
+    ``rc`` (int) — the driver's wrapper around a full bench invocation;
+    ``parsed`` may be null.
+
+Tolerance bands (by unit, per-file overrides in ``KEY_METRICS``):
+
+  * ``fraction``       — absolute: new >= baseline - 0.02
+  * ``s`` (latency)    — lower-better: new <= baseline * (1 + 0.5)
+  * everything else    — higher-better: new >= baseline * (1 - 0.25)
+
+Violation types: ``SCHEMA_ERROR``, ``MISSING_BASELINE``,
+``METRIC_RENAMED``, ``REGRESSION_ABS``, ``REGRESSION_REL``,
+``HARD_FLOOR``.
+
+Wired into tier-1 via tests/test_bench_regression.py (including a
+negative test on a perturbed copy); also runnable standalone::
+
+    python scripts/check_bench_regression.py --all            # repo root
+    python scripts/check_bench_regression.py --all some/dir
+    python scripts/check_bench_regression.py --compare NEW.json \
+        --baseline OLD.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENVELOPE_RE = re.compile(r"^BENCH_r\d+\.json$")
+
+#: per-file gate table: expected metric name, direction, optional hard
+#: floor the committed artifact itself must clear (no baseline needed)
+KEY_METRICS = {
+    "BENCH_REPLAY_r01.json": {
+        "metric": "replay_harness_gates_passed",
+        "direction": "higher", "hard_floor": 1.0},
+    "BENCH_COLDTIER_r01.json": {
+        "metric": "coldtier_steady_hit_rate",
+        "direction": "higher", "hard_floor": 0.5},
+    "BENCH_TENANT_r01.json": {
+        "metric": "tenant_warmup_compile_ratio_8x_vs_1x",
+        "direction": "lower_equal", "hard_ceiling": 1.0},
+    "BENCH_FLEET_r01.json": {
+        "metric": "fleet_aggregate_qps_speedup", "direction": "higher"},
+    "BENCH_SERVING_r01.json": {
+        "metric": "serving_throughput_qps", "direction": "higher"},
+    "BENCH_NEARLINE_r01.json": {
+        "metric": "nearline_freshness_lag_p50", "direction": "lower"},
+}
+
+#: default relative band for higher-better metrics
+REL_TOL = 0.25
+#: absolute band for ``fraction`` metrics
+FRACTION_ABS_TOL = 0.02
+#: lower-better (latency) metrics may grow by at most this factor
+LOWER_REL_TOL = 0.5
+
+
+def _violation(vtype, path, detail, **extra):
+    v = {"type": vtype, "file": os.path.basename(str(path)),
+         "detail": detail}
+    v.update(extra)
+    return v
+
+
+def _is_finite_number(x):
+    return (isinstance(x, (int, float)) and not isinstance(x, bool)
+            and math.isfinite(x))
+
+
+def validate_artifact(path):
+    """Schema-validate one BENCH_*.json. Returns a violation list."""
+    name = os.path.basename(path)
+    out = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [_violation("SCHEMA_ERROR", path, f"unreadable: {e}")]
+    if not isinstance(doc, dict):
+        return [_violation("SCHEMA_ERROR", path,
+                           f"top level must be an object, got "
+                           f"{type(doc).__name__}")]
+
+    if ENVELOPE_RE.match(name):
+        for key, typ in (("n", int), ("rc", int), ("cmd", str)):
+            if not isinstance(doc.get(key), typ):
+                out.append(_violation(
+                    "SCHEMA_ERROR", path,
+                    f"envelope field {key!r} must be "
+                    f"{typ.__name__}, got {type(doc.get(key)).__name__}"))
+        return out
+
+    # mode record
+    if not isinstance(doc.get("metric"), str) or not doc.get("metric"):
+        out.append(_violation("SCHEMA_ERROR", path,
+                              "mode record needs a non-empty str 'metric'"))
+    if not _is_finite_number(doc.get("value")):
+        out.append(_violation(
+            "SCHEMA_ERROR", path,
+            f"mode record 'value' must be a finite number, got "
+            f"{doc.get('value')!r}"))
+    if not isinstance(doc.get("unit"), str) or not doc.get("unit"):
+        out.append(_violation("SCHEMA_ERROR", path,
+                              "mode record needs a non-empty str 'unit'"))
+    if out:
+        return out
+
+    gate = KEY_METRICS.get(name)
+    if gate is not None:
+        if doc["metric"] != gate["metric"]:
+            out.append(_violation(
+                "METRIC_RENAMED", path,
+                f"expected metric {gate['metric']!r}, found "
+                f"{doc['metric']!r}"))
+        elif "hard_floor" in gate and doc["value"] < gate["hard_floor"]:
+            out.append(_violation(
+                "HARD_FLOOR", path,
+                f"{doc['metric']} = {doc['value']} below hard floor "
+                f"{gate['hard_floor']}", value=doc["value"],
+                limit=gate["hard_floor"]))
+        elif "hard_ceiling" in gate and doc["value"] > gate["hard_ceiling"]:
+            out.append(_violation(
+                "HARD_FLOOR", path,
+                f"{doc['metric']} = {doc['value']} above hard ceiling "
+                f"{gate['hard_ceiling']}", value=doc["value"],
+                limit=gate["hard_ceiling"]))
+    return out
+
+
+def _direction(name, unit):
+    gate = KEY_METRICS.get(name)
+    if gate is not None:
+        d = gate["direction"]
+        return "lower" if d.startswith("lower") else "higher"
+    if unit == "s" or unit.endswith("seconds"):
+        return "lower"
+    return "higher"
+
+
+def compare_artifacts(new_path, baseline_path):
+    """Typed band comparison of two same-schema mode records."""
+    out = validate_artifact(new_path)
+    if out:
+        return out
+    name = os.path.basename(new_path)
+    try:
+        with open(baseline_path) as f:
+            base = json.load(f)
+    except (OSError, ValueError) as e:
+        return [_violation("MISSING_BASELINE", baseline_path,
+                           f"unreadable baseline: {e}")]
+    with open(new_path) as f:
+        new = json.load(f)
+    if ENVELOPE_RE.match(name):
+        if new.get("rc") != 0 and base.get("rc") == 0:
+            return [_violation("REGRESSION_ABS", new_path,
+                               f"envelope rc regressed "
+                               f"{base.get('rc')} -> {new.get('rc')}",
+                               value=new.get("rc"), baseline=base.get("rc"))]
+        return []
+    if not _is_finite_number(base.get("value")):
+        return [_violation("MISSING_BASELINE", baseline_path,
+                           "baseline has no finite 'value'")]
+    if new["metric"] != base.get("metric"):
+        return [_violation("METRIC_RENAMED", new_path,
+                           f"metric {base.get('metric')!r} -> "
+                           f"{new['metric']!r}")]
+    nv, bv = float(new["value"]), float(base["value"])
+    unit = new["unit"]
+    if unit == "fraction":
+        if nv < bv - FRACTION_ABS_TOL:
+            return [_violation(
+                "REGRESSION_ABS", new_path,
+                f"{new['metric']} fell {bv} -> {nv} "
+                f"(band: -{FRACTION_ABS_TOL} absolute)",
+                value=nv, baseline=bv, band=FRACTION_ABS_TOL)]
+        return []
+    if _direction(name, unit) == "lower":
+        limit = bv * (1.0 + LOWER_REL_TOL)
+        if nv > limit:
+            return [_violation(
+                "REGRESSION_REL", new_path,
+                f"{new['metric']} rose {bv} -> {nv} "
+                f"(band: +{LOWER_REL_TOL:.0%})",
+                value=nv, baseline=bv, band=LOWER_REL_TOL)]
+        return []
+    limit = bv * (1.0 - REL_TOL)
+    if nv < limit:
+        return [_violation(
+            "REGRESSION_REL", new_path,
+            f"{new['metric']} fell {bv} -> {nv} "
+            f"(band: -{REL_TOL:.0%})",
+            value=nv, baseline=bv, band=REL_TOL)]
+    return []
+
+
+def check_all(directory):
+    paths = sorted(glob.glob(os.path.join(directory, "BENCH_*.json")))
+    if not paths:
+        return [_violation("SCHEMA_ERROR", directory,
+                           "no BENCH_*.json artifacts found")], 0
+    violations = []
+    for p in paths:
+        violations.extend(validate_artifact(p))
+    return violations, len(paths)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--all", nargs="?", const=REPO, default=None,
+                    metavar="DIR",
+                    help="validate every BENCH_*.json in DIR "
+                         "(default: repo root)")
+    ap.add_argument("--compare", metavar="NEW",
+                    help="a new artifact to gate against --baseline")
+    ap.add_argument("--baseline", metavar="OLD",
+                    help="the committed artifact --compare is judged by")
+    args = ap.parse_args(argv)
+
+    if args.compare:
+        if not args.baseline:
+            ap.error("--compare requires --baseline")
+        violations = compare_artifacts(args.compare, args.baseline)
+        checked = 1
+    elif args.all is not None:
+        violations, checked = check_all(args.all)
+    else:
+        ap.error("pass --all [DIR] or --compare NEW --baseline OLD")
+        return 2
+
+    for v in violations:
+        print(f"VIOLATION {v['type']} {v['file']}: {v['detail']}")
+    if violations:
+        print(f"FAIL: {len(violations)} violation(s) across "
+              f"{checked} artifact(s)")
+        return 1
+    print(f"ok: {checked} bench artifact(s) within schema and bands")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,20 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache (repo-local, gitignored): the suite is
+# compile-bound on the 1-core CI host, and every run re-lowers the same
+# HLO. Caching executables across processes/runs keeps tier-1 inside its
+# wall budget without dropping tests. Semantics are untouched — the cache
+# is keyed on the HLO hash (same executable bytes, bitwise-same results)
+# and trace/compile COUNTS (jitcache, compile monitors) are unaffected;
+# only backend-compile wall time shrinks. Env vars (not jax.config) so
+# subprocess tests (cli/serve, bench --quick smokes) inherit it too.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.normpath(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "..", ".jax_compile_cache")))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
 import jax  # noqa: E402
 
 # The axon sitecustomize (TPU tunnel) force-sets jax_platforms="axon,cpu"

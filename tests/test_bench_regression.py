@@ -1,0 +1,159 @@
+"""Bench-artifact schema + regression gate tests
+(scripts/check_bench_regression.py).
+
+Tier-1 wiring for the gate: the committed BENCH_*.json artifacts must
+validate clean (positive), and the gate must fail LOUDLY — typed
+violation, nonzero exit — on a schema break or a perturbed metric value
+(negative, on copies in a tmpdir; the committed artifacts are never
+touched).
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_bench_regression.py")
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+import check_bench_regression as cbr  # noqa: E402
+
+
+def _run(*argv):
+    return subprocess.run([sys.executable, SCRIPT, *argv],
+                          capture_output=True, text=True, timeout=120)
+
+
+# -- positive: the committed artifacts are clean -----------------------------
+
+
+def test_committed_artifacts_validate_clean():
+    proc = _run("--all")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ok:" in proc.stdout
+    assert "VIOLATION" not in proc.stdout
+
+
+def test_self_compare_passes():
+    path = os.path.join(REPO, "BENCH_REPLAY_r01.json")
+    assert os.path.exists(path)
+    assert cbr.compare_artifacts(path, path) == []
+
+
+# -- negative: schema breaks are typed SCHEMA_ERROR --------------------------
+
+
+def test_schema_break_fails_loudly(tmp_path):
+    src = os.path.join(REPO, "BENCH_REPLAY_r01.json")
+    doc = json.load(open(src))
+    del doc["value"]
+    bad = tmp_path / "BENCH_REPLAY_r01.json"
+    bad.write_text(json.dumps(doc))
+    violations = cbr.validate_artifact(str(bad))
+    assert [v["type"] for v in violations] == ["SCHEMA_ERROR"]
+    proc = _run("--all", str(tmp_path))
+    assert proc.returncode == 1
+    assert "VIOLATION SCHEMA_ERROR" in proc.stdout
+
+
+def test_nonfinite_value_is_schema_error(tmp_path):
+    bad = tmp_path / "BENCH_X_r01.json"
+    bad.write_text('{"metric": "x", "value": NaN, "unit": "qps"}')
+    violations = cbr.validate_artifact(str(bad))
+    assert violations and violations[0]["type"] == "SCHEMA_ERROR"
+
+
+def test_envelope_schema_checked(tmp_path):
+    bad = tmp_path / "BENCH_r01.json"
+    bad.write_text('{"n": "three", "cmd": "x", "rc": 0}')
+    violations = cbr.validate_artifact(str(bad))
+    assert [v["type"] for v in violations] == ["SCHEMA_ERROR"]
+    ok = tmp_path / "BENCH_r02.json"
+    ok.write_text('{"n": 3, "cmd": "x", "rc": 0, "parsed": null}')
+    assert cbr.validate_artifact(str(ok)) == []
+
+
+# -- negative: value regressions are typed, banded ---------------------------
+
+
+def test_perturbed_fraction_regresses(tmp_path):
+    """The headline negative test: copy the committed replay artifact,
+    shrink its gate fraction, and the gate fails loudly and typed. For
+    the keyed replay artifact the HARD_FLOOR (must be exactly 1.0)
+    fires before any band; the absolute fraction band is exercised under
+    a non-keyed name."""
+    base = os.path.join(REPO, "BENCH_REPLAY_r01.json")
+    doc = json.load(open(base))
+    doc["value"] = doc["value"] - 0.5
+    new = tmp_path / "BENCH_REPLAY_r01.json"
+    new.write_text(json.dumps(doc))
+    proc = _run("--compare", str(new), "--baseline", base)
+    assert proc.returncode == 1
+    assert "VIOLATION HARD_FLOOR" in proc.stdout
+    assert "replay_harness_gates_passed" in proc.stdout
+    # absolute fraction band, no hard floor in the way
+    fb = tmp_path / "frac_base.json"
+    fb.write_text('{"metric": "hit_rate", "value": 0.9, '
+                  '"unit": "fraction"}')
+    fn = tmp_path / "BENCH_F_r01.json"
+    fn.write_text('{"metric": "hit_rate", "value": 0.8, '
+                  '"unit": "fraction"}')
+    violations = cbr.compare_artifacts(str(fn), str(fb))
+    assert [v["type"] for v in violations] == ["REGRESSION_ABS"]
+    # within the band: no violation
+    fn.write_text('{"metric": "hit_rate", "value": 0.89, '
+                  '"unit": "fraction"}')
+    assert cbr.compare_artifacts(str(fn), str(fb)) == []
+
+
+def test_hard_floor_enforced_without_baseline(tmp_path):
+    doc = json.load(open(os.path.join(REPO, "BENCH_REPLAY_r01.json")))
+    doc["value"] = 0.9
+    bad = tmp_path / "BENCH_REPLAY_r01.json"
+    bad.write_text(json.dumps(doc))
+    violations = cbr.validate_artifact(str(bad))
+    assert [v["type"] for v in violations] == ["HARD_FLOOR"]
+
+
+def test_metric_rename_detected(tmp_path):
+    base = os.path.join(REPO, "BENCH_SERVING_r01.json")
+    doc = json.load(open(base))
+    doc["metric"] = "serving_qps_v2"
+    new = tmp_path / "BENCH_WHATEVER_r01.json"
+    new.write_text(json.dumps(doc))
+    violations = cbr.compare_artifacts(str(new), base)
+    assert [v["type"] for v in violations] == ["METRIC_RENAMED"]
+
+
+def test_higher_better_relative_band(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text('{"metric": "qps", "value": 1000.0, "unit": "qps"}')
+    new = tmp_path / "BENCH_Q_r01.json"
+    new.write_text('{"metric": "qps", "value": 700.0, "unit": "qps"}')
+    violations = cbr.compare_artifacts(str(new), str(base))
+    assert [v["type"] for v in violations] == ["REGRESSION_REL"]
+    new.write_text('{"metric": "qps", "value": 800.0, "unit": "qps"}')
+    assert cbr.compare_artifacts(str(new), str(base)) == []
+
+
+def test_lower_better_latency_band(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text('{"metric": "lag", "value": 1.0, "unit": "s"}')
+    new = tmp_path / "BENCH_L_r01.json"
+    new.write_text('{"metric": "lag", "value": 2.0, "unit": "s"}')
+    violations = cbr.compare_artifacts(str(new), str(base))
+    assert [v["type"] for v in violations] == ["REGRESSION_REL"]
+    new.write_text('{"metric": "lag", "value": 1.4, "unit": "s"}')
+    assert cbr.compare_artifacts(str(new), str(base)) == []
+
+
+def test_missing_baseline_typed(tmp_path):
+    new = tmp_path / "BENCH_M_r01.json"
+    new.write_text('{"metric": "m", "value": 1.0, "unit": "qps"}')
+    violations = cbr.compare_artifacts(
+        str(new), str(tmp_path / "nope.json"))
+    assert [v["type"] for v in violations] == ["MISSING_BASELINE"]

@@ -1,0 +1,281 @@
+"""Windowed time-series telemetry tests (photon_tpu/obs/timeseries.py,
+the merge_snapshots extension, and the RunReport timeline section).
+
+Covers the windowed-series contract:
+
+  * quantile sketch: pinned relative-error bound (estimate within
+    ``alpha()`` of the exact sample quantile), exact merge (bucket-count
+    sums), zero bucket, JSON round-trip, bucket-cap collapse,
+  * windowed registry: window indexing off explicit timestamps, ring
+    eviction keeps memory bounded (and counts what it evicted),
+    late-arrival drops are typed, per-label series isolation,
+  * ``merge_snapshots`` over windowed series: multi-process window
+    alignment, label-preserving merge, pinned sketch-merge error bound,
+    old snapshot shape preserved when no input carries timeseries,
+  * the cumulative shim (run totals answerable from windowed data),
+  * RunReport: timeline section emitted, schema-validated, and cleared
+    by ``obs.reset()``.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from photon_tpu import obs
+from photon_tpu.obs import timeseries as ts
+from photon_tpu.obs.metrics import merge_snapshots
+from photon_tpu.obs.timeseries import (
+    MAX_SKETCH_BUCKETS,
+    QuantileSketch,
+    WindowedRegistry,
+    merge_series,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# -- quantile sketch ---------------------------------------------------------
+
+
+def test_sketch_pinned_relative_error_bound():
+    """THE accuracy contract: every quantile estimate is within
+    ``alpha()`` relative error of the exact sample of that rank
+    (nearest-rank), for a nasty long-tailed sample."""
+    rng = np.random.default_rng(7)
+    values = np.concatenate([
+        rng.lognormal(-6, 2, size=4000),          # micro latencies
+        rng.lognormal(0, 1, size=1000),           # second-scale tail
+    ])
+    s = QuantileSketch()
+    for v in values:
+        s.observe(float(v))
+    exact = np.sort(values)
+    alpha = s.alpha()
+    for q in (0.5, 0.9, 0.95, 0.99, 0.999):
+        est = s.quantile(q)
+        true = float(exact[math.floor(q * (len(values) - 1))])
+        assert abs(est - true) / true <= alpha, (q, est, true)
+
+
+def test_sketch_merge_is_exact():
+    """Merging two sketches == sketching the concatenation (bucket-count
+    sums are exact, not approximate)."""
+    rng = np.random.default_rng(11)
+    a, b = rng.lognormal(size=500), rng.lognormal(size=800)
+    sa, sb, sall = QuantileSketch(), QuantileSketch(), QuantileSketch()
+    for v in a:
+        sa.observe(float(v))
+        sall.observe(float(v))
+    for v in b:
+        sb.observe(float(v))
+        sall.observe(float(v))
+    sa.merge(sb)
+    assert sa.count == sall.count
+    assert sa.counts == sall.counts
+    assert sa.zeros == sall.zeros
+    for q in (0.5, 0.95, 0.99):
+        assert sa.quantile(q) == sall.quantile(q)
+
+
+def test_sketch_zero_bucket_and_json_roundtrip():
+    s = QuantileSketch()
+    for v in (0.0, -1.0, 0.5, 2.0):
+        s.observe(v)
+    assert s.quantile(0.0) == 0.0            # zeros rank lowest
+    s2 = QuantileSketch.from_json(json.loads(json.dumps(s.to_json())))
+    assert s2.count == s.count
+    assert s2.counts == s.counts
+    for q in (0.5, 0.99):
+        assert s2.quantile(q) == s.quantile(q)
+
+
+def test_sketch_gamma_mismatch_refused():
+    with pytest.raises(ValueError):
+        QuantileSketch(1.1).merge(QuantileSketch(1.2))
+
+
+def test_sketch_bucket_cap_collapses_low_end_only():
+    """Past MAX_SKETCH_BUCKETS the smallest buckets merge together —
+    memory stays bounded and the HIGH quantiles stay exact."""
+    s = QuantileSketch()
+    # values spanning far more than 512 buckets of gamma=1.1
+    n = 4 * MAX_SKETCH_BUCKETS
+    exps = [i - 2 * MAX_SKETCH_BUCKETS for i in range(n)]
+    for e in exps:
+        s.observe(1.1 ** e)
+    assert len(s.counts) <= MAX_SKETCH_BUCKETS
+    true_p99 = 1.1 ** exps[math.floor(0.99 * (n - 1))]
+    assert s.quantile(0.99) == pytest.approx(true_p99, rel=2 * s.alpha())
+
+
+# -- windowed registry -------------------------------------------------------
+
+
+def test_counter_windows_follow_explicit_timestamps():
+    reg = WindowedRegistry(interval_s=0.5)
+    c = reg.counter("req")
+    for t in (0.1, 0.4, 0.6, 1.7):
+        c.inc(t)
+    snap = reg.snapshot()["timeseries"]["req"]
+    assert [(w["idx"], w["value"]) for w in snap["windows"]] == [
+        (0, 2.0), (1, 1.0), (3, 1.0)]
+
+
+def test_ring_eviction_bounds_memory_and_counts():
+    """A series never holds more than ``capacity`` windows no matter how
+    long the process lives; evictions and too-late observations are
+    counted, never silent."""
+    reg = WindowedRegistry(interval_s=1.0, capacity=4)
+    c = reg.counter("req")
+    for t in range(100):
+        c.inc(float(t))
+    h = reg.counter("req")
+    assert h.num_windows <= 4
+    s = reg.snapshot()["timeseries"]["req"]
+    assert [w["idx"] for w in s["windows"]] == [96, 97, 98, 99]
+    assert s["evicted"] == 96
+    c.inc(0.0)                          # far older than the ring
+    s = reg.snapshot()["timeseries"]["req"]
+    assert s["late_dropped"] == 1
+    assert [w["idx"] for w in s["windows"]] == [96, 97, 98, 99]
+
+
+def test_per_label_series_are_isolated():
+    """The PR 12 limitation this module exists to fix: one (name, labels)
+    series per tenant/shard, no cross-pollution."""
+    reg = WindowedRegistry(interval_s=1.0)
+    reg.quantile("lat", tenant="a").observe(0.5, 0.001)
+    reg.quantile("lat", tenant="b").observe(0.5, 1.0)
+    snap = reg.snapshot()["timeseries"]
+    pa = snap['lat{tenant="a"}']["windows"][0]["p99"]
+    pb = snap['lat{tenant="b"}']["windows"][0]["p99"]
+    assert pa < 0.01 < pb
+    assert snap['lat{tenant="a"}']["labels"] == {"tenant": "a"}
+
+
+def test_kind_conflict_refused():
+    reg = WindowedRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError):
+        reg.gauge("x")
+
+
+def test_cumulative_shim():
+    reg = WindowedRegistry(interval_s=1.0)
+    c = reg.counter("req")
+    c.inc(0.5, 2)
+    c.inc(3.5, 3)
+    q = reg.quantile("lat")
+    for t, v in ((0.1, 0.010), (1.1, 0.020), (2.1, 0.040)):
+        q.observe(t, v)
+    assert reg.cumulative("req")["value"] == 5.0
+    cum = reg.cumulative("lat")
+    assert cum["count"] == 3
+    assert cum["p50"] == pytest.approx(0.020, rel=0.05)
+    assert reg.cumulative("missing") is None
+
+
+# -- merge_snapshots over windowed series ------------------------------------
+
+
+def test_merge_snapshots_aligns_windows_across_processes():
+    """Two processes' snapshots of the same series merge window-by-window
+    (counters sum where windows overlap, keep their own elsewhere)."""
+    r1 = WindowedRegistry(interval_s=1.0)
+    r2 = WindowedRegistry(interval_s=1.0)
+    r1.counter("req").inc(0.5, 10)
+    r1.counter("req").inc(1.5, 20)
+    r2.counter("req").inc(1.5, 5)
+    r2.counter("req").inc(2.5, 7)
+    merged = merge_snapshots([r1.snapshot(), r2.snapshot()])
+    w = merged["timeseries"]["req"]["windows"]
+    assert [(x["idx"], x["value"]) for x in w] == [
+        (0, 10.0), (1, 25.0), (2, 7.0)]
+
+
+def test_merge_snapshots_preserves_labels_and_old_shape():
+    r1 = WindowedRegistry()
+    r2 = WindowedRegistry()
+    r1.counter("req", shard="0").inc(0.5, 1)
+    r2.counter("req", shard="1").inc(0.5, 4)
+    merged = merge_snapshots([r1.snapshot(), r2.snapshot()])
+    assert merged["timeseries"]['req{shard="0"}']["windows"][0]["value"] \
+        == 1.0
+    assert merged["timeseries"]['req{shard="1"}']["windows"][0]["value"] \
+        == 4.0
+    assert merged["timeseries"]['req{shard="0"}']["labels"] == {"shard": "0"}
+    # inputs WITHOUT a timeseries section keep the old output shape
+    plain = merge_snapshots([
+        {"counters": {"a": 1}, "gauges": {}, "histograms": {}}])
+    assert "timeseries" not in plain
+
+
+def test_merge_snapshots_sketch_merge_pinned_error_bound():
+    """The multi-process quantile path: per-window sketches merged across
+    snapshots stay within the pinned sketch error bound of the exact
+    pooled quantile."""
+    rng = np.random.default_rng(3)
+    parts = [rng.lognormal(-4, 1, size=700) for _ in range(3)]
+    regs = []
+    for vals in parts:
+        r = WindowedRegistry(interval_s=1.0)
+        q = r.quantile("lat")
+        for v in vals:
+            q.observe(0.5, float(v))
+        regs.append(r)
+    merged = merge_snapshots([r.snapshot() for r in regs])
+    w = merged["timeseries"]["lat"]["windows"][0]
+    pooled = np.sort(np.concatenate(parts))
+    alpha = QuantileSketch().alpha()
+    assert w["count"] == len(pooled)
+    for qn, q in (("p50", 0.5), ("p95", 0.95), ("p99", 0.99)):
+        true = float(pooled[math.floor(q * (len(pooled) - 1))])
+        assert abs(w[qn] - true) / true <= alpha, (qn, w[qn], true)
+
+
+def test_merge_series_interval_mismatch_first_wins():
+    a = {"kind": "counter", "interval_s": 1.0,
+         "windows": [{"idx": 0, "value": 1.0}]}
+    b = {"kind": "counter", "interval_s": 2.0,
+         "windows": [{"idx": 0, "value": 9.0}]}
+    out = merge_series([a, b])
+    assert out["interval_s"] == 1.0
+    assert out["windows"] == [{"idx": 0, "value": 1.0}]
+
+
+# -- RunReport wiring --------------------------------------------------------
+
+
+def test_runreport_timeline_section_roundtrip():
+    ts.series.counter("replay.requests", tenant="a").inc(0.3)
+    ts.series.quantile("replay.latency").observe(0.5, 0.01)
+    rep = obs.build_run_report("test-timeline")
+    assert obs.validate_run_report(rep) == []
+    assert rep["timeline"]["interval_s"] == ts.series.interval_s
+    assert 'replay.requests{tenant="a"}' in rep["timeline"]["series"]
+    rep2 = json.loads(json.dumps(rep))       # disk round-trip
+    assert obs.validate_run_report(rep2) == []
+
+
+def test_runreport_timeline_validation_catches_corruption():
+    ts.series.counter("req").inc(0.1)
+    rep = obs.build_run_report("test-timeline")
+    rep["timeline"]["series"]["req"]["kind"] = "banana"
+    assert any("kind" in e for e in obs.validate_run_report(rep))
+
+
+def test_obs_reset_clears_windowed_series():
+    ts.series.counter("req").inc(0.1)
+    obs.reset()
+    assert ts.series.snapshot()["timeseries"] == {}
+    assert "timeline" not in obs.build_run_report("test-timeline")
