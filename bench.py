@@ -5284,6 +5284,440 @@ def run_replay_bench(scale: float, quick: bool = False):
     return rec
 
 
+# --------------------------------------------------------------------------
+# elastic mode: --mode elastic -> BENCH_ELASTIC_r01.json
+# --------------------------------------------------------------------------
+
+
+def _elastic_model_dir(E, d_global, K, seed, out_dir):
+    """Saved GAME model dir whose entity ids match the replay
+    generator's default ``e{:09d}`` format: one fixed effect on feature
+    shard ``g`` plus a cold-backed updatable ``per_user`` coordinate
+    with E entities. The v2 virtual-bucket fleet layout is split from
+    this. Returns the entity-id list."""
+    import jax.numpy as jnp
+
+    from photon_tpu.game.dataset import EntityVocabulary
+    from photon_tpu.game.model import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.io.index_map import IndexMap, feature_key
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    imap = IndexMap({feature_key(f"f{j}", ""): j for j in range(d_global)})
+    ids = [f"e{i:09d}" for i in range(E)]
+    coef = rng.normal(size=(E, K)).astype(np.float32)
+    proj = np.zeros((E, K), np.int32)
+    for e in range(E):
+        proj[e] = np.sort(rng.choice(d_global, size=K, replace=False))
+    fixed = FixedEffectModel(
+        GeneralizedLinearModel(
+            Coefficients(jnp.asarray(
+                rng.normal(size=d_global).astype(np.float32))),
+            TaskType.LINEAR_REGRESSION), "g")
+    rem = RandomEffectModel(
+        coefficients=jnp.asarray(coef), random_effect_type="userId",
+        feature_shard_id="g", task=TaskType.LINEAR_REGRESSION)
+    vocab = EntityVocabulary()
+    vocab.build("userId", ids)
+    save_game_model(out_dir, GameModel({"global": fixed, "per_user": rem}),
+                    {"g": imap}, vocab=vocab,
+                    projections={"per_user": proj}, sparsity_threshold=0.0)
+    return ids
+
+
+def run_elastic_bench(scale: float, quick: bool = False):
+    """Elastic serving fleet under replayed traffic (ISSUE 19): a v2
+    virtual-bucket fleet dir (two-tier stores) serves a deterministic
+    Zipf+burst stream on a virtual clock while scheduled actions drive
+    the full elastic lifecycle mid-replay — a gauge-driven hot-shard
+    split (provision shard, copy the hottest buckets, double-read
+    window, bitwise-parity cutover) followed by a drain back down
+    (migrate + decommission). Gates: both scale events complete, zero
+    refusals and at most typed BUCKET_MIGRATING degradation, double-
+    read windows accumulate bitwise-clean mirror comparisons, fixed
+    probe scores stay bitwise-identical across every topology, p99
+    breaches (if any) localize to the migration windows, zero steady-
+    state compiles across the whole lifecycle, and a chaos kill mid-
+    copy resumes to a bitwise-clean fleet.
+
+    ``quick`` is the tier-1 smoke shape: tiny stream, no artifact
+    write."""
+    import shutil as _sh
+    import tempfile
+
+    import jax
+
+    from photon_tpu.io.cold_store import ColdStore
+    from photon_tpu.io.fleet_store import (
+        build_fleet_dir,
+        read_fleet_manifest,
+        shard_store_path,
+    )
+    from photon_tpu.obs import slo
+    from photon_tpu.obs import timeseries as _tsmod
+    from photon_tpu.parallel.partition import entity_bucket
+    from photon_tpu.resilience import chaos
+    from photon_tpu.serving import (
+        AutoscaleConfig,
+        BucketMigrator,
+        CoeffStoreConfig,
+        FallbackReason,
+        FleetConfig,
+        HotShardAutoscaler,
+        ScoreRequest,
+        ServingConfig,
+        ShardedServingFleet,
+        SLOConfig,
+        read_migration_journal,
+        resume_migration,
+    )
+    from photon_tpu.serving.replay import (
+        Replayer,
+        TrafficProfile,
+        VirtualClock,
+        generate,
+        stream_digest,
+    )
+
+    if quick:
+        E, K, d_global, NB = 64, 2, 16, 32
+        n_requests, base_qps = 1_000, 150.0
+        hot_capacity, transfer_batch, max_batch = 256, 8, 16
+        n_probe = 24
+    else:
+        E = int(4096 * scale) or 256
+        K, d_global, NB = 2, 32, 64
+        n_requests, base_qps = 6_000, 800.0
+        hot_capacity, transfer_batch, max_batch = 4 * E, 64, 64
+        n_probe = 48
+    interval, tick = 0.25, 0.05
+    seed = _FLEET_SEED + 19
+    burst_at, burst_len, burst_factor = 1.0, 1.0, 3.0
+
+    # every windowed series (router fleet.*, replayer replay.*, and the
+    # autoscaler's gauge reads) shares one window grid on the virtual clock
+    _tsmod.series.interval_s = interval
+    _tsmod.clear()
+    slo.clear()
+
+    profile = TrafficProfile(
+        kind="burst", n_requests=n_requests, entities=E, zipf_a=1.5,
+        base_qps=base_qps, feature_dim=d_global, nnz=4,
+        burst_at_s=burst_at, burst_len_s=burst_len,
+        burst_factor=burst_factor)
+    records = generate(profile, seed)
+    sdig = stream_digest(records)
+    ts_all = [t for t, _ in records]
+    # choreography pinned to stream quantiles: split opens inside the
+    # burst, drains after it — robust to any profile reshaping
+    t_split = ts_all[int(0.25 * n_requests)]
+    t_split_done = ts_all[int(0.45 * n_requests)]
+    t_drain = ts_all[int(0.65 * n_requests)]
+    t_drain_done = ts_all[int(0.80 * n_requests)]
+
+    tdir = tempfile.mkdtemp(prefix="elastic_bench_")
+    t0 = time.perf_counter()
+    mdir = os.path.join(tdir, "model")
+    fdir = os.path.join(tdir, "fleet")
+    ids = _elastic_model_dir(E, d_global, K, seed, mdir)
+    build_fleet_dir(mdir, fdir, 2, num_buckets=NB)
+    build_s = time.perf_counter() - t0
+    log(f"elastic: {E} entities across {NB} buckets on 2 shards "
+        f"(v2 layout) in {build_s:.1f}s; {n_requests} replay requests, "
+        f"stream digest {sdig}")
+
+    clk = VirtualClock()
+    serving_cfg = ServingConfig(
+        max_batch=max_batch, max_wait_s=0.0,
+        slo=SLOConfig(shed_queue_depth=5_000, reject_queue_depth=10_000),
+        coeff_store=CoeffStoreConfig(hot_capacity=hot_capacity,
+                                     transfer_batch=transfer_batch))
+    fleet = ShardedServingFleet.from_fleet_dir(
+        fdir, FleetConfig(serving=serving_cfg), clock=clk)
+    winfo = fleet.warmup()
+
+    frng = np.random.default_rng(seed)
+    id_bucket = {eid: entity_bucket(eid, NB) for eid in ids}
+
+    def _req(uid, eid):
+        cols = frng.choice(d_global, size=4, replace=False)
+        return ScoreRequest(uid, {"g": [(f"f{c}", "", float(frng.normal()))
+                                        for c in cols]},
+                            {"userId": eid})
+
+    def bits(resps):
+        return [None if r.score is None else
+                np.float32(r.score).tobytes() for r in resps]
+
+    def drain():
+        for c in fleet.clients:
+            c.engine.model.drain_prefetch()
+
+    def settle(reqs, rounds=10):
+        for _ in range(rounds):
+            resps = fleet.serve(reqs)
+            drain()
+            if not any(f.reason == FallbackReason.COLD_MISS
+                       for r in resps for f in r.fallbacks):
+                return resps
+        return fleet.serve(reqs)
+
+    # promote every entity pre-replay: replayed traffic must see a
+    # settled two-tier store, so degradation gates measure MIGRATION
+    # behaviour, not promotion cold misses
+    all_reqs = [_req(f"s{i}", eid) for i, eid in enumerate(ids)]
+    for i in range(0, E, 512):
+        settle(all_reqs[i:i + 512])
+    probes = [_req(f"p{i}", ids[i]) for i in range(min(n_probe, E))]
+    base_bits = bits(settle(probes))
+    g_base = all(b is not None for b in base_bits)
+    mon0 = _replay_compile_monitors(fleet)
+
+    scaler = HotShardAutoscaler(
+        fleet,
+        AutoscaleConfig(hot_factor=1.02, cold_factor=0.25, min_shards=2,
+                        max_shards=3, buckets_per_step=2,
+                        lookback_windows=8, min_total=1.0),
+        serving=serving_cfg)
+
+    st = {"parity": [], "windows": [], "split": {}, "drain": {}}
+
+    def migrated_reqs(buckets):
+        bset = {int(b) for b in buckets}
+        sub = [r for r, eid in zip(all_reqs, ids)
+               if id_bucket[eid] in bset]
+        return sub[:max_batch * 4] or probes
+
+    def act_split():
+        dec = scaler.decide()
+        st["gauge_decision"] = dict(dec) if dec else None
+        if not (dec and dec["action"] == "split"):
+            shares = scaler.shard_shares()
+            dec = {"action": "split",
+                   "shard": max(shares, key=lambda s: (shares[s], -s))}
+        plan = scaler.step(dec)
+        st["split"] = {"shard": int(plan["shard"]),
+                       "new_shard": int(plan["new_shard"]),
+                       "buckets": [int(b) for b in plan["buckets"]],
+                       "t_open": clk.now()}
+        # pre-warm the destination's hot tier through the double-read
+        # mirrors so replayed traffic compares bitwise instead of
+        # tripping COLD_MISS on the empty new shard
+        warm = migrated_reqs(plan["buckets"])
+        for _ in range(4):
+            fleet.serve(warm)
+            drain()
+        st["parity"].append(bits(fleet.serve(probes)))
+
+    def act_split_done():
+        wins = fleet.migration_windows()
+        st["windows"].append({
+            "phase": "split",
+            "double_reads": int(sum(w["double_reads"]
+                                    for w in wins.values())),
+            "mismatches": int(sum(w["mismatches"]
+                                  for w in wins.values()))})
+        done = scaler.finish()
+        sp = st["split"]
+        sp["t_cutover"] = clk.now()
+        sp["results"] = len(done["results"])
+        sp["owners_moved"] = all(
+            fleet.bucket_map.shard_of(b) == sp["new_shard"]
+            for b in sp["buckets"])
+        sp["num_shards"] = fleet.num_shards
+        settle(migrated_reqs(sp["buckets"]))
+        st["parity"].append(bits(settle(probes)))
+
+    def act_drain():
+        plan = scaler.step({"action": "drain",
+                            "shard": st["split"]["new_shard"]})
+        st["drain"] = {"shard": st["split"]["new_shard"],
+                       "dst": int(plan["dst"]),
+                       "buckets": [int(b) for b in plan["buckets"]],
+                       "t_open": clk.now()}
+        warm = migrated_reqs(plan["buckets"])
+        for _ in range(4):
+            fleet.serve(warm)
+            drain()
+        st["parity"].append(bits(fleet.serve(probes)))
+
+    def act_drain_done():
+        wins = fleet.migration_windows()
+        st["windows"].append({
+            "phase": "drain",
+            "double_reads": int(sum(w["double_reads"]
+                                    for w in wins.values())),
+            "mismatches": int(sum(w["mismatches"]
+                                  for w in wins.values()))})
+        scaler.finish()
+        dr = st["drain"]
+        dr["t_cutover"] = clk.now()
+        dr["num_shards"] = fleet.num_shards
+        dr["owners_off"] = all(
+            fleet.bucket_map.shard_of(b) != dr["shard"]
+            for b in dr["buckets"])
+        settle(migrated_reqs(dr["buckets"]))
+        st["parity"].append(bits(settle(probes)))
+
+    actions = [(t_split, act_split), (t_split_done, act_split_done),
+               (t_drain, act_drain), (t_drain_done, act_drain_done)]
+    t0 = time.perf_counter()
+    res = Replayer(fleet, clk, tick_s=tick).run(records, actions)
+    replay_wall = time.perf_counter() - t0
+    mon1 = _replay_compile_monitors(fleet)
+    compile_delta = (
+        (mon1["steady_state"] - mon0["steady_state"])
+        + (mon1["misses"] - mon0["misses"])
+        + sum(max(0, b - a) for a, b in zip(mon0["traces"],
+                                            mon1["traces"])))
+    log(f"elastic: replay {res.responses} responses over "
+        f"{res.virtual_seconds:.2f} virtual s in {replay_wall:.1f}s wall "
+        f"(split {st['split'].get('buckets')} -> shard "
+        f"{st['split'].get('new_shard')}, drain back -> shard "
+        f"{st['drain'].get('dst')}), degraded {dict(res.degraded_reasons)}, "
+        f"compile delta {compile_delta}")
+
+    # -- chaos: kill the copy mid-flight, then resume to bitwise clean ----
+    loads = {b: sum(1 for eid in ids if id_bucket[eid] == b)
+             for b in fleet.bucket_map.buckets_on(0)}
+    b2 = max(loads, key=lambda b: (loads[b], -b))
+    dst2 = next(s for s in fleet.bucket_map.shard_ids if s != 0)
+    killed = False
+    m2 = BucketMigrator(fleet, b2, dst2)
+    with chaos.active(chaos.ChaosConfig(kill_publish_ops=("bucket_copy",))):
+        try:
+            m2.copy()
+        except chaos.SimulatedKill:
+            killed = True
+    j_kill = read_migration_journal(fdir)
+    g_kill_typed = (killed and j_kill is not None
+                    and j_kill["phase"] == "copy")
+    served_during = bits(fleet.serve(probes)) == base_bits  # old map serves
+    out = resume_migration(fleet)
+    ColdStore(shard_store_path(fdir, dst2, "per_user")).verify()
+    g_resume = (out is not None
+                and fleet.bucket_map.shard_of(b2) == dst2
+                and read_migration_journal(fdir) is None)
+    settle(migrated_reqs([b2]))
+    post_bits = bits(settle(probes))
+    g_chaos = bool(g_kill_typed and served_during and g_resume
+                   and post_bits == base_bits)
+    log(f"elastic: chaos kill mid-copy of bucket {b2} -> journal "
+        f"phase 'copy', resumed to shard {dst2}, bitwise clean: {g_chaos}")
+
+    # -- SLO verdicts: breaches must localize to the migration windows ----
+    snap = _tsmod.series.snapshot()
+    mig_idx = set()
+    for ph in (st["split"], st["drain"]):
+        if "t_open" in ph and "t_cutover" in ph:
+            mig_idx.update(range(
+                int(ph["t_open"] // interval),
+                int((ph["t_cutover"] + tick) // interval) + 2))
+    rules = [
+        slo.P99Ceiling(
+            rule_id="elastic_p99_under_load", series="replay.latency",
+            ceiling_s=4 * tick, qps_series="replay.responses",
+            qps_floor=0.25 * base_qps),
+        slo.MaxDegradationRate(
+            rule_id="no_shard_unavailable",
+            degraded_series="replay.degraded",
+            total_series="replay.responses", max_rate=0.0,
+            degraded_labels={"reason": "shard_unavailable"}),
+        slo.ZeroSteadyStateCompiles(rule_id="zero_steady_state_compiles"),
+    ]
+    verdicts = slo.evaluate(slo.SLOSpec(rules), snap,
+                            compile_delta=compile_delta)
+    by_rule = {v.rule_id: v for v in verdicts}
+    p99_v = by_rule["elastic_p99_under_load"]
+    g_p99 = (p99_v.status == slo.PASS
+             or {w["idx"] for w in p99_v.offending_windows} <= mig_idx)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    verdict_doc = slo.write_verdicts(
+        os.path.join(tdir if quick else here, "ELASTIC_SLO_VERDICTS.json"),
+        verdicts)
+
+    sp, dr = st["split"], st["drain"]
+    win_split = st["windows"][0] if st["windows"] else {}
+    win_drain = st["windows"][1] if len(st["windows"]) > 1 else {}
+    gates = {
+        "scale_out_completed": bool(
+            sp.get("owners_moved") and sp.get("results", 0) >= 1
+            and sp.get("num_shards") == 3),
+        "scale_in_completed": bool(
+            dr.get("owners_off") and dr.get("num_shards") == 2
+            and read_fleet_manifest(fdir)["num_shards"] == 2),
+        "gauge_driven_split": bool(
+            st.get("gauge_decision")
+            and st["gauge_decision"].get("action") == "split"),
+        "zero_downtime": bool(
+            g_base and res.refusals == 0
+            and set(res.degraded_reasons) <= {"bucket_migrating"}
+            and by_rule["no_shard_unavailable"].status == slo.PASS),
+        "double_read_parity": bool(
+            win_split.get("double_reads", 0) > 0
+            and win_drain.get("double_reads", 0) > 0
+            and win_split.get("mismatches", 1) == 0
+            and win_drain.get("mismatches", 1) == 0),
+        "zero_steady_state_compiles": bool(
+            compile_delta == 0
+            and by_rule["zero_steady_state_compiles"].status == slo.PASS),
+        "survivor_bitwise_parity": bool(
+            st["parity"] and all(pb == base_bits for pb in st["parity"])),
+        "p99_outside_migration_windows": bool(g_p99),
+        "chaos_kill_resume": bool(g_chaos),
+    }
+    fleet.shutdown()
+    rec = {
+        "metric": "elastic_migration_gates_passed",
+        "value": round(sum(gates.values()) / len(gates), 4),
+        "unit": "fraction",
+        "gates": gates,
+        "profile": {"kind": profile.kind, "n_requests": n_requests,
+                    "entities": E, "zipf_a": profile.zipf_a,
+                    "base_qps": base_qps, "burst_factor": burst_factor,
+                    "seed": seed},
+        "stream_digest": sdig,
+        "num_buckets": NB,
+        "window_interval_s": interval,
+        "warmup_programs": winfo["programs"],
+        "gauge_decision": st.get("gauge_decision"),
+        "split": {k: v for k, v in sp.items()},
+        "drain": {k: v for k, v in dr.items()},
+        "double_read_windows": st["windows"],
+        "migration_window_idx": sorted(mig_idx),
+        "replay": res.to_json(),
+        "replay_wall_s": round(replay_wall, 2),
+        "chaos": {"bucket": int(b2), "dst": int(dst2),
+                  "killed_mid_copy": bool(killed),
+                  "resumed_phase": (out or {}).get("resumed_phase"),
+                  "bitwise_after_resume": bool(post_bits == base_bits)},
+        "compile_delta": compile_delta,
+        "slo_status": verdict_doc["status"],
+        "verdicts": verdict_doc["verdicts"],
+        "timeline": _replay_timeline(snap, interval),
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "tpu_unavailable": _STATE["tpu_unavailable"],
+        "quick": quick,
+    }
+    _sh.rmtree(tdir, ignore_errors=True)
+    if not quick:
+        with open(os.path.join(here, "BENCH_ELASTIC_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"elastic: {sum(gates.values())}/{len(gates)} gates passed "
+        f"({', '.join(k for k, v in gates.items() if not v) or 'all'}"
+        f"{' failing' if not all(gates.values()) else ''})")
+    return rec
+
+
 # Order = on-chip capture priority (each config emits its JSON line the
 # moment it completes, so when the flaky relay dies mid-run the most
 # decision-relevant numbers are already on disk): the NEWTON flagship,
@@ -5324,7 +5758,7 @@ def main():
                     choices=("train", "serving", "game_cd", "coldtier",
                              "nearline", "hier", "fused", "stream", "fleet",
                              "tenant", "ingest", "sweep", "sdca",
-                             "re_sweep", "replay"),
+                             "re_sweep", "replay", "elastic"),
                     help="train = the solver configs (default); serving = "
                          "the online-serving bench -> BENCH_SERVING_r01.json; "
                          "game_cd = parallel-vs-sequential CD sweeps "
@@ -5353,11 +5787,14 @@ def main():
                          "passes + HBM planner honesty "
                          "-> BENCH_RE_SWEEP_r01.json; replay = traffic "
                          "capture + deterministic replay + SLO gates "
-                         "-> BENCH_REPLAY_r01.json")
+                         "-> BENCH_REPLAY_r01.json; elastic = live bucket "
+                         "resharding + gauge-driven autoscale under replay "
+                         "-> BENCH_ELASTIC_r01.json")
     ap.add_argument("--quick", action="store_true",
                     help="game_cd/coldtier/nearline/hier/fused/stream/"
-                         "fleet/tenant/ingest/sweep/sdca/re_sweep/replay: "
-                         "tiny tier-1 smoke shape (no artifact write)")
+                         "fleet/tenant/ingest/sweep/sdca/re_sweep/replay/"
+                         "elastic: tiny tier-1 smoke shape (no artifact "
+                         "write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -5446,6 +5883,21 @@ def main():
             emit({"metric": "replay_harness_gates_passed", "value": 0.0,
                   "unit": "fraction", "error": repr(e)})
         _DONE.set()     # replay mode: the record above IS the summary
+        return
+
+    if args.mode == "elastic":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/elastic"):
+                emit(run_elastic_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"elastic bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "elastic_migration_gates_passed", "value": 0.0,
+                  "unit": "fraction", "error": repr(e)})
+        _DONE.set()     # elastic mode: the record above IS the summary
         return
 
     if args.mode == "tenant":

@@ -50,17 +50,25 @@ import numpy as np
 
 from photon_tpu.io.cold_store import COLD_STORE_SUFFIX, ColdStore, \
     write_cold_store
-from photon_tpu.parallel.partition import entity_shards, validate_num_shards
+from photon_tpu.parallel.partition import BucketMap, entity_shards, \
+    validate_num_buckets, validate_num_shards
 from photon_tpu.resilience import io as rio
 
 FLEET_MANIFEST_FILE = "fleet-manifest.json"
 FLEET_MANIFEST_SCHEMA = "photon_tpu.fleet.manifest.v1"
+#: v2 adds the two-level partition: a ``bucket_map``
+#: ({"num_buckets", "assignment"}) routes entity -> virtual bucket ->
+#: shard, and live resharding bumps ``version`` with a new assignment.
+#: v1 manifests keep reading as the degenerate identity map (one bucket
+#: per shard), so routing is bitwise-unchanged for existing fleet dirs.
+FLEET_MANIFEST_SCHEMA_V2 = "photon_tpu.fleet.manifest.v2"
 #: the one partitioner this layout is defined over; a manifest naming
 #: anything else is refused (routing would disagree with file layout)
 PARTITIONER = "crc32-utf8-mod"
 
 __all__ = [
-    "FLEET_MANIFEST_FILE", "FLEET_MANIFEST_SCHEMA", "PARTITIONER",
+    "FLEET_MANIFEST_FILE", "FLEET_MANIFEST_SCHEMA",
+    "FLEET_MANIFEST_SCHEMA_V2", "PARTITIONER",
     "FleetManifestError", "shard_dir", "shard_store_path",
     "split_cold_store", "build_fleet_dir",
     "write_fleet_manifest", "read_fleet_manifest",
@@ -83,7 +91,9 @@ def shard_store_path(fleet_dir: str, shard_id: int,
 
 def split_cold_store(src_path: str, fleet_dir: str, num_shards: int, *,
                      updatable: bool = True,
-                     chunk_rows: int = 262144) -> List[Dict[str, object]]:
+                     chunk_rows: int = 262144,
+                     bucket_map: Optional[BucketMap] = None
+                     ) -> List[Dict[str, object]]:
     """Split one coordinate's cold store into ``num_shards`` per-shard
     stores under ``fleet_dir`` by the canonical entity hash. Returns one
     ``{"shard_id", "path", "entities", "bytes_at_split"}`` record per
@@ -91,12 +101,22 @@ def split_cold_store(src_path: str, fleet_dir: str, num_shards: int, *,
     process can open its file unconditionally).
 
     ``updatable=True`` writes v2 stores so the nearline publisher can
-    row-update and append in place per shard."""
+    row-update and append in place per shard. ``bucket_map`` routes
+    ownership through the two-level v2 partition instead of the direct
+    crc32-mod-N hash (the map's ``num_shards`` must not exceed ``n``)."""
     n = validate_num_shards(num_shards)
     src = ColdStore(src_path)
     ids = src.entity_ids_array()
-    owners = entity_shards(ids, n) if src.num_entities else \
-        np.zeros(0, np.int32)
+    if bucket_map is not None and bucket_map.num_shards > n:
+        raise ValueError(
+            f"bucket map assigns shard {bucket_map.num_shards - 1} but "
+            f"splitting into {n} shards")
+    if not src.num_entities:
+        owners = np.zeros(0, np.int32)
+    elif bucket_map is not None:
+        owners = bucket_map.shards_for_ids(ids)
+    else:
+        owners = entity_shards(ids, n)
     records: List[Dict[str, object]] = []
     for s in range(n):
         sel = np.nonzero(owners == s)[0]
@@ -124,16 +144,26 @@ def split_cold_store(src_path: str, fleet_dir: str, num_shards: int, *,
 def build_fleet_dir(model_dir: str, fleet_dir: str, num_shards: int, *,
                     coordinates: Optional[Sequence[str]] = None,
                     updatable: bool = True,
-                    version: int = 1) -> dict:
+                    version: int = 1,
+                    num_buckets: Optional[int] = None) -> dict:
     """Split every cold-backed random-effect coordinate of ``model_dir``
     into ``num_shards`` per-shard stores under ``fleet_dir`` and write
     the fleet manifest. Returns the manifest document.
 
     Only coordinates with a cold-store file are split (100M-entity
     serving implies cold-backed coordinates); pass ``coordinates`` to
-    restrict the set."""
+    restrict the set.
+
+    ``num_buckets=None`` (the default) writes the v1 single-level layout
+    byte-for-byte as before. An explicit power-of-two ``num_buckets``
+    writes a v2 manifest carrying ``BucketMap.initial(num_buckets, n)``
+    — the elastic layout whose shard count changes by migrating whole
+    buckets instead of re-splitting offline."""
     from photon_tpu.io.cold_store import COLD_STORE_DIR, cold_store_path
     n = validate_num_shards(num_shards)
+    bucket_map: Optional[BucketMap] = None
+    if num_buckets is not None:
+        bucket_map = BucketMap.initial(validate_num_buckets(num_buckets), n)
     cold_root = os.path.join(model_dir, COLD_STORE_DIR)
     if coordinates is None:
         coordinates = sorted(
@@ -157,14 +187,16 @@ def build_fleet_dir(model_dir: str, fleet_dir: str, num_shards: int, *,
             "updatable": bool(updatable),
         }
         for rec in split_cold_store(src_path, fleet_dir, n,
-                                    updatable=updatable):
+                                    updatable=updatable,
+                                    bucket_map=bucket_map):
             shard_stores[rec["shard_id"]][cid] = {
                 "path": rec["path"],
                 "entities": rec["entities"],
                 "bytes_at_split": rec["bytes_at_split"],
             }
     doc = {
-        "schema": FLEET_MANIFEST_SCHEMA,
+        "schema": (FLEET_MANIFEST_SCHEMA if bucket_map is None
+                   else FLEET_MANIFEST_SCHEMA_V2),
         "version": int(version),
         "num_shards": n,
         "partitioner": PARTITIONER,
@@ -173,6 +205,8 @@ def build_fleet_dir(model_dir: str, fleet_dir: str, num_shards: int, *,
         "shards": [{"shard_id": s, "stores": shard_stores[s]}
                    for s in range(n)],
     }
+    if bucket_map is not None:
+        doc["bucket_map"] = bucket_map.to_json()
     write_fleet_manifest(fleet_dir, doc)
     return doc
 
@@ -205,7 +239,8 @@ def read_fleet_manifest(fleet_dir: str) -> dict:
     except (OSError, ValueError) as e:
         raise FleetManifestError(
             f"unreadable fleet manifest {path!r}: {e}") from e
-    if doc.get("schema") != FLEET_MANIFEST_SCHEMA:
+    schema = doc.get("schema")
+    if schema not in (FLEET_MANIFEST_SCHEMA, FLEET_MANIFEST_SCHEMA_V2):
         raise FleetManifestError(
             f"fleet manifest {path!r}: unknown schema {doc.get('schema')!r}")
     crc = doc.pop("crc", None)
@@ -221,4 +256,25 @@ def read_fleet_manifest(fleet_dir: str) -> dict:
         raise FleetManifestError(
             f"fleet manifest {path!r}: bad num_shards "
             f"{doc.get('num_shards')!r}")
+    shard_ids = {s.get("shard_id") for s in doc.get("shards", ())}
+    if schema == FLEET_MANIFEST_SCHEMA:
+        # v1 IS the degenerate identity map (bucket b -> shard b): the
+        # two-level route composes to crc32 % num_shards bitwise, so
+        # pre-bucket fleet dirs keep serving unchanged.
+        if "bucket_map" in doc:
+            raise FleetManifestError(
+                f"fleet manifest {path!r}: v1 schema carries a "
+                "bucket_map — torn upgrade?")
+        doc["bucket_map"] = BucketMap.identity(doc["num_shards"]).to_json()
+    else:
+        try:
+            bmap = BucketMap.from_json(doc.get("bucket_map"))
+        except ValueError as e:
+            raise FleetManifestError(
+                f"fleet manifest {path!r}: bad bucket_map: {e}") from e
+        missing = set(bmap.assignment) - shard_ids
+        if missing:
+            raise FleetManifestError(
+                f"fleet manifest {path!r}: bucket_map assigns buckets to "
+                f"shards {sorted(missing)} absent from the manifest")
     return doc

@@ -22,23 +22,48 @@ their ``str()`` form so both sides agree without a schema change.
 from __future__ import annotations
 
 import zlib
-from typing import Iterable, List, Sequence
+from dataclasses import dataclass, field
+from typing import Iterable, List, Sequence, Tuple
 
 import numpy as np
 
 __all__ = [
+    "BucketMap",
+    "DEFAULT_NUM_BUCKETS",
     "crc32_ids",
+    "entity_bucket",
+    "entity_buckets",
     "entity_shard",
     "entity_shards",
     "partition_ids",
+    "validate_num_buckets",
     "validate_num_shards",
 ]
+
+#: default virtual-bucket count for new (v2) fleet layouts. Power of two
+#: and far above any realistic shard count, so bucket->shard rebalancing
+#: moves fine-grained slices of the keyspace (Dynamo virtual nodes /
+#: Redis Cluster slots, adapted to the crc32 partitioner).
+DEFAULT_NUM_BUCKETS = 1024
 
 
 def validate_num_shards(num_shards: int) -> int:
     if not isinstance(num_shards, (int, np.integer)) or num_shards < 1:
         raise ValueError(f"num_shards must be a positive int, got {num_shards!r}")
     return int(num_shards)
+
+
+def validate_num_buckets(num_buckets: int) -> int:
+    """Virtual-bucket counts are pinned to powers of two: the bucket id
+    is a stable function of the entity hash alone, so the count can never
+    be 'rebalanced' — refusing non-powers keeps anyone from treating it
+    as a tunable and silently stranding every row."""
+    if (not isinstance(num_buckets, (int, np.integer)) or num_buckets < 1
+            or (int(num_buckets) & (int(num_buckets) - 1)) != 0):
+        raise ValueError(
+            f"num_buckets must be a positive power of two, got "
+            f"{num_buckets!r}")
+    return int(num_buckets)
 
 
 def _id_bytes(entity_id) -> bytes:
@@ -122,6 +147,150 @@ def entity_shards(entity_ids: Iterable, num_shards: int) -> np.ndarray:
     return np.fromiter(
         ((zlib.crc32(_id_bytes(e)) & 0xFFFFFFFF) % n for e in entity_ids),
         dtype=np.int32)
+
+
+def entity_bucket(entity_id, num_buckets: int = DEFAULT_NUM_BUCKETS) -> int:
+    """The canonical entity->virtual-bucket map: crc32(utf-8 id) mod a
+    fixed power-of-two bucket count. Same hash as ``entity_shard`` —
+    only the modulus differs — so the two levels of the v2 partition
+    (entity -> bucket -> shard) share one pinned primitive."""
+    n = validate_num_buckets(num_buckets)
+    return (zlib.crc32(_id_bytes(entity_id)) & 0xFFFFFFFF) % n
+
+
+def entity_buckets(entity_ids: Iterable,
+                   num_buckets: int = DEFAULT_NUM_BUCKETS) -> np.ndarray:
+    """Vectorized ``entity_bucket`` -> int32 array (same fast/slow path
+    split as ``entity_shards``, bit-identical to the scalar form)."""
+    n = validate_num_buckets(num_buckets)
+    if isinstance(entity_ids, np.ndarray):
+        arr = entity_ids
+    else:
+        entity_ids = list(entity_ids)
+        arr = np.asarray(entity_ids) if entity_ids else \
+            np.zeros(0, dtype="S1")
+    if arr.ndim == 1 and arr.dtype.kind in ("S", "U"):
+        return (crc32_ids(arr) % np.uint32(n)).astype(np.int32)
+    return np.fromiter(
+        ((zlib.crc32(_id_bytes(e)) & 0xFFFFFFFF) % n for e in entity_ids),
+        dtype=np.int32)
+
+
+@dataclass(frozen=True)
+class BucketMap:
+    """Versioned virtual-bucket -> shard assignment — the mutable second
+    level of the v2 two-level partition.
+
+    ``assignment[b]`` is the shard owning bucket ``b``. The map is an
+    immutable value: rebalancing produces a new map via
+    ``with_assignment`` and publishes it through a fleet-manifest version
+    bump, so a router swaps the whole assignment atomically (one
+    reference store) and two routers holding different versions disagree
+    only about buckets mid-migration.
+
+    Two constructors cover the compat matrix:
+
+    - ``identity(n)``: ``num_buckets == num_shards``, bucket b -> shard
+      b. This is exactly the v1 single-level partition (shard =
+      crc32 % n for ANY n, power of two or not), so v1 manifests read
+      as the degenerate identity map with bitwise-identical routing.
+    - ``initial(num_buckets, num_shards)``: the canonical fresh v2
+      layout, bucket b -> shard b % num_shards. With a power-of-two
+      bucket count and power-of-two shard count this composes to
+      crc32 % num_shards, i.e. byte-identical files to the v1 split.
+    """
+
+    num_buckets: int
+    assignment: Tuple[int, ...] = field(default=())
+
+    def __post_init__(self):
+        # identity maps inherit v1's any-positive-N domain; only
+        # entity_bucket/new v2 layouts pin power-of-two counts
+        if (not isinstance(self.num_buckets, int)
+                or self.num_buckets < 1):
+            raise ValueError(
+                f"num_buckets must be a positive int, got "
+                f"{self.num_buckets!r}")
+        a = tuple(int(s) for s in self.assignment)
+        if len(a) != self.num_buckets:
+            raise ValueError(
+                f"assignment length {len(a)} != num_buckets "
+                f"{self.num_buckets}")
+        if a and min(a) < 0:
+            raise ValueError("assignment has negative shard ids")
+        object.__setattr__(self, "assignment", a)
+        object.__setattr__(self, "_shard_arr",
+                           np.asarray(a, dtype=np.int32))
+
+    @staticmethod
+    def identity(num_shards: int) -> "BucketMap":
+        """The degenerate v1 map: one bucket per shard, bucket b ->
+        shard b, so ``shard_for_entity == entity_shard`` exactly."""
+        n = validate_num_shards(num_shards)
+        return BucketMap(n, tuple(range(n)))
+
+    @staticmethod
+    def initial(num_buckets: int, num_shards: int) -> "BucketMap":
+        """Fresh v2 layout: bucket b -> shard b % num_shards."""
+        nb = validate_num_buckets(num_buckets)
+        ns = validate_num_shards(num_shards)
+        if ns > nb:
+            raise ValueError(
+                f"num_shards {ns} > num_buckets {nb}: some shards would "
+                "own no buckets")
+        return BucketMap(nb, tuple(b % ns for b in range(nb)))
+
+    @property
+    def num_shards(self) -> int:
+        return (max(self.assignment) + 1) if self.assignment else 0
+
+    @property
+    def shard_ids(self) -> Tuple[int, ...]:
+        return tuple(sorted(set(self.assignment)))
+
+    def bucket_of(self, entity_id) -> int:
+        # identity maps inherit v1's any-N modulus, so hash directly
+        # rather than through entity_bucket's power-of-two gate
+        return (zlib.crc32(_id_bytes(entity_id)) & 0xFFFFFFFF) \
+            % self.num_buckets
+
+    def shard_of(self, bucket: int) -> int:
+        return self.assignment[bucket]
+
+    def shard_for_entity(self, entity_id) -> int:
+        return self.assignment[self.bucket_of(entity_id)]
+
+    def shards_for_ids(self, entity_ids: Iterable) -> np.ndarray:
+        """Vectorized ``shard_for_entity`` -> int32 array (the
+        cold-store splitter's bulk path)."""
+        buckets = entity_shards(entity_ids, self.num_buckets)
+        return self._shard_arr[buckets]
+
+    def buckets_on(self, shard_id: int) -> Tuple[int, ...]:
+        return tuple(b for b, s in enumerate(self.assignment)
+                     if s == int(shard_id))
+
+    def with_assignment(self, bucket: int, shard_id: int) -> "BucketMap":
+        """New map with one bucket reassigned — the cutover primitive."""
+        b = int(bucket)
+        if not (0 <= b < self.num_buckets):
+            raise ValueError(f"bucket {bucket!r} out of range "
+                             f"[0, {self.num_buckets})")
+        a = list(self.assignment)
+        a[b] = int(shard_id)
+        return BucketMap(self.num_buckets, tuple(a))
+
+    def to_json(self) -> dict:
+        return {"num_buckets": self.num_buckets,
+                "assignment": list(self.assignment)}
+
+    @staticmethod
+    def from_json(doc: dict) -> "BucketMap":
+        if (not isinstance(doc, dict)
+                or not isinstance(doc.get("num_buckets"), int)
+                or not isinstance(doc.get("assignment"), list)):
+            raise ValueError(f"bad bucket map document: {doc!r}")
+        return BucketMap(doc["num_buckets"], tuple(doc["assignment"]))
 
 
 def partition_ids(entity_ids: Sequence, num_shards: int) -> List[List[int]]:

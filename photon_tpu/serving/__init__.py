@@ -41,13 +41,26 @@ from photon_tpu.serving.batching import (
     MicroBatcher,
     QueueClosedError,
 )
+from photon_tpu.serving.autoscale import (
+    AutoscaleConfig,
+    HotShardAutoscaler,
+    decommission_shard,
+    provision_shard,
+)
 from photon_tpu.serving.breaker import CircuitBreaker
 from photon_tpu.serving.coeff_store import TwoTierCoeffStore
 from photon_tpu.serving.engine import LATENCY_BUCKETS, ServingEngine
 from photon_tpu.serving.fleet import (
+    DoubleReadWindow,
     FleetConfig,
     LocalShardClient,
     ShardedServingFleet,
+)
+from photon_tpu.serving.migrate import (
+    BucketMigrator,
+    MigrationError,
+    read_migration_journal,
+    resume_migration,
 )
 from photon_tpu.serving.model_state import DeviceResidentModel
 from photon_tpu.serving.programs import (
@@ -90,18 +103,23 @@ from photon_tpu.serving.types import (
 )
 
 __all__ = [
+    "AutoscaleConfig",
     "BreakerConfig",
     "BucketLadder",
+    "BucketMigrator",
     "CaptureRecord",
     "CaptureWriter",
     "CoeffStoreConfig",
     "CircuitBreaker",
     "DeadlineConfig",
     "DeviceResidentModel",
+    "DoubleReadWindow",
     "Fallback",
     "FallbackReason",
     "FleetConfig",
+    "HotShardAutoscaler",
     "LocalShardClient",
+    "MigrationError",
     "ShardedServingFleet",
     "LATENCY_BUCKETS",
     "MODES",
@@ -120,12 +138,16 @@ __all__ = [
     "TrafficProfile",
     "TwoTierCoeffStore",
     "VirtualClock",
+    "decommission_shard",
     "export_program_bundle",
     "generate",
     "get_scorer",
     "load_program_bundle",
+    "provision_shard",
     "read_capture",
+    "read_migration_journal",
     "record_capture",
+    "resume_migration",
     "serving_report_section",
     "stream_digest",
     "swap_from_dir",

@@ -36,6 +36,19 @@ Per-shard observability (qps, p50/p99, hot-tier hit rate, breaker
 state, unavailable/hedge counts) is kept at the router and merged into
 one fleet view via the existing ``obs/metrics.merge_snapshots`` — the
 same aggregation the multi-process RunReport path uses.
+
+Elastic (v2) fleets route through a two-level partition instead:
+entity -> fixed power-of-two virtual bucket (`partition.entity_bucket`)
+-> shard via the manifest's versioned ``BucketMap``. A v1 manifest
+reads as the identity map, so the composed route is bitwise the old
+``entity_shard`` hash. Live resharding (`serving/migrate.BucketMigrator`)
+opens a DOUBLE-READ window on one bucket: the router keeps serving the
+source shard's answer (authoritative, bitwise-unchanged) while
+mirroring the same hop to the destination and comparing scores
+bit-for-bit; any mismatch poisons the window so cutover is refused
+typed. Cutover itself is one assignment swap under the router lock +
+an atomic manifest version bump — requests never see more than a typed
+``BUCKET_MIGRATING`` fallback.
 """
 
 from __future__ import annotations
@@ -51,6 +64,7 @@ import numpy as np
 
 from photon_tpu.obs.metrics import merge_snapshots, registry as _metrics
 from photon_tpu.obs.timeseries import series as _series
+from photon_tpu.parallel.partition import BucketMap
 from photon_tpu.resilience import chaos
 from photon_tpu.serving.engine import LATENCY_BUCKETS, ServingEngine
 from photon_tpu.serving.model_state import DeviceResidentModel
@@ -63,12 +77,38 @@ from photon_tpu.serving.types import (
 )
 
 __all__ = [
+    "DoubleReadWindow",
     "FleetConfig",
     "LocalShardClient",
     "ShardedServingFleet",
     "build_front_engine",
     "build_shard_engine",
 ]
+
+
+class DoubleReadWindow:
+    """Router-side state for one bucket mid-migration: every request in
+    the bucket fans to BOTH shards; the source answer is served, the
+    destination answer only compared bitwise. All counters are guarded
+    by the router lock (mutated on the serve path)."""
+
+    def __init__(self, bucket: int, src: int, dst: int):
+        self.bucket = int(bucket)
+        self.src = int(src)
+        self.dst = int(dst)
+        self.double_reads = 0     # hops mirrored AND compared
+        self.skipped = 0          # mirrored but not comparable (see serve)
+        self.mismatches = 0
+        self.aborted = False
+        self.mismatch_detail = ""
+
+    def view(self) -> dict:
+        return {"bucket": self.bucket, "src": self.src, "dst": self.dst,
+                "double_reads": self.double_reads,
+                "skipped": self.skipped,
+                "mismatches": self.mismatches,
+                "aborted": self.aborted,
+                "mismatch_detail": self.mismatch_detail}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -305,7 +345,8 @@ class ShardedServingFleet:
                  clients: Sequence[LocalShardClient],
                  coordinates: Sequence[Tuple[str, str]],
                  config: Optional[FleetConfig] = None,
-                 clock=None):
+                 clock=None,
+                 bucket_map: Optional[BucketMap] = None):
         """``coordinates`` is the model-order list of
         (coordinate_id, random_effect_type) the fleet routes — the order
         fixes the float accumulation chain, so it must match the
@@ -315,7 +356,11 @@ class ShardedServingFleet:
         and per-shard stats timestamps, so a replay on a virtual clock
         is wall-clock-independent at the router too. Hedge racing in
         ``_supervised_call`` deliberately stays on the wall clock — it
-        supervises REAL thread liveness, which no virtual clock can."""
+        supervises REAL thread liveness, which no virtual clock can.
+
+        ``bucket_map`` (None = the identity map, i.e. v1 single-level
+        routing, bitwise-unchanged) is the versioned virtual-bucket ->
+        shard assignment the v2 manifest carries."""
         self.front = front
         self.clients = list(clients)
         self.num_shards = len(self.clients)
@@ -324,6 +369,7 @@ class ShardedServingFleet:
         self.coordinates = list(coordinates)
         self.config = config or FleetConfig()
         self.clock = clock or time.monotonic
+        self.bucket_map = bucket_map or BucketMap.identity(self.num_shards)
         self._stats = {c.shard_id: _ShardStats(self.config.stats_window,
                                                shard_id=c.shard_id,
                                                clock=self.clock)
@@ -334,6 +380,20 @@ class ShardedServingFleet:
             max_workers=2 * self.num_shards + 4,
             thread_name_prefix="fleet")
         self._closed = False
+        # elastic state: the router lock guards the bucket_map reference,
+        # open double-read windows, and shard add/remove. RLock — ops
+        # like commit_bucket are called by the migrator while it already
+        # holds the lock for the cutover sequence.
+        self._router_lock = threading.RLock()
+        self._migrations: Dict[int, DoubleReadWindow] = {}
+        # per-bucket request counters (autoscaler input: which buckets
+        # make a shard hot). Separate small lock — serve() touches it
+        # per hop member.
+        self._load_lock = threading.Lock()
+        self._bucket_load: Dict[int, int] = {}
+        # set by from_fleet_dir; None for directly-constructed fleets
+        self.fleet_dir: Optional[str] = None
+        self.manifest: Optional[dict] = None
 
     # ------------------------------------------------------------ build
 
@@ -363,16 +423,23 @@ class ShardedServingFleet:
             for sh in manifest["shards"]]
         coords = [(re.coordinate_id, re.random_effect_type)
                   for re in ordered]
-        return cls(front, clients, coords, config, clock=clock)
+        fleet = cls(front, clients, coords, config, clock=clock,
+                    bucket_map=BucketMap.from_json(manifest["bucket_map"]))
+        fleet.fleet_dir = fleet_dir
+        fleet.manifest = manifest
+        fleet._model_dir = model_dir
+        return fleet
 
     # ---------------------------------------------------------- routing
 
     def route(self, request: ScoreRequest) -> List[_Hop]:
         """The request's hop chain: routed coordinates grouped by owning
         shard, groups ordered by first coordinate in model order (the
-        float-chain order). Pure function of the canonical hash —
-        exposed so tests can pin routing == training placement."""
-        from photon_tpu.parallel.partition import entity_shard
+        float-chain order). Pure function of the canonical hash composed
+        with the current bucket map (identity map == the old
+        ``entity_shard`` hash bitwise) — exposed so tests can pin
+        routing == training placement."""
+        bmap = self.bucket_map    # one read: the assignment is immutable
         owners: List[Tuple[int, str, str]] = []  # (coord idx, re_type, eid)
         for i, (_cid, re_type) in enumerate(self.coordinates):
             eid = request.entity_ids.get(re_type)
@@ -381,7 +448,7 @@ class ShardedServingFleet:
         hops: List[_Hop] = []
         seen: Dict[int, int] = {}
         for i, re_type, eid in owners:
-            shard = entity_shard(eid, self.num_shards)
+            shard = bmap.shard_for_entity(eid)
             if shard in seen:
                 hops[seen[shard]][1][re_type] = eid
             else:
@@ -428,6 +495,13 @@ class ShardedServingFleet:
                 totals.append(np.float32(fr.score))
                 chains.append(self.route(r))
 
+        # elastic snapshot for this serve call: the assignment swap is
+        # atomic (one reference), windows are copied under the lock
+        bmap = self.bucket_map
+        with self._router_lock:
+            windows = dict(self._migrations)
+        bucket_hits: Dict[int, int] = {}
+
         depth = 0
         while True:
             # (shard -> [(req index, ids)]) for this hop depth
@@ -441,6 +515,7 @@ class ShardedServingFleet:
             futs = {}
             for shard, members in groups.items():
                 subreqs, idxs, budget = [], [], None
+                mirrors: Dict[int, List[Tuple[int, DoubleReadWindow]]] = {}
                 now = self.clock()
                 for i, ids in members:
                     remaining = None if deadlines[i] is None \
@@ -448,17 +523,45 @@ class ShardedServingFleet:
                     if remaining is not None:
                         budget = remaining if budget is None \
                             else min(budget, remaining)
+                    pos = len(subreqs)
                     subreqs.append(ScoreRequest(
                         requests[i].uid, requests[i].features, ids,
                         offset=float(totals[i]), timeout_s=remaining))
                     idxs.append(i)
+                    for eid in ids.values():
+                        b = bmap.bucket_of(eid)
+                        bucket_hits[b] = bucket_hits.get(b, 0) + 1
+                        w = windows.get(b)
+                        if w is not None and w.src == shard:
+                            # typed visibility: the bucket is mid-
+                            # migration; the served score stays the
+                            # source shard's
+                            fallbacks[i].append(Fallback(
+                                FallbackReason.BUCKET_MIGRATING, None,
+                                f"bucket {b} migrating "
+                                f"{w.src}->{w.dst}"))
+                            if not w.aborted and w.dst in self._by_id:
+                                mirrors.setdefault(w.dst, []).append(
+                                    (pos, w))
+                            break
                 if budget is None:
                     budget = cfg.shard_timeout_s
+                # mirrors go straight to the destination client (one
+                # batched call per destination, NO nested supervisor:
+                # a supervisor-per-mirror can starve the fixed pool) —
+                # best-effort by design, an unanswered mirror is a
+                # skipped comparison, never a served degradation
+                mfuts = [
+                    (pw, self._pool.submit(
+                        self._by_id[dst].serve,
+                        [subreqs[p] for p, _ in pw]))
+                    for dst, pw in mirrors.items()]
                 futs[shard] = (idxs, self._pool.submit(
                     self._supervised_call, self._by_id[shard],
-                    subreqs, budget))
-            for shard, (idxs, fut) in futs.items():
+                    subreqs, budget), mfuts)
+            for shard, (idxs, fut, mfuts) in futs.items():
                 resps = fut.result()   # supervisor never raises
+                self._check_mirrors(resps, mfuts)
                 st = self._stats[shard]
                 if resps is None:
                     with st.lock:
@@ -495,6 +598,11 @@ class ShardedServingFleet:
                         totals[i] = np.float32(resp.score)
             depth += 1
 
+        if bucket_hits:
+            with self._load_lock:
+                for b, n in bucket_hits.items():
+                    self._bucket_load[b] = self._bucket_load.get(b, 0) + n
+
         out: List[ScoreResponse] = []
         for r, fr, total, fbs in zip(requests, front_resps, totals,
                                      fallbacks):
@@ -512,7 +620,12 @@ class ShardedServingFleet:
                          ) -> Optional[List[ScoreResponse]]:
         """One hop with hedging: primary attempt, a second attempt if the
         primary lags past ``hedge_timeout_s``, first answer wins; None
-        past the budget. Records the hop latency per shard."""
+        past the budget. Records the hop latency per shard.
+
+        A shard KNOWN to be dead (killed client, chaos-killed, breaker
+        open) never gets a hedge: the second attempt would burn a pool
+        slot racing an answer that cannot come — the hop goes straight
+        to the typed ``SHARD_UNAVAILABLE`` path instead."""
         cfg = self.config
         st = self._stats[client.shard_id]
         t0 = time.monotonic()
@@ -531,6 +644,18 @@ class ShardedServingFleet:
             return None
         if hedge is None or (budget is not None
                              and time.monotonic() - t0 >= budget):
+            return None
+        if (not client.alive or chaos.shard_killed(client.shard_id)
+                or client.breaker_state() == "open"):
+            # known-dead: a hedge cannot win, don't arm one
+            if fut1.done():
+                try:
+                    resps = fut1.result()
+                except Exception:
+                    return None
+                if resps is not None:
+                    st.record(time.monotonic() - t0, len(subreqs))
+                return resps
             return None
         # hedge: second attempt races the lagging primary
         with st.lock:
@@ -556,6 +681,135 @@ class ShardedServingFleet:
             if end is not None and time.monotonic() >= end:
                 return None
             time.sleep(0.0005)
+
+    def _check_mirrors(self, primary: Optional[List[ScoreResponse]],
+                       mfuts) -> None:
+        """Resolve one hop's double-read mirrors: compare the
+        destination copy's score BITWISE against the served (source)
+        score. A comparison only counts when both sides produced a full,
+        undegraded score — a cold-miss / unknown-entity / refusal on
+        either side proves nothing about the copy and is counted as
+        ``skipped``. Any bitwise mismatch poisons the window: cutover
+        will be refused typed and the new copy is never served."""
+        for pw, mfut in mfuts:
+            try:
+                mresps = mfut.result()   # client.serve never raises, but
+            except Exception:            # stay typed if that ever changes
+                mresps = None
+            for k, (pos, w) in enumerate(pw):
+                p = primary[pos] if primary is not None \
+                    and pos < len(primary) else None
+                m = mresps[k] if mresps is not None \
+                    and k < len(mresps) else None
+                comparable = (p is not None and m is not None
+                              and p.score is not None
+                              and m.score is not None
+                              and not p.fallbacks and not m.fallbacks)
+                with self._router_lock:
+                    if not comparable:
+                        w.skipped += 1
+                        continue
+                    w.double_reads += 1
+                    if np.float32(p.score).tobytes() != \
+                            np.float32(m.score).tobytes():
+                        w.mismatches += 1
+                        w.aborted = True
+                        w.mismatch_detail = (
+                            f"bucket {w.bucket} hop {w.src}->{w.dst}: "
+                            f"src={np.float32(p.score)!r} "
+                            f"dst={np.float32(m.score)!r}")
+                        _metrics.counter("fleet.double_read_mismatch",
+                                         bucket=str(w.bucket)).inc()
+
+    # ---------------------------------------------------- elastic ops
+
+    def begin_double_read(self, bucket: int, dst: int) -> DoubleReadWindow:
+        """Open the double-read window for one bucket: requests keep
+        being served off the current (source) owner while the same hop
+        is mirrored to ``dst`` and compared bitwise. Called by the
+        migrator once the destination copy is in place."""
+        with self._router_lock:
+            if int(bucket) in self._migrations:
+                raise ValueError(f"bucket {bucket} already migrating")
+            src = self.bucket_map.shard_of(int(bucket))
+            if dst not in self._by_id:
+                raise ValueError(f"destination shard {dst} not in fleet")
+            if src == int(dst):
+                raise ValueError(
+                    f"bucket {bucket} already on shard {dst}")
+            w = DoubleReadWindow(bucket, src, dst)
+            self._migrations[int(bucket)] = w
+            return w
+
+    def end_double_read(self, bucket: int) -> Optional[DoubleReadWindow]:
+        with self._router_lock:
+            return self._migrations.pop(int(bucket), None)
+
+    def commit_bucket(self, bucket: int, dst: int) -> BucketMap:
+        """Atomically reassign one bucket — the in-router half of
+        cutover (the durable half is the manifest version bump the
+        migrator writes first). The assignment swap is one reference
+        store, so in-flight serve() calls finish on whichever map they
+        snapshotted — both route to shards holding the rows."""
+        with self._router_lock:
+            self.bucket_map = self.bucket_map.with_assignment(bucket, dst)
+            return self.bucket_map
+
+    def add_shard(self, client: LocalShardClient) -> None:
+        """Grow the fleet live (scale-out): register an already-built,
+        already-warmed shard client. The pool is swapped for a larger
+        one; submissions in flight keep running on the old pool."""
+        with self._router_lock:
+            if client.shard_id in self._by_id:
+                raise ValueError(f"shard {client.shard_id} already in fleet")
+            self.clients.append(client)
+            self._by_id[client.shard_id] = client
+            self._stats[client.shard_id] = _ShardStats(
+                self.config.stats_window, shard_id=client.shard_id,
+                clock=self.clock)
+            self.num_shards = len(self.clients)
+            old_pool = self._pool
+            self._pool = ThreadPoolExecutor(
+                max_workers=2 * self.num_shards + 4,
+                thread_name_prefix="fleet")
+            old_pool.shutdown(wait=False)
+
+    def remove_shard(self, shard_id: int) -> None:
+        """Shrink the fleet live (drain): refuse while any bucket is
+        still assigned to (or migrating toward) the shard."""
+        with self._router_lock:
+            sid = int(shard_id)
+            if sid not in self._by_id:
+                raise ValueError(f"shard {sid} not in fleet")
+            owned = self.bucket_map.buckets_on(sid)
+            if owned:
+                raise ValueError(
+                    f"shard {sid} still owns buckets {list(owned)[:8]}"
+                    f"{'...' if len(owned) > 8 else ''}")
+            inbound = [b for b, w in self._migrations.items()
+                       if w.dst == sid or w.src == sid]
+            if inbound:
+                raise ValueError(
+                    f"shard {sid} has open double-read windows on "
+                    f"buckets {inbound}")
+            client = self._by_id.pop(sid)
+            self.clients.remove(client)
+            self._stats.pop(sid, None)
+            self.num_shards = len(self.clients)
+        client.shutdown()
+
+    def bucket_loads(self, top: Optional[int] = None
+                     ) -> List[Tuple[int, int]]:
+        """(bucket, request count) since boot, hottest first — the
+        autoscaler's 'which buckets make this shard hot' input."""
+        with self._load_lock:
+            items = sorted(self._bucket_load.items(),
+                           key=lambda kv: (-kv[1], kv[0]))
+        return items[:top] if top is not None else items
+
+    def migration_windows(self) -> Dict[int, dict]:
+        with self._router_lock:
+            return {b: w.view() for b, w in self._migrations.items()}
 
     # -------------------------------------------------------------- ops
 

@@ -1,0 +1,499 @@
+"""Live bucket migration for the elastic serving fleet.
+
+One ``BucketMigrator`` moves ONE virtual bucket from its current owner
+(the source shard) to a destination shard while the fleet keeps
+serving, in four journaled phases:
+
+``copy``
+    Chunked copy of the bucket's rows off the source shard's cold
+    store into the destination store, riding the SAME atomic in-place
+    delta path nearline publishes use (`io/cold_store.
+    apply_cold_store_delta`, chaos op ``bucket_copy``). A kill mid-copy
+    leaves the destination file failing ``verify()`` typed — the old
+    map keeps serving (the router never read the copy) and a resumed
+    copy re-applies the identical append set, converging to the same
+    bytes.
+``double_read``
+    The router (`ShardedServingFleet.begin_double_read`) fans every
+    request in the bucket to BOTH shards: the source answer is served
+    (authoritative, bitwise-unchanged), the destination answer is only
+    compared bit-for-bit. Any mismatch poisons the window — cutover is
+    refused typed and the new copy is never served.
+``reconcile``
+    Exactly-once coordination with nearline: rows the publisher
+    row-published to the SOURCE mid-copy are re-read and replayed onto
+    the destination (chaos op ``bucket_reconcile``), then the whole
+    bucket is verified bitwise src == dst.
+``cutover``
+    Under the router lock: final bitwise parity check, one atomic
+    ``fleet-manifest.json`` write (schema v2, version+1, the bucket
+    reassigned — chaos op ``fleet_manifest``; a kill between the
+    destination commit and the bump leaves the OLD manifest intact and
+    ``read_fleet_manifest`` refusing the torn tmp), then the in-router
+    assignment swap and window close. Steady-state requests never see
+    more than a typed ``BUCKET_MIGRATING`` fallback.
+
+The journal (``migration-journal.json``, crc'd like the fleet
+manifest) makes the whole sequence restartable: ``resume_migration``
+rolls an interrupted migration forward (copy is idempotent, the
+manifest bump is consulted to decide whether cutover already became
+durable) or the in-process ``abort`` rolls the destination back
+bitwise via the stored undo records.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from photon_tpu.io.cold_store import (
+    ColdStore,
+    ColdStoreCapacityError,
+    apply_cold_store_delta,
+    rollback_cold_store_delta,
+    upgrade_cold_store,
+)
+from photon_tpu.io.fleet_store import (
+    FLEET_MANIFEST_SCHEMA_V2,
+    read_fleet_manifest,
+    shard_store_path,
+    write_fleet_manifest,
+)
+from photon_tpu.obs.metrics import registry as _metrics
+from photon_tpu.parallel.partition import BucketMap, entity_shards
+from photon_tpu.resilience import io as rio
+
+__all__ = [
+    "MIGRATION_JOURNAL_FILE",
+    "MIGRATION_JOURNAL_SCHEMA",
+    "BucketMigrator",
+    "MigrationError",
+    "read_migration_journal",
+    "resume_migration",
+]
+
+MIGRATION_JOURNAL_FILE = "migration-journal.json"
+MIGRATION_JOURNAL_SCHEMA = "photon_tpu.fleet.migration.v1"
+
+#: journaled phases, in order
+PHASES = ("copy", "double_read", "reconcile", "cutover")
+
+
+class MigrationError(RuntimeError):
+    """A bucket migration was refused or aborted: torn journal, parity
+    mismatch, missing destination rows, or a poisoned double-read
+    window. Always typed — the old bucket map keeps serving."""
+
+
+def _journal_path(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, MIGRATION_JOURNAL_FILE)
+
+
+def _write_journal(fleet_dir: str, doc: dict) -> None:
+    body = {k: v for k, v in doc.items() if k != "crc"}
+    blob = json.dumps(body, sort_keys=True).encode("utf-8")
+    out = dict(body)
+    out["crc"] = zlib.crc32(blob) & 0xFFFFFFFF
+    rio.atomic_write_bytes(_journal_path(fleet_dir),
+                           json.dumps(out, sort_keys=True).encode("utf-8"),
+                           op="migration_journal")
+
+
+def read_migration_journal(fleet_dir: str) -> Optional[dict]:
+    """The current migration journal, or None when no migration is in
+    flight. A torn/corrupt/unknown-schema journal raises typed — a
+    restarted migrator must never guess which phase died."""
+    path = _journal_path(fleet_dir)
+    if not os.path.exists(path):
+        return None
+    try:
+        doc = json.loads(rio.read_bytes(path, op="migration_journal"))
+    except (OSError, ValueError) as e:
+        raise MigrationError(
+            f"unreadable migration journal {path!r}: {e}") from e
+    if doc.get("schema") != MIGRATION_JOURNAL_SCHEMA:
+        raise MigrationError(
+            f"migration journal {path!r}: unknown schema "
+            f"{doc.get('schema')!r}")
+    crc = doc.pop("crc", None)
+    blob = json.dumps(doc, sort_keys=True).encode("utf-8")
+    if crc != zlib.crc32(blob) & 0xFFFFFFFF:
+        raise MigrationError(f"migration journal {path!r}: crc mismatch")
+    if doc.get("phase") not in PHASES:
+        raise MigrationError(
+            f"migration journal {path!r}: unknown phase "
+            f"{doc.get('phase')!r}")
+    return doc
+
+
+def _clear_journal(fleet_dir: str) -> None:
+    path = _journal_path(fleet_dir)
+    if os.path.exists(path):
+        os.remove(path)
+
+
+class BucketMigrator:
+    """Moves one virtual bucket live. Step methods (``copy`` →
+    ``open_double_read`` → ``reconcile`` → ``cutover``) are exposed so
+    tests/benches can interleave traffic; ``migrate`` runs them in
+    order with an optional ``drive`` callable between window-open and
+    reconcile."""
+
+    def __init__(self, fleet, bucket: int, dst: int, *,
+                 fleet_dir: Optional[str] = None):
+        self.fleet = fleet
+        self.fleet_dir = fleet_dir or getattr(fleet, "fleet_dir", None)
+        if self.fleet_dir is None:
+            raise MigrationError(
+                "fleet has no fleet_dir (not built via from_fleet_dir); "
+                "pass fleet_dir= explicitly")
+        self.bucket = int(bucket)
+        self.dst = int(dst)
+        bmap: BucketMap = fleet.bucket_map
+        if not (0 <= self.bucket < bmap.num_buckets):
+            raise MigrationError(
+                f"bucket {bucket} out of range [0, {bmap.num_buckets})")
+        self.src = int(bmap.shard_of(self.bucket))
+        if self.src == self.dst:
+            raise MigrationError(
+                f"bucket {bucket} already on shard {dst}")
+        if self.dst not in fleet._by_id:
+            raise MigrationError(f"destination shard {dst} not in fleet")
+        self.num_buckets = bmap.num_buckets
+        self.coordinates: List[str] = [cid for cid, _ in fleet.coordinates]
+        self.window = None
+        self.phase = "plan"
+        self.copied_rows = 0
+        self.reconciled_rows = 0
+        self._undo: Dict[str, dict] = {}
+        # every coordinate on the destination must be two-tier: the
+        # refresh-after-delta seam is how a serving engine sees appended
+        # rows without a rebuild (full-resident tables are compiled
+        # shapes and cannot grow live)
+        for cid in self.coordinates:
+            rs = self._random_state(self.dst, cid)
+            if rs is not None and rs.store is None:
+                raise MigrationError(
+                    f"destination shard {dst} serves {cid!r} without a "
+                    "two-tier coeff store; live migration needs "
+                    "ServingConfig.coeff_store on shard engines")
+
+    # ---------------------------------------------------------- helpers
+
+    def _random_state(self, shard_id: int, cid: str):
+        model = self.fleet._by_id[shard_id].engine.model
+        for rs in model.random:
+            if rs.coordinate_id == cid:
+                return rs
+        return None
+
+    def _refresh(self, shard_id: int, cid: str) -> None:
+        """Reopen a shard engine's cold file after a delta so serving
+        sees the new rows (same seam the nearline publisher uses)."""
+        rs = self._random_state(shard_id, cid)
+        if rs is None or rs.store is None:
+            return
+        with rs.store.publish_lock:
+            with rs.store.lock:
+                rs.store.refresh_cold_locked()
+
+    def _journal(self, phase: str) -> None:
+        self.phase = phase
+        _write_journal(self.fleet_dir, {
+            "schema": MIGRATION_JOURNAL_SCHEMA,
+            "bucket": self.bucket,
+            "src": self.src,
+            "dst": self.dst,
+            "num_buckets": self.num_buckets,
+            "phase": phase,
+            "coordinates": self.coordinates,
+        })
+
+    def _bucket_rows(self, store: ColdStore
+                     ) -> Tuple[List[str], np.ndarray]:
+        """(entity ids, storage rows) of this bucket's rows in
+        ``store`` — vectorized over the whole id table."""
+        if not store.num_entities:
+            return [], np.zeros(0, np.int64)
+        ids = store.entity_ids_array()
+        # same crc-mod math as entity_buckets, minus the power-of-two
+        # gate (identity maps carry v1's any-N bucket count)
+        buckets = entity_shards(ids, self.num_buckets)
+        rows = np.nonzero(buckets == self.bucket)[0].astype(np.int64)
+        sel = ids[rows]
+        return [i.decode("utf-8") if isinstance(i, bytes) else str(i)
+                for i in sel], rows
+
+    # ------------------------------------------------------------ phases
+
+    def copy(self) -> dict:
+        """Phase 1: journal, then copy the bucket's rows into the
+        destination stores via the atomic delta path. Idempotent — ids
+        already present on the destination become bitwise row updates,
+        so a resumed copy converges to the same file bytes."""
+        self._journal("copy")
+        copied = {}
+        for cid in self.coordinates:
+            copied[cid] = self._copy_coordinate(cid)
+        self.copied_rows = sum(copied.values())
+        _metrics.counter("fleet.migration.copied_rows").inc(
+            self.copied_rows)
+        return copied
+
+    def _copy_coordinate(self, cid: str) -> int:
+        src_path = shard_store_path(self.fleet_dir, self.src, cid)
+        dst_path = shard_store_path(self.fleet_dir, self.dst, cid)
+        src_store = ColdStore(src_path)
+        ids, rows = self._bucket_rows(src_store)
+        if not len(rows):
+            return 0
+        coef = src_store.read_rows(rows)
+        proj = src_store.read_proj_rows(rows)
+        dst_store = ColdStore(dst_path)
+        upd_rows, upd_idx, app_idx = [], [], []
+        for i, eid in enumerate(ids):
+            r = dst_store.entity_row(eid)
+            if r is None:
+                app_idx.append(i)
+            else:
+                upd_rows.append(r)
+                upd_idx.append(i)
+        kw = dict(chaos_op="bucket_copy", normalize=True)
+        if upd_idx:
+            kw.update(update_rows=np.asarray(upd_rows, np.int64),
+                      update_coef=coef[upd_idx],
+                      update_proj=proj[upd_idx])
+        if app_idx:
+            kw.update(append_ids=[ids[i] for i in app_idx],
+                      append_coef=coef[app_idx],
+                      append_proj=proj[app_idx])
+        try:
+            undo = apply_cold_store_delta(dst_path, **kw)
+        except ColdStoreCapacityError:
+            blob_need = sum(len(ids[i].encode("utf-8")) for i in app_idx)
+            cap = dst_store.num_entities + len(app_idx)
+            upgrade_cold_store(
+                dst_path,
+                capacity=cap + max(16, cap // 4),
+                id_blob_cap=2 * (dst_store._h["id_blob_used"]
+                                 + blob_need) + 256)
+            self._refresh(self.dst, cid)
+            undo = apply_cold_store_delta(dst_path, **kw)
+        self._undo[cid] = undo
+        self._refresh(self.dst, cid)
+        return len(rows)
+
+    def open_double_read(self):
+        """Phase 2: journal, then open the router's double-read window
+        (source keeps serving, destination is mirrored + compared). An
+        already-open window for this bucket (in-process resume) is
+        adopted rather than re-opened."""
+        self._journal("double_read")
+        with self.fleet._router_lock:
+            w = self.fleet._migrations.get(self.bucket)
+            if w is not None:
+                if w.dst != self.dst:
+                    raise MigrationError(
+                        f"bucket {self.bucket} already migrating to "
+                        f"shard {w.dst}, not {self.dst}")
+                self.window = w
+                return w
+        self.window = self.fleet.begin_double_read(self.bucket, self.dst)
+        return self.window
+
+    def reconcile(self) -> dict:
+        """Phase 3: replay rows nearline published to the source
+        mid-copy onto the destination, then verify the whole bucket
+        bitwise src == dst. Raises typed on any missing or
+        still-divergent row."""
+        self._journal("reconcile")
+        out = {}
+        for cid in self.coordinates:
+            out[cid] = self._reconcile_coordinate(cid)
+        self.reconciled_rows = sum(out.values())
+        diverged = self._parity_failures()
+        if diverged:
+            raise MigrationError(
+                f"bucket {self.bucket} reconcile failed bitwise parity: "
+                f"{diverged[:3]}")
+        return out
+
+    def _reconcile_coordinate(self, cid: str) -> int:
+        src_path = shard_store_path(self.fleet_dir, self.src, cid)
+        dst_path = shard_store_path(self.fleet_dir, self.dst, cid)
+        src_store = ColdStore(src_path)
+        ids, rows = self._bucket_rows(src_store)
+        if not len(rows):
+            return 0
+        coef = src_store.read_rows(rows)
+        proj = src_store.read_proj_rows(rows)
+        dst_store = ColdStore(dst_path)
+        upd_rows, upd_idx, app_idx = [], [], []
+        for i, eid in enumerate(ids):
+            r = dst_store.entity_row(eid)
+            if r is None:
+                app_idx.append(i)     # published mid-copy as a NEW row
+                continue
+            if (dst_store.read_rows(np.asarray([r])).tobytes()
+                    != coef[i:i + 1].tobytes()
+                    or dst_store.read_proj_rows(
+                        np.asarray([r])).tobytes()
+                    != proj[i:i + 1].tobytes()):
+                upd_rows.append(r)
+                upd_idx.append(i)
+        if not upd_idx and not app_idx:
+            return 0
+        kw = dict(chaos_op="bucket_reconcile", normalize=True)
+        if upd_idx:
+            kw.update(update_rows=np.asarray(upd_rows, np.int64),
+                      update_coef=coef[upd_idx],
+                      update_proj=proj[upd_idx])
+        if app_idx:
+            kw.update(append_ids=[ids[i] for i in app_idx],
+                      append_coef=coef[app_idx],
+                      append_proj=proj[app_idx])
+        apply_cold_store_delta(dst_path, **kw)
+        self._refresh(self.dst, cid)
+        return len(upd_idx) + len(app_idx)
+
+    def _parity_failures(self) -> List[str]:
+        """Bitwise src-vs-dst comparison of every bucket row, per
+        coordinate — the pure check cutover repeats under the router
+        lock. Returns typed failure strings, empty == parity."""
+        fails: List[str] = []
+        for cid in self.coordinates:
+            src_store = ColdStore(
+                shard_store_path(self.fleet_dir, self.src, cid))
+            dst_store = ColdStore(
+                shard_store_path(self.fleet_dir, self.dst, cid))
+            ids, rows = self._bucket_rows(src_store)
+            if not len(rows):
+                continue
+            coef = src_store.read_rows(rows)
+            proj = src_store.read_proj_rows(rows)
+            for i, eid in enumerate(ids):
+                r = dst_store.entity_row(eid)
+                if r is None:
+                    fails.append(f"{cid}:{eid}: missing on dst")
+                    continue
+                if (dst_store.read_rows(np.asarray([r])).tobytes()
+                        != coef[i:i + 1].tobytes()
+                        or dst_store.read_proj_rows(
+                            np.asarray([r])).tobytes()
+                        != proj[i:i + 1].tobytes()):
+                    fails.append(f"{cid}:{eid}: row bytes diverge")
+        return fails
+
+    def cutover(self) -> dict:
+        """Phase 4, under the router lock: refuse a poisoned window,
+        re-verify bitwise parity, write the v2 manifest bump (the ONE
+        durable commit point — atomic, old manifest intact on a kill),
+        swap the in-router assignment, close the window, clear the
+        journal."""
+        if self.window is None:
+            raise MigrationError("cutover before open_double_read")
+        fleet = self.fleet
+        with fleet._router_lock:
+            w = self.window
+            if w.aborted or w.mismatches:
+                raise MigrationError(
+                    f"bucket {self.bucket} cutover refused: double-read "
+                    f"window poisoned ({w.mismatches} mismatches: "
+                    f"{w.mismatch_detail}) — new copy is never served")
+            diverged = self._parity_failures()
+            if diverged:
+                raise MigrationError(
+                    f"bucket {self.bucket} cutover refused: bitwise "
+                    f"parity failed: {diverged[:3]}")
+            self._journal("cutover")
+            doc = read_fleet_manifest(self.fleet_dir)
+            new_map = BucketMap.from_json(doc["bucket_map"]) \
+                .with_assignment(self.bucket, self.dst)
+            doc["schema"] = FLEET_MANIFEST_SCHEMA_V2
+            doc["version"] = int(doc["version"]) + 1
+            doc["bucket_map"] = new_map.to_json()
+            write_fleet_manifest(self.fleet_dir, doc)
+            fleet.commit_bucket(self.bucket, self.dst)
+            fleet.manifest = doc
+            fleet.end_double_read(self.bucket)
+            _clear_journal(self.fleet_dir)
+            self.phase = "done"
+            _metrics.counter("fleet.migration.cutovers").inc()
+            return {"bucket": self.bucket, "src": self.src,
+                    "dst": self.dst, "version": doc["version"],
+                    "double_reads": w.double_reads,
+                    "skipped": w.skipped,
+                    "copied_rows": self.copied_rows,
+                    "reconciled_rows": self.reconciled_rows}
+
+    def migrate(self, drive=None) -> dict:
+        """Run all four phases in order. ``drive`` (optional callable)
+        runs after the double-read window opens — the hook benches and
+        tests use to push routed traffic through the window."""
+        self.copy()
+        self.open_double_read()
+        if drive is not None:
+            drive()
+        self.reconcile()
+        return self.cutover()
+
+    def abort(self, reason: str = "") -> None:
+        """In-process rollback: close the window, bitwise-restore every
+        destination store from the stored undo records, drop the
+        journal. The fleet is left serving the OLD map over the exact
+        prior file bytes."""
+        self.fleet.end_double_read(self.bucket)
+        for cid, undo in reversed(list(self._undo.items())):
+            rollback_cold_store_delta(
+                shard_store_path(self.fleet_dir, self.dst, cid), undo)
+            self._refresh(self.dst, cid)
+        self._undo.clear()
+        _clear_journal(self.fleet_dir)
+        self.phase = "aborted"
+        _metrics.counter("fleet.migration.aborts").inc()
+        if reason:
+            self.abort_reason = reason
+
+
+def resume_migration(fleet, fleet_dir: Optional[str] = None,
+                     drive=None) -> Optional[dict]:
+    """Pick up a migration a killed migrator left behind.
+
+    No journal → None (nothing in flight). A torn journal raises typed
+    (``MigrationError``) and the fleet keeps serving whatever map the
+    last GOOD manifest carries. Otherwise the on-disk manifest decides:
+    if the bucket already reads as owned by the journal's destination,
+    the manifest bump became durable before the kill — finish the
+    bookkeeping; else roll the migration FORWARD (copy is idempotent:
+    the re-applied delta converges to the same destination bytes) and
+    complete reconcile + cutover."""
+    fleet_dir = fleet_dir or getattr(fleet, "fleet_dir", None)
+    if fleet_dir is None:
+        raise MigrationError("resume needs a fleet_dir")
+    doc = read_migration_journal(fleet_dir)
+    if doc is None:
+        return None
+    bucket, dst = int(doc["bucket"]), int(doc["dst"])
+    manifest = read_fleet_manifest(fleet_dir)
+    on_disk = BucketMap.from_json(manifest["bucket_map"])
+    if on_disk.shard_of(bucket) == dst:
+        # cutover became durable; mirror it in the router + tidy up
+        if fleet.bucket_map.shard_of(bucket) != dst:
+            fleet.commit_bucket(bucket, dst)
+        fleet.end_double_read(bucket)
+        fleet.manifest = manifest
+        _clear_journal(fleet_dir)
+        return {"bucket": bucket, "src": int(doc["src"]), "dst": dst,
+                "resumed_phase": doc["phase"], "completed": "durable"}
+    m = BucketMigrator(fleet, bucket, dst, fleet_dir=fleet_dir)
+    if m.src != int(doc["src"]):
+        raise MigrationError(
+            f"journal src {doc['src']} disagrees with manifest owner "
+            f"{m.src} for bucket {bucket}")
+    out = m.migrate(drive=drive)
+    out["resumed_phase"] = doc["phase"]
+    return out

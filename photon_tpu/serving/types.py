@@ -70,6 +70,13 @@ class FallbackReason(str, enum.Enum):
     #: per-tenant requests-per-pump cap) is exhausted — THIS tenant's
     #: flood is bounded here so it cannot inflate its neighbors' tails
     TENANT_BUDGET_EXCEEDED = "tenant_budget_exceeded"
+    #: elastic fleet: the entity's virtual bucket is inside a live
+    #: migration's double-read window — the request was scored off the
+    #: source shard (authoritative) and mirrored to the destination for
+    #: bitwise comparison. The score value is the source shard's, so the
+    #: flag is the typed worst-case visibility the zero-downtime
+    #: resharding contract allows (never a refusal, never the new copy)
+    BUCKET_MIGRATING = "bucket_migrating"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -56,6 +56,9 @@ KEY_METRICS = {
     "BENCH_REPLAY_r01.json": {
         "metric": "replay_harness_gates_passed",
         "direction": "higher", "hard_floor": 1.0},
+    "BENCH_ELASTIC_r01.json": {
+        "metric": "elastic_migration_gates_passed",
+        "direction": "higher", "hard_floor": 1.0},
     "BENCH_COLDTIER_r01.json": {
         "metric": "coldtier_steady_hit_rate",
         "direction": "higher", "hard_floor": 0.5},

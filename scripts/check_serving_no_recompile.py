@@ -697,6 +697,129 @@ def fleet_arm(baseline, registry, compile_cache) -> list:
     return failures
 
 
+def migration_arm(baseline, registry, compile_cache) -> list:
+    """Live bucket migration: the elastic resharding path (copy ->
+    double-read -> reconcile -> cutover) must be invisible to all three
+    compile monitors. The double-read window fans every request in the
+    migrating bucket to BOTH shards — the mirror hop dispatches through
+    the destination's already-warmed ladder, the cold-store delta +
+    refresh touches no programs, and post-cutover traffic promotes the
+    moved rows through the same compiled scatter path. Monitors are
+    baselined after the fleet's promotion traffic settles (first
+    cold-miss promotions compile nothing, but they must not pollute the
+    migration window's reading)."""
+    import tempfile
+
+    import numpy as np
+
+    from photon_tpu.io.fleet_store import build_fleet_dir
+    from photon_tpu.serving import (
+        BucketMigrator,
+        CoeffStoreConfig,
+        FallbackReason,
+        FleetConfig,
+        ScoreRequest,
+        ServingConfig,
+        ShardedServingFleet,
+    )
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="mig_ck_") as td:
+        import os as _os
+        mdir, fdir = _os.path.join(td, "model"), _os.path.join(td, "fleet")
+        names = build_model_dir(7, mdir)
+        build_fleet_dir(mdir, fdir, 2, num_buckets=32)
+        fleet = ShardedServingFleet.from_fleet_dir(
+            fdir, FleetConfig(serving=ServingConfig(
+                max_batch=8, max_wait_s=0.0,
+                coeff_store=CoeffStoreConfig(hot_capacity=8,
+                                             transfer_batch=2))))
+        fleet.warmup()
+
+        rng = np.random.default_rng(61)
+
+        def req(uid, user):
+            feats = [(str(names[j]), "", float(rng.normal()))
+                     for j in rng.choice(len(names), size=5, replace=False)]
+            return ScoreRequest(uid, {"shardA": feats}, {"userId": user})
+
+        reqs = [req(f"g{i}", f"u{i % 5}") for i in range(10)]
+
+        def settle():
+            for _ in range(8):
+                resps = fleet.serve(reqs)
+                for c in fleet.clients:
+                    c.engine.model.drain_prefetch()
+                if not any(f.reason == FallbackReason.COLD_MISS
+                           for r in resps for f in r.fallbacks):
+                    return resps
+            return fleet.serve(reqs)
+
+        base_scores = [r.score for r in settle()]
+        if any(s is None for s in base_scores):
+            fleet.shutdown()
+            return ["migration arm: baseline traffic dropped a score"]
+
+        # baseline the three monitors over every engine in the fleet
+        base = compile_cache.compile_counts()
+        misses0 = registry.counter("jitcache.misses").value
+        jitted = _jitted_programs(fleet.front.model, fleet.front.ladder)
+        for c in fleet.clients:
+            jitted += _jitted_programs(c.engine.model, c.engine.ladder)
+        traces0 = [f._cache_size() for f in jitted]
+
+        # live migration of u4's bucket (25 @ 32 buckets) shard 1 -> 0,
+        # with routed traffic flowing through the double-read window
+        m = BucketMigrator(fleet, 25, 0)
+        m.copy()
+        w = m.open_double_read()
+        served = 0
+        for _ in range(3):
+            for resp in fleet.serve(reqs):
+                if resp.score is None:
+                    failures.append(
+                        f"migration window dropped a score for {resp.uid}")
+                served += 1
+            for c in fleet.clients:
+                c.engine.model.drain_prefetch()
+        if w.double_reads < 1:
+            failures.append("migration arm: double-read window compared "
+                            "nothing (cold mirror never promoted?)")
+        if w.mismatches:
+            failures.append(f"migration arm: double-read mismatches: "
+                            f"{w.mismatch_detail}")
+        m.reconcile()
+        m.cutover()
+        post = settle()
+        served += len(post)
+        if [r.score for r in post] != base_scores:
+            failures.append("migration arm: post-cutover scores differ "
+                            "from the pre-migration baseline (must be "
+                            "bitwise)")
+
+        after = compile_cache.compile_counts()
+        misses1 = registry.counter("jitcache.misses").value
+        traces1 = [f._cache_size() for f in jitted]
+        if after["steady_state"] != base["steady_state"]:
+            failures.append(
+                f"migration steady-state compiles moved: "
+                f"{base['steady_state']} -> {after['steady_state']}")
+        if misses1 != misses0:
+            failures.append(f"migration jitcache.misses moved: "
+                            f"{misses0} -> {misses1}")
+        for i, (t0, t1) in enumerate(zip(traces0, traces1)):
+            if t1 > t0:
+                failures.append(f"migration program {i} re-traced: "
+                                f"_cache_size {t0} -> {t1}")
+        fleet.shutdown()
+        if not failures:
+            print(f"ok: migration arm served {served} through a live "
+                  f"bucket cutover (double_reads={w.double_reads}, "
+                  f"mismatches=0), post-cutover scores bitwise, "
+                  f"steady-state compiles=0")
+    return failures
+
+
 def tenant_arm(baseline, registry, compile_cache) -> list:
     """Multi-tenant contract: N same-shape tenants behind ONE compiled
     ladder. After tenant #1 warms, adding tenants 2..N must not move ANY
@@ -1005,6 +1128,15 @@ def main() -> int:
     if fl_failures:
         print("FAIL: fleet serving compiled:")
         for f in fl_failures:
+            print("  " + f)
+        return 1
+
+    # -- live bucket-migration arm: copy/double-read/cutover resharding
+    # is invisible to every compile monitor
+    mg_failures = migration_arm(baseline, registry, compile_cache)
+    if mg_failures:
+        print("FAIL: serving compiled across a live bucket migration:")
+        for f in mg_failures:
             print("  " + f)
         return 1
 

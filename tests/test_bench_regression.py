@@ -119,6 +119,47 @@ def test_hard_floor_enforced_without_baseline(tmp_path):
     assert [v["type"] for v in violations] == ["HARD_FLOOR"]
 
 
+# -- the elastic artifact rides the same gate --------------------------------
+
+
+def test_elastic_artifact_committed_and_keyed():
+    """The committed elastic artifact must sit exactly at its hard
+    floor: every migration gate true (fraction 1.0), metric name keyed
+    in KEY_METRICS so a rename or a dropped gate fails typed."""
+    path = os.path.join(REPO, "BENCH_ELASTIC_r01.json")
+    assert os.path.exists(path)
+    doc = json.load(open(path))
+    assert doc["metric"] == "elastic_migration_gates_passed"
+    assert doc["value"] == 1.0 and doc["quick"] is False
+    assert all(doc["gates"].values())
+    assert cbr.validate_artifact(path) == []
+    assert cbr.compare_artifacts(path, path) == []
+    gate = cbr.KEY_METRICS["BENCH_ELASTIC_r01.json"]
+    assert gate["hard_floor"] == 1.0
+
+
+def test_elastic_perturbed_fails_hard_floor(tmp_path):
+    """A single failed migration gate (fraction < 1.0) trips the hard
+    floor — with and without a baseline."""
+    base = os.path.join(REPO, "BENCH_ELASTIC_r01.json")
+    doc = json.load(open(base))
+    doc["value"] = round(1.0 - 1.0 / max(len(doc["gates"]), 1), 4)
+    bad = tmp_path / "BENCH_ELASTIC_r01.json"
+    bad.write_text(json.dumps(doc))
+    violations = cbr.validate_artifact(str(bad))
+    assert [v["type"] for v in violations] == ["HARD_FLOOR"]
+    proc = _run("--compare", str(bad), "--baseline", base)
+    assert proc.returncode == 1
+    assert "VIOLATION HARD_FLOOR" in proc.stdout
+    assert "elastic_migration_gates_passed" in proc.stdout
+    # a renamed metric is typed, not silently re-banded
+    doc["value"] = 1.0
+    doc["metric"] = "elastic_gates_v2"
+    bad.write_text(json.dumps(doc))
+    violations = cbr.validate_artifact(str(bad))
+    assert [v["type"] for v in violations] == ["METRIC_RENAMED"]
+
+
 def test_metric_rename_detected(tmp_path):
     base = os.path.join(REPO, "BENCH_SERVING_r01.json")
     doc = json.load(open(base))

@@ -1,0 +1,757 @@
+"""Elastic serving fleet tests (photon_tpu/serving/migrate.py,
+photon_tpu/serving/autoscale.py, the v2 virtual-bucket partition in
+photon_tpu/parallel/partition.py and photon_tpu/io/fleet_store.py).
+
+Covers the elastic contract end to end on CPU:
+
+  * the virtual-bucket partitioner: pinned crc32 bucket values (burned
+    into every v2 fleet layout on disk — they may NEVER change),
+    bucket -> shard composition, the v1 identity-map equivalence, and
+    ``BucketMap`` round-trip/validation,
+  * manifest compat: v1 read as the degenerate identity map, v2 round
+    trip, unknown FUTURE schemas refused typed naming the schema
+    string, a v1 doc smuggling a bucket_map refused, and the
+    ``manifest_torn_write`` chaos injector against a v2 manifest,
+  * hedging: a shard KNOWN dead at hedge-arm time never gets a hedge
+    (the second attempt would burn a pool slot racing an answer that
+    cannot come), while a live-but-slow shard still does,
+  * live migration: copy -> double-read -> reconcile -> cutover with
+    routed traffic flowing through the window — served scores stay
+    bitwise-identical to the settled baseline the whole way, the only
+    visible artifact is a typed BUCKET_MIGRATING fallback, and the
+    steady-state compile counter stays frozen,
+  * mismatch abort: a tampered destination copy poisons the window,
+    cutover is refused typed, the new copy is never served, and
+    ``abort`` rolls the destination back,
+  * chaos kills at every phase (mid-copy, mid-double-read with a FULL
+    process restart, between destination commit and manifest bump):
+    torn state is refused typed, the old map keeps serving, and
+    ``resume_migration`` restores a bitwise-clean fleet,
+  * elastic fleet ops: add/remove guards, ``provision_shard`` /
+    ``decommission_shard`` manifest discipline, v1 refusal,
+  * the autoscaler: gauge-share decisions on synthetic snapshots and a
+    full split -> drain round trip under traffic,
+  * the tier-1 ``--mode elastic --quick`` bench smoke.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from photon_tpu.io.cold_store import (
+    ColdStore,
+    ColdStoreCorruptError,
+    apply_cold_store_delta,
+)
+from photon_tpu.io.fleet_store import (
+    FLEET_MANIFEST_SCHEMA,
+    FLEET_MANIFEST_SCHEMA_V2,
+    FleetManifestError,
+    build_fleet_dir,
+    read_fleet_manifest,
+    shard_store_path,
+    write_fleet_manifest,
+)
+from photon_tpu.parallel.partition import (
+    DEFAULT_NUM_BUCKETS,
+    BucketMap,
+    entity_bucket,
+    entity_buckets,
+    entity_shard,
+    entity_shards,
+    validate_num_buckets,
+)
+from photon_tpu.resilience import chaos
+from photon_tpu.serving import (
+    AutoscaleConfig,
+    BucketMigrator,
+    FallbackReason,
+    FleetConfig,
+    HotShardAutoscaler,
+    MigrationError,
+    ShardedServingFleet,
+    decommission_shard,
+    provision_shard,
+    read_migration_journal,
+    resume_migration,
+)
+from photon_tpu.serving.migrate import MIGRATION_JOURNAL_FILE
+from photon_tpu.utils import compile_cache
+
+from test_fleet import _build_model_dir, _mkreq, _serving_config
+
+#: the module fleet splits with 32 virtual buckets over 2 shards;
+#: under BucketMap.initial(32, 2), u4 (bucket 25) is the lone seeded
+#: entity on shard 1 — the bucket every migration test moves
+NB = 32
+B_U4 = 25
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def elastic_base():
+    """model dir + a pristine v2 fleet dir (2 shards, 32 buckets),
+    built once; tests that mutate the fleet dir copy it first."""
+    with tempfile.TemporaryDirectory(prefix="elastic_t_") as td:
+        mdir = os.path.join(td, "model")
+        fdir = os.path.join(td, "fleet_v2")
+        names = _build_model_dir(7, mdir)
+        build_fleet_dir(mdir, fdir, 2, num_buckets=NB)
+        yield mdir, fdir, names
+
+
+@pytest.fixture()
+def elastic_fleet_dir(elastic_base, tmp_path):
+    """A fresh mutable copy of the pristine v2 fleet dir."""
+    mdir, fdir, names = elastic_base
+    dst = os.path.join(str(tmp_path), "fleet")
+    shutil.copytree(fdir, dst)
+    return mdir, dst, names
+
+
+def _mk_fleet(fdir, **cfg_kw):
+    cfg_kw.setdefault("serving", _serving_config())
+    fleet = ShardedServingFleet.from_fleet_dir(fdir, FleetConfig(**cfg_kw))
+    fleet.warmup()
+    return fleet
+
+
+def _mk_reqs(seed, names, n=10):
+    """A FIXED request list (u0..u4 round-robin) reused across serves so
+    bitwise score comparisons are meaningful."""
+    rng = np.random.default_rng(seed)
+    users = [f"u{i % 5}" for i in range(n)]
+    return [_mkreq(rng, f"q{i}", names, u)
+            for i, u in enumerate(users)], users
+
+
+def _score_bits(resps):
+    return [None if r.score is None else np.float32(r.score).tobytes()
+            for r in resps]
+
+
+def _drain(fleet):
+    for c in fleet.clients:
+        c.engine.model.drain_prefetch()
+
+
+def _settle(fleet, reqs, rounds=8):
+    """Serve until the two-tier stores are promoted (no COLD_MISS) —
+    the settled responses are the bitwise baseline."""
+    for _ in range(rounds):
+        resps = fleet.serve(reqs)
+        _drain(fleet)
+        if not any(f.reason == FallbackReason.COLD_MISS
+                   for r in resps for f in r.fallbacks):
+            return resps
+    return fleet.serve(reqs)
+
+
+# -- the virtual-bucket partitioner ------------------------------------------
+
+
+#: crc32 % n for power-of-two bucket counts: burned into every v2 fleet
+#: layout on disk, these exact values may NEVER change across refactors
+_PINS = {
+    "u0": {64: 32, 256: 224, 1024: 992},
+    "u1": {64: 54, 256: 118, 1024: 886},
+    "u2": {64: 12, 256: 204, 1024: 716},
+    "u3": {64: 26, 256: 90, 1024: 602},
+    "u4": {64: 57, 256: 249, 1024: 1017},
+    "e000000042": {64: 18, 256: 210, 1024: 466},
+    "-17": {64: 28, 256: 28, 1024: 540},
+    "solo": {64: 17, 256: 17, 1024: 17},
+}
+
+
+class TestBucketPartitioner:
+    def test_pinned_bucket_values(self):
+        for eid, by_n in _PINS.items():
+            for n, want in by_n.items():
+                assert entity_bucket(eid, n) == want, (eid, n)
+                assert zlib.crc32(eid.encode()) % n == want, (eid, n)
+        assert DEFAULT_NUM_BUCKETS == 1024
+        assert entity_bucket("u4") == _PINS["u4"][1024]
+        assert entity_bucket("u4", NB) == B_U4
+
+    def test_vectorized_agrees_and_pow2_gate(self):
+        ids = list(_PINS) + [f"m{i}" for i in range(100)]
+        for n in (64, 1024):
+            np.testing.assert_array_equal(
+                entity_buckets(ids, n),
+                [zlib.crc32(s.encode()) % n for s in ids])
+        for bad in (0, -4, 3, 48):
+            with pytest.raises(ValueError):
+                entity_bucket("x", bad)
+            with pytest.raises(ValueError):
+                validate_num_buckets(bad)
+        assert validate_num_buckets(1024) == 1024
+
+    def test_bucket_to_shard_composition(self):
+        bm = BucketMap.initial(64, 3)
+        ids = list(_PINS) + [str(v) for v in range(-20, 40)]
+        for eid in ids:
+            b = entity_bucket(eid, 64)
+            assert bm.bucket_of(eid) == b
+            assert bm.shard_of(b) == b % 3
+            assert bm.shard_for_entity(eid) == b % 3
+        np.testing.assert_array_equal(
+            bm.shards_for_ids(ids),
+            [bm.shard_for_entity(e) for e in ids])
+
+    def test_identity_map_is_v1_routing(self):
+        # the degenerate map must route bitwise-identically to the v1
+        # single-level partition for ANY shard count (pow2 or not)
+        ids = list(_PINS) + [str(v) for v in range(-10, 30)]
+        for n in (1, 2, 3, 7):
+            bm = BucketMap.identity(n)
+            assert bm.num_buckets == n and bm.num_shards == n
+            np.testing.assert_array_equal(bm.shards_for_ids(ids),
+                                          entity_shards(ids, n))
+            for eid in ids:
+                assert bm.shard_for_entity(eid) == entity_shard(eid, n)
+
+    def test_with_assignment_and_round_trip(self):
+        bm = BucketMap.initial(NB, 2)
+        assert bm.assignment == tuple(b % 2 for b in range(NB))
+        assert bm.shard_ids == (0, 1)
+        moved = bm.with_assignment(B_U4, 5)
+        assert moved.shard_of(B_U4) == 5
+        assert all(moved.shard_of(b) == bm.shard_of(b)
+                   for b in range(NB) if b != B_U4)
+        assert bm.shard_of(B_U4) == 1     # the original is immutable
+        assert BucketMap.from_json(moved.to_json()) == moved
+        assert B_U4 in moved.buckets_on(5)
+        assert bm.buckets_on(5) == ()
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError):
+            BucketMap.initial(32, 33)     # a shard would own no bucket
+        with pytest.raises(ValueError):
+            BucketMap.initial(31, 2)      # new layouts pin power of two
+        with pytest.raises(ValueError):
+            BucketMap(2, (0,))            # length mismatch
+        with pytest.raises(ValueError):
+            BucketMap(2, (0, -1))         # negative shard id
+        for bad in ("x", {"num_buckets": 2}, {"assignment": [0, 1]},
+                    {"num_buckets": "2", "assignment": [0, 1]}):
+            with pytest.raises(ValueError):
+                BucketMap.from_json(bad)
+
+
+# -- manifest compat ---------------------------------------------------------
+
+
+class TestManifestCompat:
+    def test_v1_manifest_reads_as_identity_map(self, elastic_base, tmp_path):
+        mdir, _, _ = elastic_base
+        fdir = os.path.join(str(tmp_path), "fleet_v1")
+        build_fleet_dir(mdir, fdir, 2)
+        doc = read_fleet_manifest(fdir)
+        assert doc["schema"] == FLEET_MANIFEST_SCHEMA
+        bm = BucketMap.from_json(doc["bucket_map"])
+        assert bm == BucketMap.identity(2)
+
+    def test_v2_manifest_round_trip(self, elastic_base):
+        _, fdir, _ = elastic_base
+        doc = read_fleet_manifest(fdir)
+        assert doc["schema"] == FLEET_MANIFEST_SCHEMA_V2
+        bm = BucketMap.from_json(doc["bucket_map"])
+        assert bm == BucketMap.initial(NB, 2)
+        assert bm.shard_for_entity("u4") == 1
+
+    def test_unknown_future_schema_refused_typed(self, elastic_fleet_dir):
+        _, fdir, _ = elastic_fleet_dir
+        doc = read_fleet_manifest(fdir)
+        doc["schema"] = "photon_tpu.fleet.manifest.v3"
+        write_fleet_manifest(fdir, doc)   # crc-valid, schema from the future
+        with pytest.raises(FleetManifestError,
+                           match="unknown schema.*manifest.v3"):
+            read_fleet_manifest(fdir)
+        # a router must never boot on a manifest it cannot interpret
+        with pytest.raises(FleetManifestError):
+            ShardedServingFleet.from_fleet_dir(fdir)
+
+    def test_v1_doc_carrying_bucket_map_refused(self, elastic_base, tmp_path):
+        mdir, _, _ = elastic_base
+        fdir = os.path.join(str(tmp_path), "fleet_v1")
+        build_fleet_dir(mdir, fdir, 2)
+        # read_fleet_manifest injects the identity map; writing that doc
+        # back verbatim is exactly a torn v1->v2 upgrade
+        doc = read_fleet_manifest(fdir)
+        assert "bucket_map" in doc
+        write_fleet_manifest(fdir, doc)
+        with pytest.raises(FleetManifestError, match="torn upgrade"):
+            read_fleet_manifest(fdir)
+
+    def test_manifest_torn_write_v2(self, elastic_fleet_dir):
+        _, fdir, _ = elastic_fleet_dir
+        removed = chaos.manifest_torn_write(fdir)
+        assert removed > 0
+        with pytest.raises(FleetManifestError):
+            read_fleet_manifest(fdir)
+        with pytest.raises(FleetManifestError):
+            ShardedServingFleet.from_fleet_dir(fdir)
+
+
+# -- hedging vs known-dead shards --------------------------------------------
+
+
+class TestHedgeDeadShard:
+    def test_no_hedge_for_known_dead_shard(self, elastic_fleet_dir):
+        """A hop whose shard is KNOWN dead at hedge-arm time must not
+        arm a hedge — the second attempt would burn a pool slot racing
+        an answer that cannot come."""
+        mdir, fdir, names = elastic_fleet_dir
+        fleet = _mk_fleet(fdir, hedge_timeout_s=0.01)
+        try:
+            rng = np.random.default_rng(13)
+            sid = fleet.bucket_map.shard_for_entity("u4")
+            client = fleet._by_id[sid]
+
+            def slow_dead(reqs):
+                time.sleep(0.08)
+                return None
+
+            client.serve = slow_dead     # a remote that died mid-flight
+            client.alive = False
+            resps = fleet.serve([_mkreq(rng, "hx", names, "u4")])
+            assert fleet._stats[sid].hedges == 0
+            assert any(f.reason == FallbackReason.SHARD_UNAVAILABLE
+                       for f in resps[0].fallbacks)
+
+            # control: the SAME lag on a live shard still hedges
+            del client.serve             # back to the class method
+            client.alive = True
+            orig = type(client).serve
+
+            def slow_live(reqs):
+                time.sleep(0.05)
+                return orig(client, reqs)
+
+            client.serve = slow_live
+            fleet.serve([_mkreq(rng, "hy", names, "u4")])
+            assert fleet._stats[sid].hedges >= 1
+            del client.serve
+        finally:
+            fleet.shutdown()
+
+
+# -- live migration ----------------------------------------------------------
+
+
+class TestLiveMigration:
+    def test_happy_path_bitwise_through_window(self, elastic_fleet_dir):
+        mdir, fdir, names = elastic_fleet_dir
+        fleet = _mk_fleet(fdir)
+        try:
+            assert fleet.bucket_map.num_buckets == NB
+            assert fleet.bucket_map.shard_for_entity("u4") == 1
+            reqs, users = _mk_reqs(11, names)
+            base = _score_bits(_settle(fleet, reqs))
+            assert all(b is not None for b in base)
+            c0 = compile_cache.compile_counts().get("steady_state", 0)
+            v0 = read_fleet_manifest(fdir)["version"]
+
+            m = BucketMigrator(fleet, B_U4, 0)
+            copied = m.copy()
+            assert sum(copied.values()) >= 1
+            assert read_migration_journal(fdir)["phase"] == "copy"
+            w = m.open_double_read()
+
+            # routed traffic THROUGH the double-read window
+            for _ in range(3):
+                resps = fleet.serve(reqs)
+                assert _score_bits(resps) == base
+                for r, u in zip(resps, users):
+                    migrating = any(
+                        f.reason == FallbackReason.BUCKET_MIGRATING
+                        for f in r.fallbacks)
+                    assert migrating == (u == "u4")
+                _drain(fleet)
+            assert w.double_reads > 0
+            assert w.mismatches == 0 and not w.aborted
+
+            m.reconcile()
+            res = m.cutover()
+            assert res["version"] == v0 + 1
+            assert res["double_reads"] == w.double_reads
+            assert fleet.bucket_map.shard_of(B_U4) == 0
+            assert fleet.migration_windows() == {}
+            assert read_migration_journal(fdir) is None
+            doc = read_fleet_manifest(fdir)
+            assert doc["schema"] == FLEET_MANIFEST_SCHEMA_V2
+            assert BucketMap.from_json(doc["bucket_map"]).shard_of(B_U4) == 0
+
+            post = _settle(fleet, reqs)
+            assert _score_bits(post) == base
+            assert not any(f.reason == FallbackReason.BUCKET_MIGRATING
+                           for r in post for f in r.fallbacks)
+            # the whole migration compiled NOTHING new
+            assert compile_cache.compile_counts().get(
+                "steady_state", 0) == c0
+        finally:
+            fleet.shutdown()
+
+    def test_mismatch_poisons_window_and_abort_rolls_back(
+            self, elastic_fleet_dir):
+        mdir, fdir, names = elastic_fleet_dir
+        fleet = _mk_fleet(fdir)
+        try:
+            reqs, _ = _mk_reqs(17, names)
+            base = _score_bits(_settle(fleet, reqs))
+            m = BucketMigrator(fleet, B_U4, 0)
+            m.copy()
+            w = m.open_double_read()
+
+            # tamper the DESTINATION copy: the double-read must catch it
+            dst_path = shard_store_path(fdir, 0, "per-user")
+            st = ColdStore(dst_path)
+            r = st.entity_row("u4")
+            assert r is not None
+            rows = np.asarray([r], np.int64)
+            apply_cold_store_delta(
+                dst_path, update_rows=rows,
+                update_coef=st.read_rows(rows) + np.float32(0.25),
+                update_proj=st.read_proj_rows(rows))
+            m._refresh(0, "per-user")
+
+            during = []
+            for _ in range(3):
+                during.append(_score_bits(fleet.serve(reqs)))
+                _drain(fleet)
+            assert w.mismatches >= 1 and w.aborted
+            assert w.mismatch_detail
+            # the source stayed authoritative: served bits never moved
+            assert all(bits == base for bits in during)
+            with pytest.raises(MigrationError, match="poisoned"):
+                m.cutover()
+            assert fleet.bucket_map.shard_of(B_U4) == 1
+
+            m.abort("tampered destination")
+            assert fleet.migration_windows() == {}
+            assert read_migration_journal(fdir) is None
+            assert _score_bits(_settle(fleet, reqs)) == base
+        finally:
+            fleet.shutdown()
+
+
+# -- chaos: kills at every phase ---------------------------------------------
+
+
+class TestMigrationChaos:
+    def test_kill_mid_copy_then_resume(self, elastic_fleet_dir):
+        mdir, fdir, names = elastic_fleet_dir
+        fleet = _mk_fleet(fdir)
+        try:
+            reqs, _ = _mk_reqs(31, names)
+            base = _score_bits(_settle(fleet, reqs))
+            m = BucketMigrator(fleet, B_U4, 0)
+            with chaos.active(chaos.ChaosConfig(
+                    kill_publish_ops=("bucket_copy",))):
+                with pytest.raises(chaos.SimulatedKill):
+                    m.copy()
+            j = read_migration_journal(fdir)
+            assert j["phase"] == "copy" and j["bucket"] == B_U4
+            # the destination file is torn — and typed-refused
+            with pytest.raises(ColdStoreCorruptError):
+                ColdStore(shard_store_path(fdir, 0, "per-user")).verify()
+            # the router never read the copy: the old map keeps serving
+            assert _score_bits(fleet.serve(reqs)) == base
+
+            out = resume_migration(fleet)
+            assert out["resumed_phase"] == "copy" and out["dst"] == 0
+            assert read_migration_journal(fdir) is None
+            assert fleet.bucket_map.shard_of(B_U4) == 0
+            ColdStore(shard_store_path(fdir, 0, "per-user")).verify()
+            assert _score_bits(_settle(fleet, reqs)) == base
+        finally:
+            fleet.shutdown()
+
+    def test_kill_mid_double_read_fresh_process_resume(
+            self, elastic_fleet_dir):
+        """Die mid-window, then a FULL restart: a fresh fleet boots off
+        the old manifest (no window), the journal names the phase, and
+        resume rolls the migration forward bitwise."""
+        mdir, fdir, names = elastic_fleet_dir
+        fleet = _mk_fleet(fdir)
+        reqs, _ = _mk_reqs(37, names)
+        base = _score_bits(_settle(fleet, reqs))
+        m = BucketMigrator(fleet, B_U4, 0)
+        m.copy()
+        m.open_double_read()
+        fleet.serve(reqs)
+        fleet.shutdown()                  # the process "dies" mid-window
+
+        fleet2 = _mk_fleet(fdir)
+        try:
+            assert fleet2.bucket_map.shard_of(B_U4) == 1   # old map
+            assert fleet2.migration_windows() == {}
+            assert read_migration_journal(fdir)["phase"] == "double_read"
+            assert _score_bits(_settle(fleet2, reqs)) == base
+            out = resume_migration(fleet2)
+            assert out["resumed_phase"] == "double_read"
+            assert fleet2.bucket_map.shard_of(B_U4) == 0
+            assert read_migration_journal(fdir) is None
+            assert _score_bits(_settle(fleet2, reqs)) == base
+        finally:
+            fleet2.shutdown()
+
+    def test_kill_between_commit_and_manifest_bump(self, elastic_fleet_dir):
+        mdir, fdir, names = elastic_fleet_dir
+        fleet = _mk_fleet(fdir)
+        try:
+            reqs, _ = _mk_reqs(41, names)
+            base = _score_bits(_settle(fleet, reqs))
+            m = BucketMigrator(fleet, B_U4, 0)
+            m.copy()
+            m.open_double_read()
+            for _ in range(2):
+                fleet.serve(reqs)
+                _drain(fleet)
+            m.reconcile()
+            v0 = read_fleet_manifest(fdir)["version"]
+            with chaos.active(chaos.ChaosConfig(
+                    kill_publish_ops=("fleet_manifest",))):
+                with pytest.raises(chaos.SimulatedKill):
+                    m.cutover()
+            # the atomic bump never landed: OLD manifest intact, owner
+            # unchanged, journal pinned at cutover, fleet still serving
+            doc = read_fleet_manifest(fdir)
+            assert doc["version"] == v0
+            assert BucketMap.from_json(doc["bucket_map"]).shard_of(
+                B_U4) == 1
+            assert fleet.bucket_map.shard_of(B_U4) == 1
+            assert read_migration_journal(fdir)["phase"] == "cutover"
+            assert _score_bits(fleet.serve(reqs)) == base
+
+            out = resume_migration(fleet)
+            assert out["resumed_phase"] == "cutover"
+            assert read_fleet_manifest(fdir)["version"] == v0 + 1
+            assert fleet.bucket_map.shard_of(B_U4) == 0
+            assert read_migration_journal(fdir) is None
+            assert _score_bits(_settle(fleet, reqs)) == base
+        finally:
+            fleet.shutdown()
+
+    def test_torn_journal_refused_typed(self, elastic_fleet_dir):
+        _, fdir, _ = elastic_fleet_dir
+        # no journal: nothing in flight
+        assert resume_migration(object(), fleet_dir=fdir) is None
+        path = os.path.join(fdir, MIGRATION_JOURNAL_FILE)
+        # torn mid-write
+        with open(path, "w") as f:
+            f.write('{"schema": "photon_tpu.fleet.migration.v1", "buc')
+        with pytest.raises(MigrationError, match="unreadable"):
+            read_migration_journal(fdir)
+        with pytest.raises(MigrationError):
+            resume_migration(object(), fleet_dir=fdir)
+        # crc mismatch
+        doc = {"schema": "photon_tpu.fleet.migration.v1", "bucket": B_U4,
+               "src": 1, "dst": 0, "num_buckets": NB, "phase": "copy",
+               "coordinates": ["per-user"], "crc": 1}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        with pytest.raises(MigrationError, match="crc mismatch"):
+            read_migration_journal(fdir)
+        # unknown schema names the schema string
+        doc["schema"] = "photon_tpu.fleet.migration.v9"
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        with pytest.raises(MigrationError, match="migration.v9"):
+            read_migration_journal(fdir)
+
+
+# -- elastic fleet ops -------------------------------------------------------
+
+
+class TestElasticOps:
+    def test_provision_and_decommission(self, elastic_fleet_dir):
+        mdir, fdir, names = elastic_fleet_dir
+        fleet = _mk_fleet(fdir)
+        try:
+            reqs, _ = _mk_reqs(43, names)
+            base = _score_bits(_settle(fleet, reqs))
+            v0 = read_fleet_manifest(fdir)["version"]
+            doc = provision_shard(fleet, 5)
+            assert doc["num_shards"] == 3 and fleet.num_shards == 3
+            assert doc["version"] == v0 + 1
+            st = ColdStore(shard_store_path(fdir, 5, "per-user"))
+            assert st.num_entities == 0    # empty, updatable, idle
+            # an idle provisioned shard changes nothing the router serves
+            assert _score_bits(fleet.serve(reqs)) == base
+            # refuse removing a shard that still owns buckets
+            with pytest.raises(ValueError, match="still owns buckets"):
+                fleet.remove_shard(0)
+            doc2 = decommission_shard(fleet, 5)
+            assert doc2["num_shards"] == 2 and fleet.num_shards == 2
+            assert _score_bits(fleet.serve(reqs)) == base
+        finally:
+            fleet.shutdown()
+
+    def test_provision_refused_on_v1_layout(self, elastic_base, tmp_path):
+        mdir, _, names = elastic_base
+        fdir = os.path.join(str(tmp_path), "fleet_v1")
+        build_fleet_dir(mdir, fdir, 2)
+        fleet = _mk_fleet(fdir)
+        try:
+            with pytest.raises(MigrationError, match="v2 virtual-bucket"):
+                provision_shard(fleet, 2)
+        finally:
+            fleet.shutdown()
+
+
+# -- the autoscaler ----------------------------------------------------------
+
+
+class _FakeRegistry:
+    def __init__(self, shares, interval_s=1.0):
+        self._snap = {"timeseries": {
+            'fleet.shard.responses{shard="%d"}' % sid: {
+                "kind": "counter", "interval_s": interval_s,
+                "labels": {"shard": str(sid)},
+                "windows": [{"idx": 0, "value": float(v)}],
+            } for sid, v in shares.items()}}
+
+    def snapshot(self):
+        return self._snap
+
+
+class TestAutoscaler:
+    def test_decisions_on_synthetic_gauges(self, elastic_fleet_dir):
+        mdir, fdir, names = elastic_fleet_dir
+        fleet = _mk_fleet(fdir)
+        try:
+            cfg = AutoscaleConfig(hot_factor=1.5, cold_factor=0.25)
+            # hot skew -> split the hot shard
+            s = HotShardAutoscaler(fleet, cfg,
+                                   registry=_FakeRegistry({0: 90, 1: 10}))
+            assert s.decide() == {"action": "split", "shard": 0,
+                                  "share": 90.0, "mean": 50.0}
+            # balanced -> hold
+            s = HotShardAutoscaler(fleet, cfg,
+                                   registry=_FakeRegistry({0: 50, 1: 50}))
+            assert s.decide() is None
+            # cold shard (without a hot one) -> drain
+            cfg2 = AutoscaleConfig(hot_factor=10.0, cold_factor=0.25)
+            s = HotShardAutoscaler(fleet, cfg2,
+                                   registry=_FakeRegistry({0: 30, 1: 1}))
+            assert s.decide() == {"action": "drain", "shard": 1,
+                                  "share": 1.0, "mean": 15.5}
+            # below min_total -> hold (no signal)
+            s = HotShardAutoscaler(
+                fleet, AutoscaleConfig(min_total=100.0),
+                registry=_FakeRegistry({0: 30, 1: 1}))
+            assert s.decide() is None
+            # at min_shards a drain is never proposed
+            s = HotShardAutoscaler(
+                fleet, AutoscaleConfig(hot_factor=10.0, min_shards=2),
+                registry=_FakeRegistry({0: 30, 1: 1}))
+            assert s.decide() is None
+        finally:
+            fleet.shutdown()
+
+    def test_split_then_drain_end_to_end(self, elastic_fleet_dir):
+        mdir, fdir, names = elastic_fleet_dir
+        fleet = _mk_fleet(fdir)
+        try:
+            reqs, _ = _mk_reqs(23, names)
+            base = _score_bits(_settle(fleet, reqs))
+            scaler = HotShardAutoscaler(
+                fleet, AutoscaleConfig(hot_factor=1.5, buckets_per_step=2),
+                serving=_serving_config())
+            shares = scaler.shard_shares()
+            assert set(shares) == {0, 1}
+
+            # split shard 0 (owns u0..u3): provision shard 2, move the
+            # two hottest buckets, traffic flows through the windows
+            plan = scaler.step({"action": "split", "shard": 0})
+            assert plan["new_shard"] == 2 and len(plan["buckets"]) == 2
+            assert fleet.num_shards == 3
+            for _ in range(3):
+                assert _score_bits(fleet.serve(reqs)) == base
+                _drain(fleet)
+            wins = fleet.migration_windows()
+            assert set(wins) == set(plan["buckets"])
+            assert all(w["mismatches"] == 0 for w in wins.values())
+            assert any(w["double_reads"] > 0 for w in wins.values())
+            done = scaler.finish()
+            assert len(done["results"]) == 2
+            assert all(fleet.bucket_map.shard_of(b) == 2
+                       for b in plan["buckets"])
+            assert _score_bits(_settle(fleet, reqs)) == base
+
+            # drain shard 2 straight back and decommission it
+            plan2 = scaler.step({"action": "drain", "shard": 2})
+            assert set(plan2["buckets"]) == set(plan["buckets"])
+            for _ in range(2):
+                assert _score_bits(fleet.serve(reqs)) == base
+                _drain(fleet)
+            scaler.finish()
+            assert fleet.num_shards == 2
+            doc = read_fleet_manifest(fdir)
+            assert doc["num_shards"] == 2
+            assert all(sh["shard_id"] in (0, 1) for sh in doc["shards"])
+            assert _score_bits(_settle(fleet, reqs)) == base
+        finally:
+            fleet.shutdown()
+
+    def test_step_refused_while_plan_in_flight(self, elastic_fleet_dir):
+        mdir, fdir, names = elastic_fleet_dir
+        fleet = _mk_fleet(fdir)
+        try:
+            reqs, _ = _mk_reqs(29, names)
+            _settle(fleet, reqs)
+            scaler = HotShardAutoscaler(fleet, AutoscaleConfig(),
+                                        serving=_serving_config())
+            scaler.step({"action": "split", "shard": 0})
+            with pytest.raises(MigrationError, match="not finished"):
+                scaler.step({"action": "split", "shard": 1})
+            scaler.abort()                 # bitwise rollback, windows shut
+            assert fleet.migration_windows() == {}
+            assert read_migration_journal(fdir) is None
+            assert scaler.step({"action": "split", "shard": 0}) is not None
+            scaler.finish()
+        finally:
+            fleet.shutdown()
+
+
+# -- the tier-1 elastic bench smoke ------------------------------------------
+
+
+def test_elastic_quick_bench_smoke():
+    """Tier-1 smoke: the elastic bench's quick shape end to end —
+    replayed traffic, a live split and drain, chaos kill + resume — no
+    artifact write."""
+    bench = os.path.join(REPO, "bench.py")
+    proc = subprocess.run(
+        [sys.executable, bench, "--mode", "elastic", "--quick"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.splitlines()[-1])
+    assert rec["metric"] == "elastic_migration_gates_passed"
+    assert rec["quick"] is True
+    assert rec["value"] == 1.0
+    gates = rec["gates"]
+    assert gates["scale_out_completed"] is True
+    assert gates["scale_in_completed"] is True
+    assert gates["zero_downtime"] is True
+    assert gates["double_read_parity"] is True
+    assert gates["zero_steady_state_compiles"] is True
+    assert gates["survivor_bitwise_parity"] is True
+    assert gates["chaos_kill_resume"] is True
