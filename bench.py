@@ -5718,6 +5718,299 @@ def run_elastic_bench(scale: float, quick: bool = False):
     return rec
 
 
+# --------------------------------------------------------------------------
+# bayes mode: --mode bayes -> BENCH_BAYES_r01.json
+# --------------------------------------------------------------------------
+
+
+def _bayes_model_dir(out_dir, with_var, d_g=8, d_u=6, n_users=4, k=3,
+                     seed=41):
+    """Saved GAME model dir for the Thompson serving gates: a fixed
+    effect + one full-resident random effect, with or without the
+    posterior-variance column (the var-less twin pins mean-mode byte
+    identity under the thompson flag)."""
+    import jax.numpy as jnp
+
+    from photon_tpu.game.dataset import EntityVocabulary
+    from photon_tpu.game.model import (
+        FixedEffectModel,
+        GameModel,
+        RandomEffectModel,
+    )
+    from photon_tpu.io.index_map import IndexMap, feature_key
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.models.glm import Coefficients, GeneralizedLinearModel
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(seed)
+    im_g = IndexMap.from_keys([feature_key("g", str(j)) for j in range(d_g)])
+    im_u = IndexMap.from_keys([feature_key("u", str(j)) for j in range(d_u)])
+    theta = rng.normal(size=d_g).astype(np.float32)
+    fvar = (np.abs(rng.normal(size=d_g)) * 0.1).astype(np.float32)
+    proj = np.full((n_users, k), -1, np.int32)
+    coef = np.zeros((n_users, k), np.float32)
+    rvar = np.zeros((n_users, k), np.float32)
+    for e in range(n_users):
+        proj[e] = np.sort(rng.choice(d_u, size=k, replace=False))
+        coef[e] = rng.normal(size=k)
+        rvar[e] = np.abs(rng.normal(size=k)) * 0.05
+    users = [f"user{e}" for e in range(n_users)]
+    vocab = EntityVocabulary()
+    vocab.build("userId", users)
+    model = GameModel({
+        "fixed": FixedEffectModel(
+            GeneralizedLinearModel(
+                Coefficients(jnp.asarray(theta),
+                             jnp.asarray(fvar) if with_var else None),
+                TaskType.LOGISTIC_REGRESSION), "g"),
+        "per_user": RandomEffectModel(
+            jnp.asarray(coef), "userId", "u", TaskType.LOGISTIC_REGRESSION,
+            variances=jnp.asarray(rvar) if with_var else None),
+    })
+    save_game_model(out_dir, model, {"g": im_g, "u": im_u}, vocab=vocab,
+                    projections={"per_user": proj}, sparsity_threshold=0.0)
+    return users
+
+
+def _bayes_score_digest(responses) -> int:
+    """Arrival-order-independent bitwise digest of a served batch: crc32
+    chain over uid-sorted (uid, score repr, sorted fallback reasons)."""
+    import zlib as _z
+
+    dig = 0
+    for r in sorted(responses, key=lambda x: x.uid):
+        reasons = ",".join(sorted(f.reason.value for f in r.fallbacks))
+        dig = _z.crc32(f"{r.uid}|{r.score!r}|{reasons}".encode(), dig)
+    return dig & 0xFFFFFFFF
+
+
+def run_bayes_bench(scale: float, quick: bool = False):
+    """Bayesian GLMix gates (posterior-variance subsystem + Thompson
+    serving): (1) ridge closed form — ``StreamedLaplace`` over an
+    orthogonal-design squared-loss stream must match the dense
+    ``diag((X'WX + lambda I)^-1)`` to 1e-10 relative; (2) calibration —
+    per-entity GLMix posteriors on synthetic known-truth data (truth
+    drawn from the L2 prior, unit noise, one-hot designs so the diagonal
+    Laplace IS the exact posterior) must cover the truth with their 90%
+    intervals at empirical rate in [0.85, 0.95], and the blocked
+    variance pass must be bitwise run-to-run; (3) Thompson serving —
+    replay-twice bitwise digest under shuffled arrival order, typed
+    EXPLORING_COLD_START on unknown entities, zero steady-state
+    compiles, and mean-mode byte identity for var-less models under the
+    thompson flag.
+
+    ``quick`` is the tier-1 smoke shape: tiny sizes, no artifact
+    write."""
+    import random as _random
+    import shutil as _sh
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+
+    from photon_tpu.bayes import fixed_effect_variances_streamed
+    from photon_tpu.data.streaming import (ChunkLoader, DenseSource,
+                                            StreamConfig, ensure_aligned)
+    from photon_tpu.function.objective import GLMObjective
+    from photon_tpu.ops.losses import SquaredLoss
+
+    t0 = time.perf_counter()
+    gates = {}
+
+    # -- (1) ridge closed form: streamed Laplace vs dense inverse -----------
+    if quick:
+        n_r, d_r = 512, 16
+    else:
+        n_r, d_r = int(4096 * scale) or 512, 48
+    l2_r = 0.7
+    rng = np.random.default_rng(113)
+    # orthogonal columns: X'X is exactly diagonal, so the diagonal
+    # Laplace equals the dense closed form to float64 roundoff
+    q, _ = np.linalg.qr(rng.normal(size=(n_r, d_r)))
+    x_r = ensure_aligned(np.ascontiguousarray(
+        q * rng.uniform(0.5, 2.0, size=d_r)[None, :], np.float64))
+    y_r = ensure_aligned(rng.normal(size=n_r).astype(np.float64))
+    obj = GLMObjective(loss=SquaredLoss)
+    loader = ChunkLoader(DenseSource(x_r, y_r),
+                         StreamConfig(chunk_rows=max(n_r // 4, 64),
+                                      dtype=np.float64))
+    var_stream = fixed_effect_variances_streamed(
+        obj, loader, np.zeros(d_r, np.float64), l2_weight=l2_r)
+    closed = np.diag(np.linalg.inv(x_r.T @ x_r + l2_r * np.eye(d_r)))
+    ridge_rel = float(np.max(np.abs(var_stream - closed) / closed))
+    gates["ridge_closed_form_1e10"] = bool(ridge_rel <= 1e-10)
+    log(f"bayes: ridge closed-form max rel err {ridge_rel:.3e}")
+
+    # -- (2) calibration: known-truth per-entity posteriors -----------------
+    from photon_tpu.bayes import entity_variances_blocked
+    from photon_tpu.function.objective import L2Regularization
+    from photon_tpu.game.coordinate import RandomEffectCoordinate
+    from photon_tpu.game.dataset import (EntityVocabulary, FeatureShard,
+                                         GameDataFrame)
+    from photon_tpu.game.random_effect import (
+        RandomEffectDataConfiguration, build_random_effect_dataset)
+    from photon_tpu.optim.problem import GLMOptimizationConfiguration
+    from photon_tpu.types import TaskType
+
+    if quick:
+        e_c, k_c, m_c, d_c = 16, 3, 6, 12
+    else:
+        e_c = int(96 * scale) or 16
+        k_c, m_c, d_c = 4, 8, 24
+    lam = 1.0
+    z90 = 1.6448536269514722           # two-sided 90% normal quantile
+    rng = np.random.default_rng(211)
+    ent_ids = [f"e{i:04d}" for i in range(e_c)]
+    truth = {}                          # (entity, global col) -> w_true
+    rows, ids, resp = [], [], []
+    for ent in ent_ids:
+        cols = np.sort(rng.choice(d_c, size=k_c, replace=False))
+        for c in cols:
+            # truth drawn FROM the prior N(0, 1/lambda): the ridge
+            # posterior is then exactly calibrated, so 90% intervals
+            # cover at 90% in expectation — this is the spec the gate
+            # checks, not a tuned constant
+            w = rng.normal() / np.sqrt(lam)
+            truth[(ent, int(c))] = w
+            for _ in range(m_c):
+                x = rng.normal()
+                rows.append((np.array([c], np.int32),
+                             np.array([x], np.float64)))
+                ids.append(ent)
+                resp.append(x * w + rng.normal())
+    n_s = len(rows)
+    df = GameDataFrame(
+        num_samples=n_s, response=np.asarray(resp, np.float64),
+        feature_shards={"u": FeatureShard(rows, d_c)},
+        offsets=np.zeros(n_s), weights=np.ones(n_s),
+        id_tags={"userId": ids})
+    vocab = EntityVocabulary()
+    ds = build_random_effect_dataset(
+        df, RandomEffectDataConfiguration("userId", "u",
+                                          max_entity_buckets=4), vocab)
+    coord = RandomEffectCoordinate(
+        ds, n_s, "userId", "u", TaskType.LINEAR_REGRESSION,
+        config=GLMOptimizationConfiguration(
+            regularization=L2Regularization, regularization_weight=lam))
+    rem = coord.update_model_blocked(None)
+    coefs = np.asarray(rem.coefficients)
+    var1 = entity_variances_blocked(coord, rem.coefficients)
+    var2 = entity_variances_blocked(coord, rem.coefficients)
+    gates["variance_pass_bitwise"] = bool(
+        var1.tobytes() == var2.tobytes())
+    names = vocab.names("userId")
+    proj = np.asarray(ds.projection)
+    covered = total = 0
+    for r, name in enumerate(names):
+        for k in range(proj.shape[1]):
+            c = int(proj[r, k])
+            if c < 0 or var1[r, k] <= 0:
+                continue
+            total += 1
+            sigma = float(np.sqrt(var1[r, k]))
+            if abs(float(coefs[r, k]) - truth[(name, c)]) <= z90 * sigma:
+                covered += 1
+    coverage = covered / max(total, 1)
+    gates["calibration_coverage_90"] = bool(0.85 <= coverage <= 0.95)
+    log(f"bayes: 90% interval coverage {coverage:.4f} "
+        f"({covered}/{total} coefficients)")
+
+    # -- (3) Thompson serving: replay digest, typed cold start, compiles ----
+    from photon_tpu.serving.engine import ServingEngine
+    from photon_tpu.serving.types import (FallbackReason, ScoreRequest,
+                                          ServingConfig)
+    from photon_tpu.utils import compile_cache
+
+    tdir = tempfile.mkdtemp(prefix="bench_bayes_")
+    d_g, d_u = 8, 6
+    users = _bayes_model_dir(os.path.join(tdir, "var"), True,
+                             d_g=d_g, d_u=d_u)
+    _bayes_model_dir(os.path.join(tdir, "mean"), False, d_g=d_g, d_u=d_u)
+    rng = np.random.default_rng(307)
+    n_req = 64 if quick else 256
+    reqs = []
+    for i in range(n_req):
+        gf = [("g", str(j), float(rng.normal())) for j in range(d_g)]
+        uf = [("u", str(j), float(rng.normal())) for j in range(d_u)]
+        ent = (f"cold{i}" if i % 7 == 0
+               else users[int(rng.integers(0, len(users)))])
+        reqs.append(ScoreRequest(f"r{i:05d}", {"g": gf, "u": uf},
+                                 {"userId": ent}, float(rng.normal() * 0.1)))
+
+    cfg_t = ServingConfig(max_batch=16, max_wait_s=0.0,
+                          thompson_serving=True, thompson_seed=77)
+    eng = ServingEngine.from_model_dir(os.path.join(tdir, "var"),
+                                       config=cfg_t)
+    winfo = eng.warmup()
+    resp1 = eng.serve(reqs)
+    dig1 = _bayes_score_digest(resp1)
+    shuffled = list(reqs)
+    _random.Random(19).shuffle(shuffled)
+    steady0 = compile_cache.compile_counts().get("steady_state", 0)
+    resp2 = eng.serve(shuffled)
+    steady1 = compile_cache.compile_counts().get("steady_state", 0)
+    dig2 = _bayes_score_digest(resp2)
+    gates["thompson_replay_bitwise"] = bool(dig1 == dig2)
+    gates["zero_steady_state_compiles"] = bool(steady1 == steady0)
+    cold_ok = True
+    for r, rr in zip(shuffled, resp2):
+        reasons = {f.reason for f in rr.fallbacks}
+        if r.entity_ids["userId"].startswith("cold"):
+            cold_ok &= (FallbackReason.EXPLORING_COLD_START in reasons
+                        and FallbackReason.UNKNOWN_ENTITY not in reasons)
+        else:
+            cold_ok &= FallbackReason.EXPLORING_COLD_START not in reasons
+    gates["typed_cold_start_exploration"] = bool(cold_ok)
+
+    # var-less model under the thompson flag: byte-identical to a plain
+    # mean-mode engine — the flag must cost nothing when there is no
+    # uncertainty to sample
+    eng_plain = ServingEngine.from_model_dir(os.path.join(tdir, "mean"))
+    eng_plain.warmup()
+    base_scores = [r.score for r in eng_plain.serve(reqs)]
+    eng_flag = ServingEngine.from_model_dir(os.path.join(tdir, "mean"),
+                                            config=cfg_t)
+    eng_flag.warmup()
+    flag_scores = [r.score for r in eng_flag.serve(reqs)]
+    gates["mean_mode_bitwise_unchanged"] = bool(
+        base_scores == flag_scores
+        and not eng_flag.model.thompson_enabled)
+
+    rec = {
+        "metric": "bayes_gates_passed",
+        "value": round(sum(gates.values()) / len(gates), 4),
+        "unit": "fraction",
+        "gates": gates,
+        "ridge": {"n": n_r, "dim": d_r, "l2": l2_r,
+                  "max_rel_err": ridge_rel},
+        "calibration": {"entities": e_c, "slots": k_c,
+                        "samples_per_coef": m_c, "lambda": lam,
+                        "coverage": round(coverage, 4),
+                        "n_coefficients": total, "interval": 0.9},
+        "thompson": {"n_requests": n_req, "digest": dig1,
+                     "warmup_programs": winfo.get("programs"),
+                     "modes": list(winfo.get("modes", ()))},
+        "compile_delta": steady1 - steady0,
+        "wall_s": round(time.perf_counter() - t0, 2),
+        "device": getattr(jax.devices()[0], "device_kind",
+                          str(jax.devices()[0])),
+        "tpu_unavailable": _STATE["tpu_unavailable"],
+        "quick": quick,
+    }
+    _sh.rmtree(tdir, ignore_errors=True)
+    if not quick:
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_BAYES_r01.json"), "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
+    log(f"bayes: {sum(gates.values())}/{len(gates)} gates passed "
+        f"({', '.join(k for k, v in gates.items() if not v) or 'all'}"
+        f"{' failing' if not all(gates.values()) else ''})")
+    return rec
+
+
 # Order = on-chip capture priority (each config emits its JSON line the
 # moment it completes, so when the flaky relay dies mid-run the most
 # decision-relevant numbers are already on disk): the NEWTON flagship,
@@ -5758,7 +6051,7 @@ def main():
                     choices=("train", "serving", "game_cd", "coldtier",
                              "nearline", "hier", "fused", "stream", "fleet",
                              "tenant", "ingest", "sweep", "sdca",
-                             "re_sweep", "replay", "elastic"),
+                             "re_sweep", "replay", "elastic", "bayes"),
                     help="train = the solver configs (default); serving = "
                          "the online-serving bench -> BENCH_SERVING_r01.json; "
                          "game_cd = parallel-vs-sequential CD sweeps "
@@ -5789,12 +6082,14 @@ def main():
                          "capture + deterministic replay + SLO gates "
                          "-> BENCH_REPLAY_r01.json; elastic = live bucket "
                          "resharding + gauge-driven autoscale under replay "
-                         "-> BENCH_ELASTIC_r01.json")
+                         "-> BENCH_ELASTIC_r01.json; bayes = Laplace "
+                         "posterior calibration + Thompson serving replay "
+                         "-> BENCH_BAYES_r01.json")
     ap.add_argument("--quick", action="store_true",
                     help="game_cd/coldtier/nearline/hier/fused/stream/"
                          "fleet/tenant/ingest/sweep/sdca/re_sweep/replay/"
-                         "elastic: tiny tier-1 smoke shape (no artifact "
-                         "write)")
+                         "elastic/bayes: tiny tier-1 smoke shape (no "
+                         "artifact write)")
     ap.add_argument("--platform", default=os.environ.get("BENCH_PLATFORM", ""))
     ap.add_argument("--probe-timeout", type=float,
                     default=float(os.environ.get("BENCH_PROBE_TIMEOUT", "600")),
@@ -5898,6 +6193,21 @@ def main():
             emit({"metric": "elastic_migration_gates_passed", "value": 0.0,
                   "unit": "fraction", "error": repr(e)})
         _DONE.set()     # elastic mode: the record above IS the summary
+        return
+
+    if args.mode == "bayes":
+        try:
+            from photon_tpu.obs.spans import span as _obs_span
+            with _obs_span("bench/bayes"):
+                emit(run_bayes_bench(args.scale, quick=args.quick))
+        except Exception as e:
+            import traceback
+
+            log(f"bayes bench FAILED: {e!r}")
+            traceback.print_exc(file=sys.stderr)
+            emit({"metric": "bayes_gates_passed", "value": 0.0,
+                  "unit": "fraction", "error": repr(e)})
+        _DONE.set()     # bayes mode: the record above IS the summary
         return
 
     if args.mode == "tenant":

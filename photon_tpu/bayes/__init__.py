@@ -1,0 +1,22 @@
+"""Posterior-uncertainty subsystem: diagonal-Hessian Laplace variances.
+
+``laplace`` computes per-coefficient posterior variances
+``sigma^2 = 1 / (H_ii + lambda)`` at a fitted optimum — the Bayesian
+output the reference repo's model contract (``BayesianLinearModelAvro``
+means + variances) has carried since day one. Downstream they persist
+through the checkpoint / cold-store / Avro schemas and open the
+Thompson-sampling serving mode (``serving/scorer.py`` mode
+``"thompson"``).
+"""
+
+from photon_tpu.bayes.laplace import (
+    StreamedLaplace,
+    entity_variances_blocked,
+    fixed_effect_variances_streamed,
+)
+
+__all__ = [
+    "StreamedLaplace",
+    "entity_variances_blocked",
+    "fixed_effect_variances_streamed",
+]

@@ -62,6 +62,13 @@ from photon_tpu.resilience import chaos as _chaos
 MAGIC = b"PHOTCOLD"
 SCHEMA = "photon_tpu.coldstore.v1"
 SCHEMA_V2 = "photon_tpu.coldstore.v2"
+# Bayesian column (PR 20): v3 = v1 + an optional [E, slot_width] f32
+# posterior-variance section (between proj and ids), v4 = v2 + the same
+# section with its OWN per-chunk crc entries. Files without variances
+# keep writing v1/v2 BYTE-IDENTICAL to before — the variance column is
+# strictly additive, and every v1/v2 reader path is unchanged.
+SCHEMA_V3 = "photon_tpu.coldstore.v3"
+SCHEMA_V4 = "photon_tpu.coldstore.v4"
 COLD_STORE_DIR = "cold-store"
 COLD_STORE_SUFFIX = ".coldstore"
 _ALIGN = 64
@@ -133,22 +140,30 @@ def _aligned(pos: int) -> int:
     return pos + ((-pos) % _ALIGN)
 
 
-def normalize_slot_rows(coefficients: np.ndarray, projection: np.ndarray
-                        ) -> Tuple[np.ndarray, np.ndarray]:
+def normalize_slot_rows(coefficients: np.ndarray, projection: np.ndarray,
+                        variances: Optional[np.ndarray] = None):
     """Normalize coefficient/projection rows to the canonical on-disk and
     serving form: valid slots sorted ascending by global column, -1 pads
     last. The serving hot-tier slot replay (searchsorted over the valid
     prefix) and the bitwise delta-parity gates both depend on every row —
     whether written at model save or row-published nearline — being in
     exactly this layout. Rows already normalized pass through unchanged
-    (stable sort)."""
+    (stable sort). When ``variances`` is given it rides the SAME slot
+    permutation (a variance belongs to its coefficient) and a 3-tuple is
+    returned."""
     coefficients = np.asarray(coefficients, dtype=np.float32)
     projection = np.asarray(projection, dtype=np.int32)
+    if variances is not None:
+        variances = np.asarray(variances, dtype=np.float32)
     if coefficients.size and coefficients.shape[-1] > 1:
         key = np.where(projection < 0, np.iinfo(np.int32).max, projection)
         slot_order = np.argsort(key, axis=-1, kind="stable")
         projection = np.take_along_axis(projection, slot_order, axis=-1)
         coefficients = np.take_along_axis(coefficients, slot_order, axis=-1)
+        if variances is not None:
+            variances = np.take_along_axis(variances, slot_order, axis=-1)
+    if variances is not None:
+        return coefficients, projection, variances
     return coefficients, projection
 
 
@@ -166,6 +181,7 @@ def write_cold_store(
     capacity: Optional[int] = None,
     id_blob_cap: Optional[int] = None,
     rows_per_chunk: int = 4096,
+    variances: Optional[np.ndarray] = None,
 ) -> str:
     """Write one coordinate's cold-tier file; returns its path.
 
@@ -178,6 +194,11 @@ def write_cold_store(
     rows and ``id_blob_cap`` reserved id bytes (defaults: ~25% headroom)
     so the nearline publisher can row-update and entity-append in place;
     the crc footer becomes a per-``rows_per_chunk`` chunk table.
+
+    ``variances`` (optional ``[E, slot_width]`` f32, same slot layout as
+    ``coefficients``) adds the Bayesian posterior-variance column —
+    schema bumps to v3 (plain) / v4 (updatable). Omitting it writes
+    v1/v2 files byte-identical to pre-variance builds.
     """
     coefficients = np.asarray(coefficients, dtype=np.float32)
     projection = np.asarray(projection, dtype=np.int32)
@@ -189,12 +210,22 @@ def write_cold_store(
     if ids.shape != (num_entities,):
         raise ValueError(f"{ids.shape[0]} entity ids for "
                          f"{num_entities} rows")
+    if variances is not None:
+        variances = np.asarray(variances, dtype=np.float32)
+        if variances.shape != coefficients.shape:
+            raise ValueError(f"variances shape {variances.shape} != "
+                             f"coefficients shape {coefficients.shape}")
 
     # normalize every row to (valid slots sorted ascending by global
     # column, -1 pads last) — the invariant the serving hot-tier slot
     # replay (searchsorted over the valid prefix) depends on; rows
     # already in that form pass through unchanged (stable sort)
-    coefficients, projection = normalize_slot_rows(coefficients, projection)
+    if variances is None:
+        coefficients, projection = normalize_slot_rows(coefficients,
+                                                       projection)
+    else:
+        coefficients, projection, variances = normalize_slot_rows(
+            coefficients, projection, variances)
 
     order = np.argsort(ids, kind="stable")
     if updatable:
@@ -202,11 +233,12 @@ def write_cold_store(
             path, coordinate_id, random_effect_type, feature_shard_id,
             coefficients, projection, ids, order,
             capacity=capacity, id_blob_cap=id_blob_cap,
-            rows_per_chunk=rows_per_chunk, chunk_rows=chunk_rows)
+            rows_per_chunk=rows_per_chunk, chunk_rows=chunk_rows,
+            variances=variances)
     ids = ids[order]
 
     header = {
-        "schema": SCHEMA,
+        "schema": SCHEMA if variances is None else SCHEMA_V3,
         "coordinate_id": coordinate_id,
         "random_effect_type": random_effect_type,
         "feature_shard_id": feature_shard_id,
@@ -221,8 +253,14 @@ def write_cold_store(
     # length, then fill real offsets and pad back to the reserved length
     # — the header's byte length never depends on the offset values
     _SENTINEL = 10 ** 14
-    for key in ("coef_off", "proj_off", "id_offsets_off", "id_blob_off",
-                "id_blob_len"):
+    sentinel_keys = ["coef_off", "proj_off", "id_offsets_off", "id_blob_off",
+                     "id_blob_len"]
+    if variances is not None:
+        # the var_off key only exists in v3 headers, so v1 headers (and
+        # therefore whole v1 files) stay byte-identical to pre-variance
+        # builds
+        sentinel_keys.append("var_off")
+    for key in sentinel_keys:
         header[key] = _SENTINEL
     reserved = len(json.dumps(header).encode())
     base = len(MAGIC) + 4 + reserved
@@ -232,7 +270,13 @@ def write_cold_store(
 
     coef_off = aligned(base)
     proj_off = aligned(coef_off + num_entities * slot_width * 4)
-    id_offsets_off = aligned(proj_off + num_entities * slot_width * 4)
+    after_proj = aligned(proj_off + num_entities * slot_width * 4)
+    if variances is not None:
+        var_off = after_proj
+        id_offsets_off = aligned(var_off + num_entities * slot_width * 4)
+    else:
+        var_off = 0
+        id_offsets_off = after_proj
     if id_width:
         id_blob_off = id_offsets_off
         id_offsets_off = 0
@@ -243,6 +287,8 @@ def write_cold_store(
     header.update(coef_off=coef_off, proj_off=proj_off,
                   id_offsets_off=id_offsets_off, id_blob_off=id_blob_off,
                   id_blob_len=id_blob_len)
+    if variances is not None:
+        header.update(var_off=var_off)
     header_bytes = json.dumps(header).encode()
     header_bytes += b" " * (reserved - len(header_bytes))
 
@@ -271,6 +317,12 @@ def write_cold_store(
             sel = order[lo:lo + chunk_rows]
             put(np.ascontiguousarray(projection[sel]).tobytes())
         crc, pos = _pad(f, crc, pos)
+        if variances is not None:
+            assert pos == header["var_off"], (pos, header["var_off"])
+            for lo in range(0, num_entities, chunk_rows):
+                sel = order[lo:lo + chunk_rows]
+                put(np.ascontiguousarray(variances[sel]).tobytes())
+            crc, pos = _pad(f, crc, pos)
         if id_width:
             put(ids.tobytes())
         else:
@@ -333,12 +385,25 @@ def _region_crc(f, lo: int, hi: int, buf: int = 4 << 20) -> int:
     return crc
 
 
+def _v2_sections(h: dict) -> int:
+    """Number of chunked data sections in the crc table: 3 when the file
+    carries the v4 variance column, else 2."""
+    return 3 if h.get("var_off") else 2
+
+
 def _v2_chunk_bounds(h: dict, section: str) -> List[Tuple[int, int]]:
-    """Byte ranges of each crc chunk of the coef/proj section. The last
-    chunk extends to the next section offset so alignment padding is
+    """Byte ranges of each crc chunk of the coef/proj/var section. The
+    last chunk extends to the next section offset so alignment padding is
     always covered by exactly one crc entry."""
-    off = h["coef_off"] if section == "coef" else h["proj_off"]
-    end = h["proj_off"] if section == "coef" else h["id_offsets_off"]
+    if section == "coef":
+        off, end = h["coef_off"], h["proj_off"]
+    elif section == "proj":
+        off = h["proj_off"]
+        end = h.get("var_off") or h["id_offsets_off"]
+    elif section == "var":
+        off, end = h["var_off"], h["id_offsets_off"]
+    else:
+        raise ValueError(f"unknown section {section!r}")
     csz = h["rows_per_chunk"] * h["slot_width"] * 4
     n = h["n_chunks"]
     return [(off + ci * csz, end if ci == n - 1 else min(off + (ci + 1) * csz, end))
@@ -346,13 +411,15 @@ def _v2_chunk_bounds(h: dict, section: str) -> List[Tuple[int, int]]:
 
 
 def _v2_recompute_crcs(f, h: dict, *, coef_chunks=None, proj_chunks=None,
-                       ids: bool = True, sort: bool = True,
+                       var_chunks=None, ids: bool = True, sort: bool = True,
                        header: bool = True) -> None:
     """Recompute and write the selected crc-table entries by reading the
-    current file bytes back. ``coef_chunks``/``proj_chunks`` are chunk
-    indices (None = all). Table layout: [coef chunks..., proj chunks...,
-    ids region, sort region, header region]."""
+    current file bytes back. ``coef_chunks``/``proj_chunks``/``var_chunks``
+    are chunk indices (None = all). Table layout: [coef chunks..., proj
+    chunks..., var chunks... (v4 only), ids region, sort region, header
+    region]."""
     n = h["n_chunks"]
+    s = _v2_sections(h)
     coef_bounds = _v2_chunk_bounds(h, "coef")
     proj_bounds = _v2_chunk_bounds(h, "proj")
     entries: List[Tuple[int, int, int]] = []  # (table idx, lo, hi)
@@ -360,12 +427,17 @@ def _v2_recompute_crcs(f, h: dict, *, coef_chunks=None, proj_chunks=None,
         entries.append((ci,) + coef_bounds[ci])
     for ci in sorted(set(range(n) if proj_chunks is None else proj_chunks)):
         entries.append((n + ci,) + proj_bounds[ci])
+    if s == 3:
+        var_bounds = _v2_chunk_bounds(h, "var")
+        for ci in sorted(set(range(n) if var_chunks is None
+                             else var_chunks)):
+            entries.append((2 * n + ci,) + var_bounds[ci])
     if ids:
-        entries.append((2 * n, h["id_offsets_off"], h["sort_off"]))
+        entries.append((s * n, h["id_offsets_off"], h["sort_off"]))
     if sort:
-        entries.append((2 * n + 1, h["sort_off"], h["crc_off"]))
+        entries.append((s * n + 1, h["sort_off"], h["crc_off"]))
     if header:
-        entries.append((2 * n + 2, 0, h["coef_off"]))
+        entries.append((s * n + 2, 0, h["coef_off"]))
     for idx, lo, hi in entries:
         crc = _region_crc(f, lo, hi)
         f.seek(h["crc_off"] + 4 * idx)
@@ -386,13 +458,15 @@ def _write_cold_store_v2(
     id_blob_cap: Optional[int],
     rows_per_chunk: int,
     chunk_rows: int = 262144,
+    variances: Optional[np.ndarray] = None,
 ) -> str:
     """Write the updatable layout. ``order`` maps storage row -> input
     index; ``write_cold_store`` passes an id-sort (fresh files start
     physically sorted, making the sort indirection the identity) while
     ``upgrade_cold_store`` passes arange to keep every existing storage
     row number stable — the serving hot tier caches cold row indices, so
-    an upgrade must never renumber rows."""
+    an upgrade must never renumber rows. ``variances`` adds the v4
+    posterior-variance section (capacity-sized, its own crc chunks)."""
     num_entities, slot_width = coefficients.shape
     lengths = np.char.str_len(ids).astype(np.int64) if num_entities else \
         np.zeros(0, dtype=np.int64)
@@ -407,7 +481,7 @@ def _write_cold_store_v2(
     n_chunks = -(-capacity // rows_per_chunk)
 
     header = {
-        "schema": SCHEMA_V2,
+        "schema": SCHEMA_V2 if variances is None else SCHEMA_V4,
         "coordinate_id": coordinate_id,
         "random_effect_type": random_effect_type,
         "feature_shard_id": feature_shard_id,
@@ -422,24 +496,36 @@ def _write_cold_store_v2(
     # same one-pass trick as v1, extended to the fields a delta mutates
     # (num_entities, id_blob_used): measure with sentinels, fill real
     # values, pad — so an in-place header rewrite can never overflow
-    for key in ("num_entities", "id_blob_used", "coef_off", "proj_off",
-                "id_offsets_off", "id_blob_off", "id_blob_len", "sort_off",
-                "crc_off"):
+    sentinel_keys = ["num_entities", "id_blob_used", "coef_off", "proj_off",
+                     "id_offsets_off", "id_blob_off", "id_blob_len",
+                     "sort_off", "crc_off"]
+    if variances is not None:
+        sentinel_keys.append("var_off")
+    for key in sentinel_keys:
         header[key] = _SENTINEL
     reserved = len(json.dumps(header).encode())
     base = len(MAGIC) + 4 + reserved
     coef_off = _aligned(base)
     proj_off = _aligned(coef_off + capacity * slot_width * 4)
-    id_offsets_off = _aligned(proj_off + capacity * slot_width * 4)
+    after_proj = _aligned(proj_off + capacity * slot_width * 4)
+    if variances is not None:
+        var_off = after_proj
+        id_offsets_off = _aligned(var_off + capacity * slot_width * 4)
+    else:
+        var_off = 0
+        id_offsets_off = after_proj
     id_blob_off = _aligned(id_offsets_off + (capacity + 1) * 8)
     sort_off = _aligned(id_blob_off + id_blob_cap)
     crc_off = _aligned(sort_off + capacity * 8)
-    file_end = crc_off + 4 * (2 * n_chunks + 3)
+    n_sections = 2 if variances is None else 3
+    file_end = crc_off + 4 * (n_sections * n_chunks + 3)
     header.update(num_entities=int(num_entities), id_blob_used=blob_used,
                   coef_off=coef_off, proj_off=proj_off,
                   id_offsets_off=id_offsets_off, id_blob_off=id_blob_off,
                   id_blob_len=int(id_blob_cap), sort_off=sort_off,
                   crc_off=crc_off)
+    if variances is not None:
+        header.update(var_off=var_off)
     header_bytes = json.dumps(header).encode()
     header_bytes += b" " * (reserved - len(header_bytes))
 
@@ -461,6 +547,11 @@ def _write_cold_store_v2(
         for lo in range(0, num_entities, chunk_rows):
             sel = order[lo:lo + chunk_rows]
             f.write(np.ascontiguousarray(projection[sel]).tobytes())
+        if variances is not None:
+            f.seek(var_off)
+            for lo in range(0, num_entities, chunk_rows):
+                sel = order[lo:lo + chunk_rows]
+                f.write(np.ascontiguousarray(variances[sel]).tobytes())
         offsets = np.full(capacity + 1, blob_used, dtype=np.uint64)
         offsets[0] = 0
         if num_entities:
@@ -507,9 +598,9 @@ class ColdStore:
                 h = json.loads(f.read(hlen))
             except (ValueError, UnicodeDecodeError) as e:
                 raise ColdStoreCorruptError(path, f"unparseable header: {e}")
-        if h.get("schema") not in (SCHEMA, SCHEMA_V2):
+        if h.get("schema") not in (SCHEMA, SCHEMA_V2, SCHEMA_V3, SCHEMA_V4):
             raise ColdStoreCorruptError(path, f"schema {h.get('schema')!r}")
-        self.updatable: bool = h["schema"] == SCHEMA_V2
+        self.updatable: bool = h["schema"] in (SCHEMA_V2, SCHEMA_V4)
         self._h = dict(h)
         self.coordinate_id: str = h["coordinate_id"]
         self.random_effect_type: str = h["random_effect_type"]
@@ -528,6 +619,14 @@ class ColdStore:
                               mode="r", offset=h["coef_off"], shape=shape)
         self.proj = np.memmap(path, dtype=np.dtype(h["proj_dtype"]),
                               mode="r", offset=h["proj_off"], shape=shape)
+        if h.get("var_off"):
+            # v3/v4 Bayesian posterior-variance column, same row/slot
+            # layout as coef; None on v1/v2 files (mean-only models)
+            self.var: Optional[np.memmap] = np.memmap(
+                path, dtype=np.float32, mode="r", offset=h["var_off"],
+                shape=shape)
+        else:
+            self.var = None
         if self._id_width:
             self._id_blob = np.memmap(
                 path, dtype=np.uint8, mode="r", offset=h["id_blob_off"],
@@ -618,6 +717,20 @@ class ColdStore:
         return np.asarray(self.proj[np.asarray(rows, dtype=np.int64)],
                           dtype=np.int32)
 
+    @property
+    def has_variances(self) -> bool:
+        return self.var is not None
+
+    def read_var_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Posterior-variance rows [len(rows), slot_width] as a fresh
+        float32 host array. Raises on mean-only (v1/v2) files — callers
+        gate on ``has_variances``."""
+        if self.var is None:
+            raise ValueError(f"cold store at {self.path} has no variance "
+                             f"column (schema {self._h.get('schema')!r})")
+        return np.asarray(self.var[np.asarray(rows, dtype=np.int64)],
+                          dtype=np.float32)
+
     def iter_blocks(self, block_rows: int,
                     start_row: int = 0
                     ) -> Iterator[Tuple[int, List[str], np.ndarray,
@@ -665,7 +778,8 @@ class ColdStore:
     def _verify_v2(self) -> None:
         h = self._h
         n = h["n_chunks"]
-        expected_size = h["crc_off"] + 4 * (2 * n + 3)
+        s = _v2_sections(h)
+        expected_size = h["crc_off"] + 4 * (s * n + 3)
         size = os.path.getsize(self.path)
         if size != expected_size:
             raise ColdStoreCorruptError(
@@ -679,14 +793,17 @@ class ColdStore:
             regions.append((f"coef chunk {ci}", ci, lo, hi))
         for ci, (lo, hi) in enumerate(_v2_chunk_bounds(h, "proj")):
             regions.append((f"proj chunk {ci}", n + ci, lo, hi))
-        regions.append(("id table", 2 * n, h["id_offsets_off"],
+        if s == 3:
+            for ci, (lo, hi) in enumerate(_v2_chunk_bounds(h, "var")):
+                regions.append((f"var chunk {ci}", 2 * n + ci, lo, hi))
+        regions.append(("id table", s * n, h["id_offsets_off"],
                         h["sort_off"]))
-        regions.append(("sort table", 2 * n + 1, h["sort_off"],
+        regions.append(("sort table", s * n + 1, h["sort_off"],
                         h["crc_off"]))
-        regions.append(("header", 2 * n + 2, 0, h["coef_off"]))
+        regions.append(("header", s * n + 2, 0, h["coef_off"]))
         with open(self.path, "rb") as f:
             f.seek(h["crc_off"])
-            table = np.frombuffer(f.read(4 * (2 * n + 3)), dtype="<u4")
+            table = np.frombuffer(f.read(4 * (s * n + 3)), dtype="<u4")
             for name, idx, lo, hi in regions:
                 crc = _region_crc(f, lo, hi)
                 if crc != int(table[idx]):
@@ -712,6 +829,7 @@ class ColdStore:
             "file_bytes": self.file_bytes,
             "updatable": self.updatable,
             "capacity": self.capacity,
+            "has_variances": self.has_variances,
         }
 
 
@@ -727,11 +845,21 @@ def apply_cold_store_delta(
     append_ids: Sequence[str] = (),
     append_coef: Optional[np.ndarray] = None,
     append_proj: Optional[np.ndarray] = None,
+    update_var: Optional[np.ndarray] = None,
+    append_var: Optional[np.ndarray] = None,
     normalize: bool = True,
     chaos_op: Optional[str] = "cold_delta",
 ) -> dict:
-    """Apply a row-level delta to a v2 file in place; returns the undo
+    """Apply a row-level delta to a v2/v4 file in place; returns the undo
     record ``rollback_cold_store_delta`` needs for a bitwise restore.
+
+    On v4 files ``update_var``/``append_var`` carry the posterior
+    variances alongside the means. A delta that omits ``update_var``
+    leaves the updated rows' existing variance bytes untouched (a
+    mean-only refresh never silently zeroes uncertainty); appends that
+    omit ``append_var`` land zero variances (served at the mean until a
+    Bayesian pass republishes them). Passing either on a v2 (var-less)
+    file is a typed error — upgrade the file with variances first.
 
     Write order is data rows -> (chaos kill point) -> id tail -> sort
     rebuild -> header -> touched-chunk crcs -> fsync, so a crash at any
@@ -747,8 +875,14 @@ def apply_cold_store_delta(
     caller which storage rows the new entities landed on.
     """
     h, hlen = _read_header(path)
-    if h.get("schema") != SCHEMA_V2:
+    if h.get("schema") not in (SCHEMA_V2, SCHEMA_V4):
         raise ColdStoreNotUpdatable(path, h.get("schema"))
+    has_var = bool(h.get("var_off"))
+    if (update_var is not None or append_var is not None) and not has_var:
+        raise ValueError(
+            f"cold store at {path} (schema {h.get('schema')!r}) has no "
+            f"variance column; rewrite it with variances (v4) before "
+            f"publishing variance deltas")
     slot_width = h["slot_width"]
     num_entities = h["num_entities"]
     capacity = h["capacity"]
@@ -776,6 +910,16 @@ def apply_cold_store_delta(
             append_proj.shape != (n_app, slot_width):
         raise ValueError(f"append arrays must be [{n_app}, {slot_width}], "
                          f"got {append_coef.shape} / {append_proj.shape}")
+    if update_var is not None:
+        update_var = np.asarray(update_var, np.float32)
+        if update_var.shape != (n_upd, slot_width):
+            raise ValueError(f"update_var must be [{n_upd}, {slot_width}], "
+                             f"got {update_var.shape}")
+    if append_var is not None:
+        append_var = np.asarray(append_var, np.float32)
+        if append_var.shape != (n_app, slot_width):
+            raise ValueError(f"append_var must be [{n_app}, {slot_width}], "
+                             f"got {append_var.shape}")
     if n_upd and (np.unique(update_rows).size != n_upd
                   or update_rows.min() < 0
                   or update_rows.max() >= num_entities):
@@ -784,10 +928,18 @@ def apply_cold_store_delta(
     if len(set(append_ids)) != n_app:
         raise ValueError("duplicate ids in append_ids")
     if normalize:
-        update_coef, update_proj = normalize_slot_rows(update_coef,
-                                                       update_proj)
-        append_coef, append_proj = normalize_slot_rows(append_coef,
-                                                       append_proj)
+        if update_var is not None:
+            update_coef, update_proj, update_var = normalize_slot_rows(
+                update_coef, update_proj, update_var)
+        else:
+            update_coef, update_proj = normalize_slot_rows(update_coef,
+                                                           update_proj)
+        if append_var is not None:
+            append_coef, append_proj, append_var = normalize_slot_rows(
+                append_coef, append_proj, append_var)
+        else:
+            append_coef, append_proj = normalize_slot_rows(append_coef,
+                                                           append_proj)
 
     new_id_bytes = [e.encode("utf-8") for e in append_ids]
     blob_add = sum(len(b) for b in new_id_bytes)
@@ -806,10 +958,12 @@ def apply_cold_store_delta(
             raise ValueError(f"append_ids already present: {dup[:5]}")
 
     undo = {
-        "schema": SCHEMA_V2,
+        "schema": h["schema"],
         "update_rows": update_rows.copy(),
         "prior_update_coef": np.zeros((n_upd, slot_width), np.float32),
         "prior_update_proj": np.zeros((n_upd, slot_width), np.int32),
+        "prior_update_var": (np.zeros((n_upd, slot_width), np.float32)
+                             if update_var is not None else None),
         "prior_num_entities": num_entities,
         "prior_id_blob_used": blob_used,
         "append_rows": np.arange(num_entities, num_entities + n_app,
@@ -827,6 +981,10 @@ def apply_cold_store_delta(
             f.seek(h["proj_off"] + int(r) * rowb)
             undo["prior_update_proj"][i] = np.frombuffer(f.read(rowb),
                                                          np.int32)
+            if update_var is not None:
+                f.seek(h["var_off"] + int(r) * rowb)
+                undo["prior_update_var"][i] = np.frombuffer(f.read(rowb),
+                                                            np.float32)
         existing_ids: List[bytes] = []
         if n_app:
             f.seek(h["id_offsets_off"])
@@ -844,17 +1002,30 @@ def apply_cold_store_delta(
             f.write(np.ascontiguousarray(update_coef[i]).tobytes())
             f.seek(h["proj_off"] + int(r) * rowb)
             f.write(np.ascontiguousarray(update_proj[i]).tobytes())
+            if update_var is not None:
+                f.seek(h["var_off"] + int(r) * rowb)
+                f.write(np.ascontiguousarray(update_var[i]).tobytes())
+        zero_var = (np.zeros(slot_width, np.float32)
+                    if has_var and append_var is None else None)
         for j in range(n_app):
             r = num_entities + j
             f.seek(h["coef_off"] + r * rowb)
             f.write(np.ascontiguousarray(append_coef[j]).tobytes())
             f.seek(h["proj_off"] + r * rowb)
             f.write(np.ascontiguousarray(append_proj[j]).tobytes())
+            if has_var:
+                # appended entities without a variance delta get explicit
+                # zeros (served at the mean) — reserve bytes there may be
+                # stale from a rolled-back append
+                row_var = zero_var if append_var is None else append_var[j]
+                f.seek(h["var_off"] + r * rowb)
+                f.write(np.ascontiguousarray(row_var).tobytes())
         # torn-update kill point: data landed, ids/header/crcs stale —
         # a kill here must leave a file verify() refuses
         if chaos_op is not None:
             _chaos.at_publish(chaos_op)
         touched = set((update_rows // h["rows_per_chunk"]).tolist())
+        var_touched = set(touched) if update_var is not None else set()
         if n_app:
             offs = np.frombuffer(undo["prior_id_offsets_bytes"],
                                  np.uint64).copy()
@@ -876,9 +1047,13 @@ def apply_cold_store_delta(
             h2["num_entities"] = num_entities + n_app
             h2["id_blob_used"] = blob_used + blob_add
             _rewrite_header(f, h2, hlen)
-            touched |= set((undo["append_rows"]
-                            // h["rows_per_chunk"]).tolist())
+            app_chunks = set((undo["append_rows"]
+                              // h["rows_per_chunk"]).tolist())
+            touched |= app_chunks
+            if has_var:
+                var_touched |= app_chunks
         _v2_recompute_crcs(f, h, coef_chunks=touched, proj_chunks=touched,
+                           var_chunks=var_touched,
                            ids=bool(n_app), sort=bool(n_app),
                            header=bool(n_app))
         f.flush()
@@ -893,19 +1068,26 @@ def rollback_cold_store_delta(path: str, undo: dict) -> None:
     their reserve rows become unreachable garbage that the recomputed
     chunk crcs still cover). The file verifies clean afterwards."""
     h, hlen = _read_header(path)
-    if h.get("schema") != SCHEMA_V2:
+    if h.get("schema") not in (SCHEMA_V2, SCHEMA_V4):
         raise ColdStoreNotUpdatable(path, h.get("schema"))
+    has_var = bool(h.get("var_off"))
     rowb = h["slot_width"] * 4
     update_rows = np.asarray(undo["update_rows"], dtype=np.int64)
     prior_coef = np.asarray(undo["prior_update_coef"], dtype=np.float32)
     prior_proj = np.asarray(undo["prior_update_proj"], dtype=np.int32)
+    prior_var = undo.get("prior_update_var")
     with open(path, "r+b") as f:
         for i, r in enumerate(update_rows):
             f.seek(h["coef_off"] + int(r) * rowb)
             f.write(np.ascontiguousarray(prior_coef[i]).tobytes())
             f.seek(h["proj_off"] + int(r) * rowb)
             f.write(np.ascontiguousarray(prior_proj[i]).tobytes())
+            if prior_var is not None:
+                f.seek(h["var_off"] + int(r) * rowb)
+                f.write(np.ascontiguousarray(
+                    np.asarray(prior_var[i], np.float32)).tobytes())
         touched = set((update_rows // h["rows_per_chunk"]).tolist())
+        var_touched = set(touched) if prior_var is not None else set()
         had_appends = undo.get("prior_sort_bytes") is not None
         if had_appends:
             f.seek(h["id_offsets_off"])
@@ -913,12 +1095,16 @@ def rollback_cold_store_delta(path: str, undo: dict) -> None:
             f.seek(h["sort_off"])
             f.write(undo["prior_sort_bytes"])
             append_rows = np.asarray(undo["append_rows"], dtype=np.int64)
-            touched |= set((append_rows // h["rows_per_chunk"]).tolist())
+            app_chunks = set((append_rows // h["rows_per_chunk"]).tolist())
+            touched |= app_chunks
+            if has_var:
+                var_touched |= app_chunks
             h2 = dict(h)
             h2["num_entities"] = int(undo["prior_num_entities"])
             h2["id_blob_used"] = int(undo["prior_id_blob_used"])
             _rewrite_header(f, h2, hlen)
         _v2_recompute_crcs(f, h, coef_chunks=touched, proj_chunks=touched,
+                           var_chunks=var_touched,
                            ids=had_appends, sort=had_appends,
                            header=had_appends)
         f.flush()
@@ -937,6 +1123,8 @@ def upgrade_cold_store(path: str, *, capacity: Optional[int] = None,
     cs = ColdStore(path)
     coef = np.asarray(cs.coef, dtype=np.float32)
     proj = np.asarray(cs.proj, dtype=np.int32)
+    var = (np.asarray(cs.var, dtype=np.float32)
+           if cs.has_variances else None)
     ids, _ = _encode_ids([cs.entity_id(r) for r in range(cs.num_entities)])
     if ids.shape[0] == 0:
         ids = np.asarray([], dtype="S1")
@@ -946,4 +1134,4 @@ def upgrade_cold_store(path: str, *, capacity: Optional[int] = None,
         path, *meta, coef, proj, ids,
         np.arange(ids.shape[0], dtype=np.int64),
         capacity=capacity, id_blob_cap=id_blob_cap,
-        rows_per_chunk=rows_per_chunk)
+        rows_per_chunk=rows_per_chunk, variances=var)

@@ -298,7 +298,8 @@ def save_game_model(
                     cold_store_path(output_dir, cid), cid,
                     m.random_effect_type, m.feature_shard_id,
                     coef, proj.astype(np.int32, copy=False),
-                    np.asarray(list(names)))
+                    np.asarray(list(names)),
+                    variances=var)
         else:
             raise TypeError(f"unknown model type for coordinate {cid}: {type(m)}")
 
@@ -380,11 +381,13 @@ class LoadedGameModel:
 
 @dataclasses.dataclass
 class ServingFixedEffect:
-    """One fixed-effect coordinate as a flat coefficient vector."""
+    """One fixed-effect coordinate as a flat coefficient vector (plus the
+    optional posterior-variance vector a Bayesian save carries)."""
 
     coordinate_id: str
     feature_shard_id: str
     coefficients: np.ndarray          # [D_shard] in the serving index space
+    variances: Optional[np.ndarray] = None   # [D_shard] or None (mean-only)
 
 
 class ServingRandomEffect:
@@ -409,7 +412,8 @@ class ServingRandomEffect:
                  coefficients: Optional[np.ndarray] = None,
                  projection: Optional[np.ndarray] = None,
                  entity_rows: Optional[Dict[str, int]] = None,
-                 cold_store_path: Optional[str] = None):
+                 cold_store_path: Optional[str] = None,
+                 variances: Optional[np.ndarray] = None):
         if coefficients is None and cold_store_path is None:
             raise ValueError(
                 f"random effect {coordinate_id!r} needs either eager "
@@ -421,6 +425,11 @@ class ServingRandomEffect:
         self._coefficients = coefficients
         self._projection = projection
         self._entity_rows = entity_rows
+        self._variances = variances
+        # eager loads know up front; cold-backed answers from the header
+        # on first ask (one header read, no array materialization)
+        self._has_var: Optional[bool] = (
+            None if coefficients is None else variances is not None)
         self._num_entities: Optional[int] = (
             None if coefficients is None else int(coefficients.shape[0]))
 
@@ -432,6 +441,9 @@ class ServingRandomEffect:
         self._projection = np.asarray(cs.proj, dtype=np.int32)
         self._entity_rows = {cs.entity_id(r): r
                              for r in range(cs.num_entities)}
+        if cs.has_variances:
+            self._variances = np.asarray(cs.var, dtype=np.float32)
+        self._has_var = cs.has_variances
         self._num_entities = cs.num_entities
 
     @property
@@ -451,6 +463,24 @@ class ServingRandomEffect:
         if self._entity_rows is None:
             self._materialize()
         return self._entity_rows
+
+    @property
+    def has_variances(self) -> bool:
+        if self._has_var is None:
+            from photon_tpu.io.cold_store import ColdStore
+
+            self._has_var = ColdStore(self.cold_store_path).has_variances
+        return self._has_var
+
+    @property
+    def variances(self) -> Optional[np.ndarray]:
+        """Per-entity posterior variances [E, K] in the same slot layout
+        as ``coefficients``, or None for a mean-only model."""
+        if not self.has_variances:
+            return None
+        if self._variances is None:
+            self._materialize()
+        return self._variances
 
     @property
     def num_entities(self) -> int:
@@ -500,8 +530,11 @@ def load_for_serving(
     Random-effect coordinates with a cold-store file are opened LAZILY:
     their per-entity Avro records are never read, and the returned
     :class:`ServingRandomEffect` materializes dense arrays from the cold
-    file only if something asks for them. Variances are never parsed —
-    serving only scores.
+    file only if something asks for them. Posterior variances ride along
+    when the model has them (Avro ``variances`` fields, or the cold
+    store's v3/v4 variance column) — the Thompson-sampling serving mode's
+    input; mean-only models load exactly as before with ``variances``
+    absent.
     """
     from photon_tpu.io.cold_store import cold_store_path
 
@@ -522,8 +555,10 @@ def load_for_serving(
 
     # pass 1 (and only): records -> {global column: value} slot dicts;
     # dense packing waits until every coordinate has grown the builders
-    fixed_raw: List[Tuple[str, str, Dict[int, float]]] = []
-    random_raw: List[Tuple[str, str, str, List[str], List[Dict[int, float]]]] = []
+    fixed_raw: List[Tuple[str, str, Dict[int, float],
+                          Optional[Dict[int, float]]]] = []
+    random_raw: List[Tuple[str, str, str, List[str], List[Dict[int, float]],
+                           Optional[List[Dict[int, float]]]]] = []
     cold_raw: List[Tuple[str, str, str, str]] = []  # cid, type, shard, path
 
     fixed_dir = os.path.join(model_dir, FIXED_EFFECT)
@@ -545,7 +580,15 @@ def load_for_serving(
                 g = col_of(shard_id, str(r["name"]), str(r["term"]))
                 if g >= 0:
                     slots[g] = float(r["value"])
-            fixed_raw.append((cid, shard_id, slots))
+            var_recs = recs[0].get("variances")
+            var_slots: Optional[Dict[int, float]] = None
+            if var_recs is not None:
+                var_slots = {}
+                for r in var_recs:
+                    g = col_of(shard_id, str(r["name"]), str(r["term"]))
+                    if g >= 0:
+                        var_slots[g] = float(r["value"])
+            fixed_raw.append((cid, shard_id, slots, var_slots))
 
     random_dir = os.path.join(model_dir, RANDOM_EFFECT)
     if os.path.isdir(random_dir):
@@ -566,41 +609,65 @@ def load_for_serving(
                 continue
             names: List[str] = []
             per_entity: List[Dict[int, float]] = []
+            per_entity_var: List[Dict[int, float]] = []
+            have_var = False
             for rec in avro_io.iter_avro_dir(os.path.join(cdir, COEFFICIENTS)):
                 slots = {}
                 for r in rec["means"]:
                     g = col_of(shard_id, str(r["name"]), str(r["term"]))
                     if g >= 0:
                         slots[g] = float(r["value"])
+                vslots: Dict[int, float] = {}
+                for r in (rec.get("variances") or ()):
+                    have_var = True
+                    g = col_of(shard_id, str(r["name"]), str(r["term"]))
+                    if g >= 0:
+                        vslots[g] = float(r["value"])
                 names.append(str(rec["modelId"]))
                 per_entity.append(slots)
-            random_raw.append((cid, re_type, shard_id, names, per_entity))
+                per_entity_var.append(vslots)
+            random_raw.append((cid, re_type, shard_id, names, per_entity,
+                               per_entity_var if have_var else None))
 
     maps = dict(index_maps) if external else {
         **{sid: b.build() for sid, b in builders.items()},
         **sidecars}
 
     fixed = []
-    for cid, shard_id, slots in fixed_raw:
+    for cid, shard_id, slots, var_slots in fixed_raw:
         dim = maps[shard_id].feature_dimension if shard_id in maps else 0
         vec = np.zeros(max(dim, 1), dtype)
         for g, v in slots.items():
             vec[g] = v
-        fixed.append(ServingFixedEffect(cid, shard_id, vec))
+        var_vec = None
+        if var_slots is not None:
+            var_vec = np.zeros(max(dim, 1), dtype)
+            for g, v in var_slots.items():
+                var_vec[g] = v
+        fixed.append(ServingFixedEffect(cid, shard_id, vec, var_vec))
 
     random_ = []
-    for cid, re_type, shard_id, names, per_entity in random_raw:
+    for cid, re_type, shard_id, names, per_entity, per_entity_var \
+            in random_raw:
         E = len(per_entity)
-        K = max((len(s) for s in per_entity), default=1) or 1
+        # slot space per entity = union of the means + variances supports
+        # (independent vectors on disk, same treatment as load_game_model)
+        unions = [sorted(set(s) | set(per_entity_var[e]
+                                      if per_entity_var else ()))
+                  for e, s in enumerate(per_entity)]
+        K = max((len(u) for u in unions), default=1) or 1
         coef = np.zeros((E, K), dtype)
         proj = np.full((E, K), -1, np.int32)
-        for e, slots in enumerate(per_entity):
-            for s, (g, v) in enumerate(sorted(slots.items())):
+        var = None if per_entity_var is None else np.zeros((E, K), dtype)
+        for e, cols in enumerate(unions):
+            for s, g in enumerate(cols):
                 proj[e, s] = g
-                coef[e, s] = v
+                coef[e, s] = per_entity[e].get(g, 0.0)
+                if var is not None:
+                    var[e, s] = per_entity_var[e].get(g, 0.0)
         random_.append(ServingRandomEffect(
             cid, re_type, shard_id, coef, proj,
-            {name: i for i, name in enumerate(names)}))
+            {name: i for i, name in enumerate(names)}, variances=var))
     for cid, re_type, shard_id, cold_path in cold_raw:
         random_.append(ServingRandomEffect(
             cid, re_type, shard_id, cold_store_path=cold_path))

@@ -69,6 +69,12 @@ class CoordinateDelta:
     rows: Dict[str, Tuple[np.ndarray, np.ndarray]]
     event_ts: Dict[str, float]         # entity_id -> newest event ts
     num_events: int = 0
+    # entity_id -> posterior-variance row [K_ds] f32 aligned with
+    # ``rows`` (same projected space, same slot order).  Populated only
+    # when the serving coordinate carries variances (Thompson models) —
+    # a delta-trained mean must republish its uncertainty in the SAME
+    # round or the scorer would explore with stale noise.
+    var_rows: Dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -284,7 +290,30 @@ class DeltaTrainer:
                 config=self.config.glm)
             rem = coord.update_model_blocked(None, warm_start=warm)
             coef = np.asarray(rem.coefficients, np.float32)[:len(names)]
+            # Thompson coordinates republish uncertainty WITH the means:
+            # a diagonal-Hessian Laplace pass at the freshly solved rows
+            # (bayes/laplace), gated on the target actually serving
+            # variances and the loss having a Hessian (typed skip — the
+            # mean delta still publishes, existing variance bytes stay).
+            var: Optional[np.ndarray] = None
+            serves_var = (getattr(rs, "var_coef", None) is not None
+                          or (cold is not None
+                              and getattr(cold, "has_variances", False)))
+            if serves_var:
+                if coord.objective.loss.has_hessian:
+                    from photon_tpu.bayes.laplace import \
+                        entity_variances_blocked
+                    var = np.asarray(
+                        entity_variances_blocked(coord, rem.coefficients),
+                        np.float32)[:len(names)]
+                else:
+                    stats["variance_skips"] = stats.get(
+                        "variance_skips", 0) + 1
+                    _metrics.counter(
+                        "nearline.train.variance_skipped",
+                        reason="no_hessian").inc()
             delta_rows: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+            var_rows: Dict[str, np.ndarray] = {}
             ev_ts: Dict[str, float] = {}
             for r, name in enumerate(names):
                 if not np.isfinite(coef[r]).all():
@@ -292,6 +321,14 @@ class DeltaTrainer:
                     _metrics.counter("nearline.train.nonfinite_rows").inc()
                     continue
                 delta_rows[name] = (coef[r].copy(), proj[r].astype(np.int32))
+                if var is not None:
+                    if np.isfinite(var[r]).all() and (var[r] >= 0).all():
+                        var_rows[name] = var[r].copy()
+                    else:
+                        stats["nonfinite_var_rows"] = stats.get(
+                            "nonfinite_var_rows", 0) + 1
+                        _metrics.counter(
+                            "nearline.train.nonfinite_var_rows").inc()
             for ev, name in zip(evs, ids):
                 ts = ev.get("ts")
                 if ts is not None and name in delta_rows:
@@ -299,7 +336,8 @@ class DeltaTrainer:
             stats["entities"] += len(delta_rows)
             out[rs.coordinate_id] = CoordinateDelta(
                 rs.coordinate_id, rs.random_effect_type, sid,
-                delta_rows, ev_ts, num_events=len(evs))
+                delta_rows, ev_ts, num_events=len(evs),
+                var_rows=var_rows)
         _metrics.counter("nearline.train.events").inc(len(events))
         _metrics.counter("nearline.train.entities").inc(stats["entities"])
         return DeltaTrainResult(out, len(events), stats)

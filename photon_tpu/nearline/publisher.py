@@ -30,7 +30,8 @@ Atomicity protocol (the order matters):
 2. Every touched store's ``publish_lock`` is acquired (sorted by
    coordinate id), pausing cold->hot transfer cycles; the scoring path
    only takes the transfer lock and keeps serving the PRIOR rows.
-3. Gates run against a stable table: finite -> deviation -> capacity ->
+3. Gates run against a stable table: finite -> variance (published
+   posterior rows finite and non-negative) -> deviation -> capacity ->
    staging+parity (device readback of the staged copy, bitwise) ->
    shadow (expected-vs-actual score delta on touched entities; the RE
    margin is linear in the row, so the expectation is host-computable)
@@ -170,14 +171,17 @@ def _pub_gather(shape: Tuple[int, int], batch: int, dtype) -> object:
 
 
 def _scatter_rows(scatter, table, idx: np.ndarray, rows: np.ndarray,
-                  batch: int, pad_row: int):
+                  batch: int, pad_row: int, pad_value: float = 0.0):
     """Apply [N] row writes through the fixed-shape scatter in chunks;
-    padding writes zeros to ``pad_row`` (the zero/scratch row)."""
+    padding writes ``pad_value`` rows to ``pad_row`` — zero for the
+    coef tables (the zero/scratch row), the prior variance for a var
+    table (whose unknown row HOLDS the prior, so the pad write must be
+    idempotent, not a clobber)."""
     import jax
 
     for lo in range(0, len(idx), batch):
         i = np.full(batch, pad_row, np.int32)
-        r = np.zeros((batch, rows.shape[1]), rows.dtype)
+        r = np.full((batch, rows.shape[1]), pad_value, rows.dtype)
         n = min(batch, len(idx) - lo)
         i[:n] = idx[lo:lo + n]
         r[:n] = rows[lo:lo + n]
@@ -198,35 +202,50 @@ def _gather_rows(gather, table, idx: np.ndarray, batch: int) -> np.ndarray:
             else np.zeros((0, 1), np.float32))
 
 
-def _fit_slot_width(coef: np.ndarray, proj: np.ndarray,
-                    width: int) -> Tuple[np.ndarray, np.ndarray, int]:
+def _fit_slot_width(coef: np.ndarray, proj: np.ndarray, width: int,
+                    var: Optional[np.ndarray] = None,
+                    ) -> Tuple[np.ndarray, np.ndarray,
+                               Optional[np.ndarray], int]:
     """Normalize candidate rows into the serving slot width.  Rows whose
     valid slots exceed ``width`` keep the largest-|coef| features (count
-    returned as truncated)."""
+    returned as truncated).  ``var`` rides the same drops and the same
+    slot permutation — a variance belongs to its coefficient."""
     coef = np.asarray(coef, np.float32)
     proj = np.asarray(proj, np.int32)
+    if var is not None:
+        var = np.asarray(var, np.float32)
     truncated = 0
     nvalid = (proj >= 0).sum(axis=1)
     over = nvalid > width
     if over.any():
         coef = coef.copy()
         proj = proj.copy()
+        var = var.copy() if var is not None else None
         for r in np.nonzero(over)[0]:
             valid = np.nonzero(proj[r] >= 0)[0]
             drop = valid[np.argsort(np.abs(coef[r, valid]),
                                     kind="stable")[:len(valid) - width]]
             proj[r, drop] = -1
             coef[r, drop] = 0.0
+            if var is not None:
+                var[r, drop] = 0.0
             truncated += len(drop)
-    coef, proj = normalize_slot_rows(coef, proj)
+    if var is not None:
+        coef, proj, var = normalize_slot_rows(coef, proj, variances=var)
+    else:
+        coef, proj = normalize_slot_rows(coef, proj)
     k = coef.shape[1]
     if k < width:
         coef = np.pad(coef, [(0, 0), (0, width - k)])
         proj = np.pad(proj, [(0, 0), (0, width - k)], constant_values=-1)
+        if var is not None:
+            var = np.pad(var, [(0, 0), (0, width - k)])
     elif k > width:
         coef = np.ascontiguousarray(coef[:, :width])
         proj = np.ascontiguousarray(proj[:, :width])
-    return coef, proj, truncated
+        if var is not None:
+            var = np.ascontiguousarray(var[:, :width])
+    return coef, proj, var, truncated
 
 
 def _union_deviation(coef_a, proj_a, coef_b, proj_b) -> float:
@@ -255,6 +274,10 @@ class _CoordPlan:
     app_proj: np.ndarray
     truncated: int = 0
     cold_rows: Optional[np.ndarray] = None   # two-tier: storage rows
+    # posterior-variance rows published WITH the means (Thompson
+    # coordinates); None = mean-only round, existing variance bytes stay
+    upd_var: Optional[np.ndarray] = None     # [U, K]
+    app_var: Optional[np.ndarray] = None     # [A, K]
 
 
 class DeltaPublisher:
@@ -377,7 +400,50 @@ class DeltaPublisher:
             ids = sorted(cd.rows)
             coef = np.stack([cd.rows[e][0] for e in ids])
             proj = np.stack([cd.rows[e][1] for e in ids])
-            coef, proj, trunc = _fit_slot_width(coef, proj, rs.slot_width)
+            # Variance rows ride the same slot normalization when the
+            # coordinate serves variances (Thompson) and the delta
+            # carries any.  Entities the trainer skipped keep their
+            # LIVE variance row (updates) or land zeros (appends), so
+            # the full-width variance write stays coherent with the
+            # cold-store contract: a mean-only refresh never silently
+            # zeroes uncertainty.
+            vr = getattr(cd, "var_rows", None) or {}
+            serves_var = (getattr(rs, "var_coef", None) is not None
+                          or (rs.store is not None
+                              and rs.store.cold.has_variances))
+            disk_cold = None
+            if (vr and not serves_var and rs.store is None
+                    and self.model_dir is not None):
+                from photon_tpu.io.cold_store import (ColdStore,
+                                                      cold_store_path)
+
+                cp = cold_store_path(self.model_dir, rs.coordinate_id)
+                if os.path.exists(cp):
+                    try:
+                        dc = ColdStore(cp)
+                        if dc.has_variances:
+                            disk_cold = dc
+                            serves_var = True
+                    except (OSError, ValueError):
+                        pass
+            var = None
+            have_var = None
+            if serves_var and vr:
+                var = np.zeros(proj.shape, np.float32)
+                have_var = np.zeros(len(ids), bool)
+                for i, e in enumerate(ids):
+                    v = vr.get(e)
+                    if v is not None:
+                        var[i] = np.asarray(v, np.float32)
+                        have_var[i] = True
+            coef, proj, var, trunc = _fit_slot_width(coef, proj,
+                                                     rs.slot_width, var)
+            if var is not None and not have_var.all():
+                for i in np.nonzero(~have_var)[0]:
+                    lv = self._live_var_row(rs, ids[i], disk_cold)
+                    if lv is not None:
+                        k = min(len(lv), var.shape[1])
+                        var[i, :k] = lv[:k]
             D = model.shard_dims.get(rs.feature_shard_id, 1)
             upd_i, app_i, priors, cold_rows = [], [], [], []
             for i, e in enumerate(ids):
@@ -414,8 +480,34 @@ class DeltaPublisher:
                 app_coef=coef[app_i], app_proj=proj[app_i],
                 truncated=trunc,
                 cold_rows=(np.asarray(cold_rows, np.int64)
-                           if rs.store is not None else None)))
+                           if rs.store is not None else None),
+                upd_var=(var[upd_i] if var is not None else None),
+                app_var=(var[app_i] if var is not None else None)))
         return plans
+
+    @staticmethod
+    def _live_var_row(rs, entity_id: str,
+                      disk_cold) -> Optional[np.ndarray]:
+        """The variance row ``entity_id`` currently serves with, in
+        serving layout — the fill for delta entities whose variance the
+        trainer skipped (their update must not disturb live bytes)."""
+        if rs.store is not None and rs.store.cold.has_variances:
+            r = rs.store.cold.entity_row(entity_id)
+            if r is not None:
+                return rs.store.cold.read_var_rows(
+                    np.asarray([r], np.int64))[0]
+            return None
+        if getattr(rs, "var_coef", None) is not None:
+            er = rs.entity_rows.get(entity_id)
+            if er is not None:
+                return np.asarray(rs.var_coef[er], np.float32)
+            return None
+        if disk_cold is not None:
+            r = disk_cold.entity_row(entity_id)
+            if r is not None:
+                return disk_cold.read_var_rows(
+                    np.asarray([r], np.int64))[0]
+        return None
 
     def _expected_delta(self, request, plans: List[_CoordPlan],
                         hot_slots: Dict[str, Dict[str, int]]) -> float:
@@ -488,6 +580,24 @@ class DeltaPublisher:
                                       f"{p.cid!r}", label,
                                       rows_truncated=n_trunc)
         gates["finite"] = "pass"
+
+        # variance: published uncertainty must be finite and
+        # non-negative — a NaN or negative variance row would make the
+        # Thompson sampler emit NaN scores (sqrt of the row) for every
+        # request that gathers it
+        if any(p.upd_var is not None or p.app_var is not None
+               for p in plans):
+            for p in plans:
+                for arr in (p.upd_var, p.app_var):
+                    if arr is not None and arr.size and not (
+                            np.isfinite(arr).all() and (arr >= 0).all()):
+                        return self._fail(
+                            gates, "variance",
+                            f"non-finite or negative variance rows in "
+                            f"{p.cid!r}", label, rows_truncated=n_trunc)
+            gates["variance"] = "pass"
+        else:
+            gates["variance"] = "skip"
 
         # deviation: |new - prior| over the union feature space
         if np.isfinite(cfg.max_row_deviation):
@@ -693,7 +803,11 @@ class DeltaPublisher:
                 "appended": list(p.app_ids),
                 "row_crc": zlib.crc32(
                     written[p.cid][0].tobytes()
-                    + written[p.cid][1].tobytes()) & 0xFFFFFFFF,
+                    + written[p.cid][1].tobytes()
+                    + (p.upd_var.tobytes() if p.upd_var is not None
+                       else b"")
+                    + (p.app_var.tobytes() if p.app_var is not None
+                       else b"")) & 0xFFFFFFFF,
             } for p in plans}
         self._write_manifest(label, watermark, coords_doc)
         self._last_undo = {"label": label, "version": self.version,
@@ -780,6 +894,12 @@ class DeltaPublisher:
                 append_ids=p.app_ids,
                 append_coef=wa if len(p.app_ids) else None,
                 append_proj=p.app_proj if len(p.app_ids) else None,
+                update_var=(p.upd_var if cold.has_variances
+                            and p.upd_var is not None
+                            and len(p.upd_ids) else None),
+                append_var=(p.app_var if cold.has_variances
+                            and p.app_var is not None
+                            and len(p.app_ids) else None),
                 normalize=False)
             # the staged table was built from the intended rows; if the
             # written payload differs (chaos poison) re-scatter so table
@@ -806,6 +926,7 @@ class DeltaPublisher:
                  "prior_pslots": rs.pslots_sorted,
                  "prior_append_used": rs.append_used,
                  "prior_coef_q": rs.coef_q, "prior_scales": rs.scales,
+                 "prior_var_table": getattr(rs, "var_coef", None),
                  "cold_undo": None, "cold_path": None}
         model = self.engine.model
         D = max(model.shard_dims.get(rs.feature_shard_id, 1), 1)
@@ -853,6 +974,19 @@ class DeltaPublisher:
             ssc = _pub_scatter(tuple(rs.scales.shape), batch, np.float32)
             rs.coef_q = _scatter_rows(qsc, rs.coef_q, idx, qrows, batch, pad)
             rs.scales = _scatter_rows(ssc, rs.scales, idx, srows, batch, pad)
+        # Thompson arm: the resident variance table tracks every row
+        # publish in the same transaction, or the sampler would explore
+        # a fresh mean with STALE uncertainty. Pad writes target the
+        # unknown row, which holds the prior variance — so the pad value
+        # is the prior, making the padding idempotent instead of a
+        # cold-start-exploration clobber.
+        if getattr(rs, "var_coef", None) is not None \
+                and p.upd_var is not None and len(idx):
+            vrows = np.concatenate([p.upd_var, p.app_var])
+            vsc = _pub_scatter(tuple(rs.var_coef.shape), batch, np.float32)
+            rs.var_coef = _scatter_rows(
+                vsc, rs.var_coef, idx, vrows.astype(np.float32), batch,
+                pad, pad_value=float(getattr(model, "prior_variance", 1.0)))
         rs.pkeys_sorted = pk[order]
         rs.pslots_sorted = psl[order]
         for j, e in enumerate(p.app_ids):
@@ -886,6 +1020,14 @@ class DeltaPublisher:
                             append_coef=wa if len(p.app_ids) else None,
                             append_proj=(p.app_proj if len(p.app_ids)
                                          else None),
+                            update_var=(p.upd_var
+                                        if disk.has_variances
+                                        and p.upd_var is not None
+                                        and len(p.upd_ids) else None),
+                            append_var=(p.app_var
+                                        if disk.has_variances
+                                        and p.app_var is not None
+                                        and len(p.app_ids) else None),
                             normalize=False)
                         prior["cold_path"] = cp
                 except (ColdStoreCapacityError, ColdStoreNotUpdatable,
@@ -919,6 +1061,19 @@ class DeltaPublisher:
                     if np.asarray(cold.coef[r], np.float32).tobytes() != \
                             wa[j].tobytes():
                         return f"{p.cid}: appended {e!r} bytes mismatch"
+                if cold.has_variances and p.upd_var is not None:
+                    if len(p.upd_ids):
+                        got = cold.read_var_rows(p.cold_rows)
+                        if got.astype(np.float32).tobytes() != \
+                                p.upd_var.astype(np.float32).tobytes():
+                            return f"{p.cid}: cold variance rows mismatch"
+                    for j, e in enumerate(p.app_ids):
+                        r = cold.entity_row(e)
+                        if r is not None and np.asarray(
+                                cold.var[r], np.float32).tobytes() != \
+                                p.app_var[j].astype(np.float32).tobytes():
+                            return (f"{p.cid}: appended {e!r} variance "
+                                    f"bytes mismatch")
                 with rs.store.lock:
                     hs = {e: s for e in p.upd_ids
                           if (s := rs.store.hot_slot_locked(e)) is not None}
@@ -944,6 +1099,15 @@ class DeltaPublisher:
                     if got.astype(np.float32).tobytes() != \
                             want.astype(np.float32).tobytes():
                         return f"{p.cid}: resident rows mismatch"
+                if getattr(rs, "var_coef", None) is not None \
+                        and p.upd_var is not None and len(idx):
+                    vga = _pub_gather(tuple(rs.var_coef.shape), batch,
+                                      np.float32)
+                    vwant = np.concatenate([p.upd_var, p.app_var])
+                    vgot = _gather_rows(vga, rs.var_coef, idx, batch)
+                    if vgot.astype(np.float32).tobytes() != \
+                            vwant.astype(np.float32).tobytes():
+                        return f"{p.cid}: resident variance rows mismatch"
         return ""
 
     # ---------------------------------------------------------- rollback
@@ -1014,6 +1178,7 @@ class DeltaPublisher:
                 rs.coef = c["prior_table"]
                 rs.coef_q = c.get("prior_coef_q")
                 rs.scales = c.get("prior_scales")
+                rs.var_coef = c.get("prior_var_table")
                 rs.pkeys_sorted = c["prior_pkeys"]
                 rs.pslots_sorted = c["prior_pslots"]
                 for e in p.app_ids:
@@ -1125,6 +1290,7 @@ class FleetDeltaPublisher:
             for eid, row in cd.rows.items():
                 by_shard.setdefault(
                     entity_shard(eid, self.num_shards), {})[eid] = row
+            vr = getattr(cd, "var_rows", None) or {}
             for s, rows in by_shard.items():
                 out.setdefault(s, {})[cid] = CoordinateDelta(
                     coordinate_id=cd.coordinate_id,
@@ -1133,7 +1299,8 @@ class FleetDeltaPublisher:
                     rows=rows,
                     event_ts={e: cd.event_ts[e] for e in rows
                               if e in cd.event_ts},
-                    num_events=cd.num_events)
+                    num_events=cd.num_events,
+                    var_rows={e: vr[e] for e in rows if e in vr})
         return out
 
     def publish(self, delta, label: str,

@@ -52,8 +52,8 @@ from photon_tpu.serving.breaker import (
     CircuitBreaker,
 )
 from photon_tpu.serving.model_state import DeviceResidentModel
-from photon_tpu.serving.scorer import (INT8_MODE, get_scorer,
-                                       serving_modes, tables_for_mode,
+from photon_tpu.serving.scorer import (INT8_MODE, THOMPSON_MODE,
+                                       dispatch, serving_modes,
                                        warmup_scorers)
 from photon_tpu.serving.types import (
     Fallback,
@@ -138,7 +138,11 @@ class ServingEngine:
                                     append_reserve=(config.append_reserve
                                                     if config else 0),
                                     int8=(config.int8_serving
-                                          if config else False))
+                                          if config else False),
+                                    thompson=(config.thompson_serving
+                                              if config else False),
+                                    prior_variance=(config.prior_variance
+                                                    if config else 1.0))
         return cls(model, config=config, clock=clock, obs_labels=obs_labels)
 
     def _prefetch_lookahead(self, request: ScoreRequest) -> None:
@@ -290,10 +294,28 @@ class ServingEngine:
         # the next batch, never mid-batch
         if shed_any:
             mode = "fixed_only"
+        elif getattr(model, "thompson_enabled", False):
+            # explore/exploit IS the healthy-path program for a
+            # variance-carrying model under thompson_serving; sheds
+            # still drop to fixed_only above (no exploration under
+            # pressure), and it outranks int8 (sampling needs f32 vars)
+            mode = THOMPSON_MODE
         elif getattr(model, "int8_enabled", False):
             mode = INT8_MODE  # quantized arm IS the healthy-path program
         else:
             mode = "full"
+        seeds = None
+        if mode == THOMPSON_MODE:
+            # per-request sampling keys from the uid alone: bitwise
+            # replay-stable no matter how requests batch or arrive
+            from photon_tpu.utils.seeds import request_key, split32
+
+            hi = np.zeros(bucket, np.uint32)
+            lo = np.zeros(bucket, np.uint32)
+            for i, r in enumerate(requests):
+                hi[i], lo[i] = split32(
+                    request_key(self.config.thompson_seed, r.uid))
+            seeds = (hi, lo)
 
         # two-tier consistency contract: assemble (slot lookups against the
         # host-side hot maps), the table read, and the scorer DISPATCH all
@@ -309,7 +331,8 @@ class ServingEngine:
         with model.transfer_lock:
             t0 = time.perf_counter()
             args, fallbacks, counters = model.assemble(
-                requests, bucket, shed_random=shed_any)
+                requests, bucket, shed_random=shed_any,
+                explore_unknown=(mode == THOMPSON_MODE))
             t_assemble = time.perf_counter() - t0
 
             t0 = time.perf_counter()
@@ -317,9 +340,7 @@ class ServingEngine:
                 delay = _chaos.scorer_delay()
                 if delay > 0:
                     time.sleep(delay)
-                raw = get_scorer(model, mode, bucket)(
-                    *args, model.current_thetas(),
-                    tables_for_mode(model, mode))
+                raw = dispatch(model, mode, bucket, args, seeds=seeds)
             except Exception as e:  # device/dispatch fault: typed, counted
                 scorer_ok = False
                 record_failure("serving_scorer_error", error=repr(e),
@@ -399,6 +420,11 @@ class ServingEngine:
             _metrics.counter("serving.degraded",
                              reason=FallbackReason.COLD_MISS.value
                              ).inc(counters["cold_misses"])
+        if counters.get("explored_cold_start"):
+            _metrics.counter(
+                "serving.degraded",
+                reason=FallbackReason.EXPLORING_COLD_START.value
+                ).inc(counters["explored_cold_start"])
         if shed:
             _metrics.counter(
                 "serving.degraded",

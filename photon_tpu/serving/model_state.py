@@ -48,6 +48,10 @@ class _FixedState:
     coordinate_id: str
     feature_shard_id: str
     theta: object                     # device [D_pad] (replicated on a mesh)
+    # thompson arm: posterior variances aligned with theta ([D_pad],
+    # zeros where the model carried none). None unless the model was
+    # built with thompson=True and carries variances somewhere.
+    var_theta: Optional[object] = None
 
 
 @dataclasses.dataclass
@@ -79,6 +83,14 @@ class _RandomState:
     # with int8=True; two-tier coordinates never quantize.
     coef_q: Optional[object] = None      # device [E_pad, K] int8
     scales: Optional[object] = None      # device [E_pad, 1] float32
+    # thompson arm: posterior-variance gather table mirroring ``coef``
+    # row for row — real entities carry their Laplace variances (zeros
+    # when the model has none for this coordinate), the unknown row
+    # carries ``prior_variance`` (cold-start exploration; its MEAN row
+    # stays zero), and the append reserve rows are zero until a nearline
+    # publish hands them a variance row (appended-without-variance
+    # entities serve the mean). None unless thompson staging is on.
+    var_coef: Optional[object] = None    # device [E_pad, K] float32
 
 
 class AssembledBatch(Tuple):
@@ -113,7 +125,8 @@ class DeviceResidentModel:
     def __init__(self, model: ServingGameModel, mesh=None,
                  feature_pad: Optional[int] = None, dtype=None,
                  coeff_store: Optional[CoeffStoreConfig] = None,
-                 append_reserve: int = 0, int8: bool = False):
+                 append_reserve: int = 0, int8: bool = False,
+                 thompson: bool = False, prior_variance: float = 1.0):
         import jax
         import jax.numpy as jnp
 
@@ -126,6 +139,30 @@ class DeviceResidentModel:
         #: int8 serving arm requested: full-resident coordinates carry a
         #: (coef_q, scales) mirror and "full_int8" programs are warmed
         self.int8_enabled = bool(int8)
+        #: thompson arm: active only when it was REQUESTED and the model
+        #: actually carries posterior variances somewhere — a var-less
+        #: model under the flag stages nothing extra, keeps its pre-
+        #: thompson shape signature, and serves the mean bitwise as
+        #: before. When active, every coordinate gets a variance mirror
+        #: (zeros where a coordinate has none) and "thompson" programs
+        #: are warmed.
+        self.prior_variance = float(prior_variance)
+        has_var = (any(getattr(fe, "variances", None) is not None
+                       for fe in model.fixed)
+                   or any(getattr(re, "has_variances", False)
+                          for re in model.random))
+        self.thompson_enabled = bool(thompson) and has_var
+        if self.thompson_enabled and coeff_store is not None and any(
+                getattr(re, "cold_store_path", None) is not None
+                for re in model.random):
+            # the variance mirror must be a full-resident program
+            # argument — a hot-set slice of it would explore with
+            # whichever rows happen to be hot. Typed refusal, at load,
+            # never a silent mean fallback.
+            raise ValueError(
+                "thompson serving requires full-resident random-effect "
+                "tables; this model serves through a two-tier coeff_store "
+                "— drop the CoeffStoreConfig or disable thompson_serving")
         # serializes batch assembly + scorer dispatch against the
         # two-tier stores' cold->hot transfer commits; recursive so the
         # engine can nest assemble inside its own hold. A model with no
@@ -153,8 +190,18 @@ class DeviceResidentModel:
             if len(theta) < dim:
                 theta = np.concatenate([theta, np.zeros(dim - len(theta),
                                                         theta.dtype)])
+            var_theta = None
+            if self.thompson_enabled:
+                v = getattr(fe, "variances", None)
+                var = (np.zeros(dim, theta.dtype) if v is None
+                       else np.asarray(v, theta.dtype))
+                if len(var) < dim:
+                    var = np.concatenate(
+                        [var, np.zeros(dim - len(var), var.dtype)])
+                var_theta = put_rep(var[:dim])
             self.fixed.append(_FixedState(
-                fe.coordinate_id, fe.feature_shard_id, put_rep(theta)))
+                fe.coordinate_id, fe.feature_shard_id, put_rep(theta),
+                var_theta=var_theta))
 
         self.random: List[_RandomState] = []
         for re in model.random:
@@ -194,13 +241,28 @@ class DeviceResidentModel:
             if self.int8_enabled:
                 q, s = quantize_rows(coef)
                 coef_q, scales = put_ent(q), put_ent(s)
+            var_coef = None
+            if self.thompson_enabled:
+                vtab = np.zeros((E + 1 + reserve, K), np.float32)
+                rv = getattr(re, "variances", None)
+                if rv is not None:
+                    rv = np.asarray(rv, np.float32)
+                    vtab[:E] = rv[:E]
+                # the unknown row's MEAN stays zero but its VARIANCE is
+                # the prior: cold-start entities explore instead of
+                # silently scoring the mean. Reserve rows stay zero —
+                # appended entities explore only once a publish hands
+                # them a variance row.
+                vtab[E] = self.prior_variance
+                var_coef = put_ent(vtab)
             self.random.append(_RandomState(
                 re.coordinate_id, re.random_effect_type, re.feature_shard_id,
                 put_ent(coef.astype(np.float32) if self.dtype == jnp.float32
                         else coef),
                 E, E, K, dict(re.entity_rows),
                 pkeys[order], ps[order].astype(np.int64),
-                append_reserve=reserve, coef_q=coef_q, scales=scales))
+                append_reserve=reserve, coef_q=coef_q, scales=scales,
+                var_coef=var_coef))
 
     # -- two-tier store plumbing --------------------------------------------
 
@@ -236,6 +298,20 @@ class DeviceResidentModel:
         shape/dtype arguments re-dispatch with zero retraces, exactly
         the random-effect tables' calling convention."""
         return tuple(f.theta for f in self.fixed)
+
+    def current_var_thetas(self) -> tuple:
+        """Posterior-variance vectors for the "thompson" programs, one
+        per fixed coordinate (zeros where the model carried none). Only
+        meaningful when ``thompson_enabled``."""
+        return tuple(f.var_theta for f in self.fixed)
+
+    def current_var_tables(self) -> tuple:
+        """Posterior-variance gather tables for the "thompson" programs,
+        one per random coordinate, row-aligned with ``current_tables()``
+        (thompson is full-resident only, so these are static device
+        arrays — nearline publishes scatter into them like the mean
+        tables). Only meaningful when ``thompson_enabled``."""
+        return tuple(rs.var_coef for rs in self.random)
 
     def shape_signature(self) -> tuple:
         """Canonical shape signature: everything a scorer trace depends
@@ -275,10 +351,21 @@ class DeviceResidentModel:
                           _dt(rs.coef_q),
                           tuple(int(s) for s in rs.scales.shape))
             rand_sig.append(entry)
-        self._shape_sig = (
+        sig = (
             "servshape", _dt(self.dtype), int(self.int8_enabled), mesh_tok,
             tuple(int(self.shard_pad[sid]) for sid in self.shard_order),
             fixed_sig, tuple(rand_sig))
+        if self.thompson_enabled:
+            # appended ONLY when variance mirrors are staged: a var-less
+            # (or thompson-off) model keeps its pre-thompson signature
+            # bitwise, so its compiled programs and AOT bundles stay
+            # shared with pre-variance builds
+            sig = sig + (("thompson",
+                          tuple((tuple(int(s) for s in f.var_theta.shape),
+                                 _dt(f.var_theta)) for f in self.fixed),
+                          tuple((tuple(int(s) for s in rs.var_coef.shape),
+                                 _dt(rs.var_coef)) for rs in self.random)),)
+        self._shape_sig = sig
         return self._shape_sig
 
     def prefetch_request(self, request: ScoreRequest,
@@ -352,18 +439,26 @@ class DeviceResidentModel:
     # -- batch assembly (host) ----------------------------------------------
 
     def assemble(self, requests: Sequence[ScoreRequest], bucket: int,
-                 shed_random: bool = False):
+                 shed_random: bool = False, explore_unknown: bool = False):
         """Pack <=bucket requests into the padded device arrays one scorer
         call consumes. Returns (args tuple, per-request fallback lists,
         counters dict). Pad rows beyond ``len(requests)`` carry zero
         features and the unknown-entity sentinel, so they score to their
-        (zero) offset and are discarded by the engine."""
+        (zero) offset and are discarded by the engine.
+
+        ``explore_unknown`` (thompson mode only): an unknown entity's
+        request features are packed into its slot lanes against the
+        unknown row — whose MEAN row is zero (no mean contribution, same
+        score center as before) and whose VARIANCE row is the prior, so
+        the thompson program draws prior-variance exploration noise for
+        it. Typed EXPLORING_COLD_START instead of UNKNOWN_ENTITY."""
         n = len(requests)
         if n > bucket:
             raise ValueError(f"{n} requests > bucket {bucket}")
         fallbacks: List[List[Fallback]] = [[] for _ in range(n)]
         counters = {"unknown_features": 0, "truncated_features": 0,
                     "unknown_entities": 0, "cold_misses": 0,
+                    "explored_cold_start": 0,
                     "padded_rows": bucket - n}
 
         offsets = np.zeros(bucket, np.float32)
@@ -468,6 +563,23 @@ class DeviceResidentModel:
                     re_id = r.entity_ids.get(rs.random_effect_type)
                     e = rs.entity_rows.get(re_id) if re_id is not None else None
                     if e is None:
+                        if explore_unknown:
+                            # cold-start exploration: pack this request's
+                            # shard features into slots 0..k against the
+                            # unknown row (zero mean, prior variance) —
+                            # the slot ORDER is immaterial because every
+                            # slot of that row shares the prior
+                            counters["explored_cold_start"] += 1
+                            fallbacks[i].append(Fallback(
+                                FallbackReason.EXPLORING_COLD_START,
+                                coordinate=rs.coordinate_id,
+                                detail=str(re_id)))
+                            cvals = shard_vals[rs.feature_shard_id][i]
+                            k = min(len(cvals), rs.slot_width)
+                            if k:
+                                sidx[i, :k] = np.arange(k)
+                                sval[i, :k] = cvals[:k]
+                            continue
                         counters["unknown_entities"] += 1
                         fallbacks[i].append(Fallback(
                             FallbackReason.UNKNOWN_ENTITY,
@@ -521,4 +633,5 @@ class DeviceResidentModel:
             "shard_pad": dict(self.shard_pad),
             "entity_sharded": self.mesh is not None,
             "int8": self.int8_enabled,
+            "thompson": self.thompson_enabled,
         }

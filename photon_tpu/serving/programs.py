@@ -41,8 +41,8 @@ from typing import Optional, Sequence
 from photon_tpu.obs.metrics import registry as _metrics
 from photon_tpu.serving.model_state import DeviceResidentModel
 from photon_tpu.serving.scorer import (build_scorer_fn, get_scorer,
-                                       program_key, serving_modes,
-                                       tables_for_mode)
+                                       mode_args, program_key,
+                                       serving_modes)
 from photon_tpu.utils import compile_cache, jitcache
 
 _logger = logging.getLogger("photon_tpu.serving.programs")
@@ -115,7 +115,6 @@ def export_program_bundle(model: DeviceResidentModel,
     skipped = []
     for bucket in buckets:
         args = model.dummy_args(bucket)
-        thetas = model.current_thetas()
         for mode in serving_modes(model):
             fn = _unwrap(get_scorer(model, mode, bucket))
             if not hasattr(fn, "lower"):
@@ -127,7 +126,7 @@ def export_program_bundle(model: DeviceResidentModel,
             name = _prog_name(mode, bucket)
             try:
                 compiled = fn.lower(
-                    *args, thetas, tables_for_mode(model, mode)).compile()
+                    *mode_args(model, mode, args)).compile()
                 payload, in_tree, out_tree = serialize(compiled)
                 blob = pickle.dumps((payload, in_tree, out_tree),
                                     protocol=pickle.HIGHEST_PROTOCOL)

@@ -272,25 +272,12 @@ def read_capture(path: str) -> Tuple[List[CaptureRecord], dict]:
 # synthetic traffic: counter-derived, bitwise deterministic
 # --------------------------------------------------------------------------
 
-_U64 = (1 << 64) - 1
-
-
-def _splitmix64(x: int) -> int:
-    """Pure-integer splitmix64 (same finalizer the streaming shuffle
-    uses) — platform-independent, no RNG object state."""
-    x = (x + 0x9E3779B97F4A7C15) & _U64
-    z = x
-    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _U64
-    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _U64
-    return (z ^ (z >> 31)) & _U64
-
-
-def _u(seed: int, stream: str, i: int) -> float:
-    """Uniform in (0, 1): splitmix64 over (seed, named stream, counter).
-    Never exactly 0 (log-safe) or 1."""
-    key = (seed * 0x9E3779B97F4A7C15
-           + zlib.crc32(stream.encode()) * 0xD1342543DE82EF95 + i) & _U64
-    return (_splitmix64(key) + 1) / (2.0 ** 64 + 2)
+# the splitmix64 stream machinery moved to utils/seeds.py (PR 20) so the
+# Thompson scorer shares it; these aliases are bit-for-bit the PR 18
+# functions — pinned by tests/test_seeds.py forever-vectors
+from photon_tpu.utils.seeds import U64 as _U64  # noqa: E402
+from photon_tpu.utils.seeds import splitmix64 as _splitmix64  # noqa: E402
+from photon_tpu.utils.seeds import stream_u as _u  # noqa: E402
 
 
 @dataclasses.dataclass(frozen=True)

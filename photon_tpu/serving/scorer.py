@@ -48,7 +48,9 @@ traces.
 from __future__ import annotations
 
 import os
-from typing import Callable, Sequence, Tuple
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
 
 from photon_tpu.serving.model_state import DeviceResidentModel
 from photon_tpu.utils import compile_cache, jitcache
@@ -63,13 +65,28 @@ MODES = ("full", "fixed_only")
 #: ``model.current_tables_int8()``
 INT8_MODE = "full_int8"
 
+#: the opt-in Thompson-sampling arm — only valid (and only warmed) for
+#: models staged with thompson=True over posterior variances. Each
+#: request row samples ``theta ~ N(mu, sigma^2)`` INSIDE the program
+#: from its (seed_hi, seed_lo) counter pair: a murmur3-finalizer hash of
+#: (request seed, coordinate tag, coefficient identity) feeds Box-Muller
+#: so one coefficient gets ONE normal draw per request, duplicate
+#: features agree, and a replay with the same seeds is bitwise. Extra
+#: arguments beyond "full": seed_hi/seed_lo [B] uint32, the variance
+#: mirrors (``current_var_thetas``/``current_var_tables``).
+THOMPSON_MODE = "thompson"
+
 
 def serving_modes(model: DeviceResidentModel) -> Tuple[str, ...]:
     """The modes this model warms and may dispatch: the base ladder,
-    plus the int8 arm when the model carries quantized tables."""
+    plus the int8 arm when the model carries quantized tables, plus the
+    thompson arm when it carries posterior-variance mirrors."""
+    modes = MODES
     if getattr(model, "int8_enabled", False):
-        return MODES + (INT8_MODE,)
-    return MODES
+        modes = modes + (INT8_MODE,)
+    if getattr(model, "thompson_enabled", False):
+        modes = modes + (THOMPSON_MODE,)
+    return modes
 
 
 def _fused_fixed_margin(mesh_local: bool, dtype, theta_dims, theta_dtypes,
@@ -158,6 +175,79 @@ def build_scorer_fn(model: DeviceResidentModel, mode: str,
         import jax
         import jax.numpy as jnp
 
+        if mode == THOMPSON_MODE:
+            # in-program posterior sampling. Randomness is a counter
+            # hash, not a PRNG object: murmur3's 32-bit finalizer over
+            # (per-request seed halves, a per-coordinate tag, the
+            # coefficient's identity) yields the two uniforms Box-Muller
+            # turns into ONE standard normal per (request, coefficient).
+            # Keying on the coefficient identity (global column for
+            # fixed effects, (entity row, slot) for random effects)
+            # makes duplicate features sample the same theta-tilde draw
+            # — this is sampling the PARAMETER, not per-slot noise — and
+            # pad slots contribute nothing because their values are
+            # zero. Everything is uint32/f32 inside the jit, so the
+            # program runs without x64 and replays bitwise.
+            M1 = jnp.uint32(0x85EBCA6B)
+            M2 = jnp.uint32(0xC2B2AE35)
+            S16, S13 = jnp.uint32(16), jnp.uint32(13)
+            GOLD = jnp.uint32(0x9E3779B9)
+            TWO_PI = 6.283185307179586
+            INV_2_32 = 1.0 / 4294967296.0
+
+            def _mix(x):
+                x = x ^ (x >> S16)
+                x = x * M1
+                x = x ^ (x >> S13)
+                x = x * M2
+                return x ^ (x >> S16)
+
+            @jax.jit
+            def fn(fixed_idx, fixed_val, re_sidx, re_sval, re_ent,
+                   offsets, seed_hi, seed_lo, thetas, var_thetas,
+                   re_tables, re_var_tables):
+                sh = seed_hi.astype(jnp.uint32)[:, None]
+                sl = seed_lo.astype(jnp.uint32)[:, None]
+
+                def z_normal(key, tag):
+                    # key [B, P] uint32: coefficient identity
+                    k = _mix(key ^ _mix(jnp.uint32(tag) ^ sl))
+                    k = _mix(k ^ sh)
+                    k2 = _mix(k ^ GOLD)
+                    # +0.5 keeps both uniforms strictly inside (0, 1]
+                    # after the f32 round, so log/sqrt never see 0
+                    u1 = (k.astype(dtype) + 0.5) * INV_2_32
+                    u2 = (k2.astype(dtype) + 0.5) * INV_2_32
+                    return (jnp.sqrt(-2.0 * jnp.log(u1))
+                            * jnp.cos(TWO_PI * u2))
+
+                total = offsets.astype(dtype)
+                for j, pos in enumerate(fixed_pos):
+                    idx = fixed_idx[pos]
+                    val = fixed_val[pos].astype(dtype)
+                    theta = thetas[j][idx].astype(dtype)
+                    sigma = jnp.sqrt(var_thetas[j][idx].astype(dtype))
+                    z = z_normal(idx.astype(jnp.uint32), 2 * j + 1)
+                    total = total + jnp.sum(
+                        val * (theta + sigma * z), axis=-1)
+                for j, (coef, vcoef, sidx, sval, ent) in enumerate(
+                        zip(re_tables, re_var_tables, re_sidx,
+                            re_sval, re_ent)):
+                    rows = coef.at[ent].get(mode="fill", fill_value=0.0)
+                    vrows = vcoef.at[ent].get(mode="fill", fill_value=0.0)
+                    mu = jnp.take_along_axis(
+                        rows, sidx, axis=1).astype(dtype)
+                    sigma = jnp.sqrt(jnp.take_along_axis(
+                        vrows, sidx, axis=1).astype(dtype))
+                    key = (_mix(ent.astype(jnp.uint32)[:, None])
+                           ^ sidx.astype(jnp.uint32))
+                    z = z_normal(key, 2 * j + 2)
+                    total = total + jnp.sum(
+                        sval.astype(dtype) * (mu + sigma * z), axis=-1)
+                return total
+
+            return fn
+
         with_random = mode != "fixed_only"
         fused_fixed = _fused_fixed_margin(
             mesh_local, dtype, theta_dims, theta_dtypes, fixed_pos, k_total)
@@ -231,13 +321,32 @@ def tables_for_mode(model: DeviceResidentModel, mode: str) -> tuple:
     return model.current_tables()
 
 
-def dispatch(model: DeviceResidentModel, mode: str, bucket: int, args):
+def mode_args(model: DeviceResidentModel, mode: str, args,
+              seeds: Optional[tuple] = None) -> tuple:
+    """The FULL positional argument tuple for one (mode, batch): the
+    assemble output plus the mode's parameter arguments, in program
+    order. ``seeds`` is the thompson arm's (seed_hi, seed_lo) uint32
+    pair; None falls back to all-zero seeds of the batch width (warmup /
+    AOT lowering — shape-correct, values irrelevant). Same transfer_lock
+    contract as ``current_tables``."""
+    if mode == THOMPSON_MODE:
+        if seeds is None:
+            z = np.zeros(args[5].shape[0], np.uint32)
+            seeds = (z, z)
+        return args + (seeds[0], seeds[1], model.current_thetas(),
+                       model.current_var_thetas(), model.current_tables(),
+                       model.current_var_tables())
+    return args + (model.current_thetas(), tables_for_mode(model, mode))
+
+
+def dispatch(model: DeviceResidentModel, mode: str, bucket: int, args,
+             seeds: Optional[tuple] = None):
     """One scorer call with the model's current parameter arguments
     appended — the full calling convention in one place. Caller holds
     ``model.transfer_lock`` around assemble + this call (two-tier
     consistency)."""
     return get_scorer(model, mode, bucket)(
-        *args, model.current_thetas(), tables_for_mode(model, mode))
+        *mode_args(model, mode, args, seeds))
 
 
 def warmup_scorers(model: DeviceResidentModel,

@@ -70,6 +70,14 @@ class FallbackReason(str, enum.Enum):
     #: per-tenant requests-per-pump cap) is exhausted — THIS tenant's
     #: flood is bounded here so it cannot inflate its neighbors' tails
     TENANT_BUDGET_EXCEEDED = "tenant_budget_exceeded"
+    #: Thompson serving mode: the entity id is absent from the model
+    #: vocabulary, so the engine scored it with PRIOR-variance
+    #: exploration noise (zero mean contribution + ``sqrt(prior_variance)``
+    #: per feature) instead of silently at the mean — the explore half of
+    #: explore/exploit for cold-start entities. Typed distinctly from
+    #: UNKNOWN_ENTITY so operators can tell deliberate exploration from a
+    #: vocabulary miss in mean-mode serving.
+    EXPLORING_COLD_START = "exploring_cold_start"
     #: elastic fleet: the entity's virtual bucket is inside a live
     #: migration's double-read window — the request was scored off the
     #: source shard (authoritative) and mirrored to the destination for
@@ -373,3 +381,25 @@ class ServingConfig:
     #: coordinates keep their f32 hot tables (the cold tier is the
     #: capacity lever there). Off = exact f32 behavior, no extra tables.
     int8_serving: bool = False
+    #: OPT-IN Thompson-sampling serving: when the loaded model carries
+    #: posterior variances (bayes/laplace.py via the v3/v4 cold-store /
+    #: Avro variance columns), healthy traffic scores through the
+    #: "thompson" mode — each request samples ``theta ~ N(mu, sigma^2)``
+    #: INSIDE the compiled program from a counter-derived per-request
+    #: seed, so replays are bitwise and steady state stays zero-compile.
+    #: Takes precedence over the int8 arm; sheds still drop to
+    #: fixed_only. A var-less model under this flag serves the mean
+    #: exactly as before (the mode never activates). Full-resident
+    #: tables only: combining with a two-tier ``coeff_store`` on a
+    #: variance-carrying model is a typed refusal at load.
+    thompson_serving: bool = False
+    #: base seed for the per-request sampling keys: a request's
+    #: exploration draw is derived from ``request_key(thompson_seed,
+    #: uid)`` (utils/seeds.py), so a replay with the same seed and uids
+    #: reproduces every sampled score bitwise, independent of arrival
+    #: order or batch packing
+    thompson_seed: int = 0
+    #: prior variance served to cold-start entities in thompson mode: an
+    #: unknown entity's features get zero mean and this variance per
+    #: coefficient (the typed EXPLORING_COLD_START path)
+    prior_variance: float = 1.0

@@ -1,0 +1,74 @@
+"""Counter-derived deterministic seed streams — the ONE implementation.
+
+Every bitwise-determinism contract in the repo that needs randomness
+derives it from here: the replay traffic generators
+(``serving/replay.py``), the Thompson-sampling scorer's per-request
+seeds (``serving/scorer.py``), and any future consumer. There is no RNG
+object state anywhere — a draw is a pure function of
+``(seed, stream name, counter)``, so two runs (or a capture and its
+replay) can never drift apart.
+
+The kernel is splitmix64 (Steele et al.'s SplittableRandom finalizer):
+platform-independent pure-integer arithmetic, full 64-bit avalanche.
+Stream separation folds the crc32 of the stream name in with two odd
+multiplicative constants, exactly the construction serving/replay.py
+shipped in PR 18 — the functions here are bit-for-bit that code, moved,
+and the pinned forever-vectors in tests/test_seeds.py freeze them so
+the stream identity can never drift.
+
+``request_key`` is the Thompson-serving entry point: a stable 64-bit
+key per ``(seed, request uid)`` — derived from the request's *identity*
+(its uid string), never from arrival order, so asynchronous completion
+reordering between a capture and a replay cannot change any sample.
+``split32`` halves a key for jitted programs that must stay in uint32
+(serving runs without x64).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Tuple
+
+U64 = (1 << 64) - 1
+
+#: odd 64-bit mixing constants (golden-ratio increment + a Mersenne-ish
+#: companion) — part of the frozen stream identity, never change them
+GOLDEN = 0x9E3779B97F4A7C15
+STREAM_MIX = 0xD1342543DE82EF95
+
+
+def splitmix64(x: int) -> int:
+    """Pure-integer splitmix64 finalizer — platform-independent, no RNG
+    object state."""
+    x = (x + GOLDEN) & U64
+    z = x
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & U64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & U64
+    return (z ^ (z >> 31)) & U64
+
+
+def stream_key(seed: int, stream: str, i: int) -> int:
+    """The 64-bit key of draw ``i`` of named stream ``stream`` under
+    ``seed`` — the pre-finalizer combination ``_u`` has always used."""
+    return (seed * GOLDEN + zlib.crc32(stream.encode()) * STREAM_MIX
+            + i) & U64
+
+
+def stream_u(seed: int, stream: str, i: int) -> float:
+    """Uniform in (0, 1): splitmix64 over (seed, named stream, counter).
+    Never exactly 0 (log-safe) or 1."""
+    return (splitmix64(stream_key(seed, stream, i)) + 1) / (2.0 ** 64 + 2)
+
+
+def request_key(seed: int, uid: str) -> int:
+    """Stable finalized 64-bit key for one scoring request: a function
+    of the request's uid string (its identity), not its arrival slot —
+    replays sample identically however completions interleave."""
+    return splitmix64(stream_key(seed, uid, 0))
+
+
+def split32(key: int) -> Tuple[int, int]:
+    """(hi, lo) uint32 halves of a 64-bit key, for programs that must
+    stay in 32-bit integer arithmetic (serving runs without x64)."""
+    key &= U64
+    return (key >> 32) & 0xFFFFFFFF, key & 0xFFFFFFFF

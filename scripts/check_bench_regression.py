@@ -59,6 +59,9 @@ KEY_METRICS = {
     "BENCH_ELASTIC_r01.json": {
         "metric": "elastic_migration_gates_passed",
         "direction": "higher", "hard_floor": 1.0},
+    "BENCH_BAYES_r01.json": {
+        "metric": "bayes_gates_passed",
+        "direction": "higher", "hard_floor": 1.0},
     "BENCH_COLDTIER_r01.json": {
         "metric": "coldtier_steady_hit_rate",
         "direction": "higher", "hard_floor": 0.5},

@@ -73,6 +73,12 @@ SCAN_DIRS = (
     # staging fence, marked; game/ walk covers block_stream.py and the
     # swept solve loops in coordinate.py)
     os.path.join(REPO, "photon_tpu", "parallel", "memory.py"),
+    # Bayesian Laplace pass: the streamed fixed-effect accumulator rides
+    # the same chunk pipeline (one deliberate finalize read, marked) and
+    # the blocked RE variance pass reuses the prefetcher staging — a
+    # host sync inside either loop would serialize variance extraction
+    # behind compute
+    os.path.join(REPO, "photon_tpu", "bayes"),
 )
 MARKER = "host-sync-ok"
 
@@ -171,9 +177,9 @@ def main() -> int:
             print(f"  {v}")
         return 1
     print("ok: no host-sync primitives in photon_tpu/optim, "
-          "photon_tpu/game, photon_tpu/function, the streaming chunk "
-          "loop, the mmap data store, the RE-sweep HBM planner, or the "
-          "serving hot path")
+          "photon_tpu/game, photon_tpu/function, photon_tpu/bayes, the "
+          "streaming chunk loop, the mmap data store, the RE-sweep HBM "
+          "planner, or the serving hot path")
     return 0
 
 

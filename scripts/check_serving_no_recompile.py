@@ -142,10 +142,12 @@ def _jitted_programs(model, ladder):
     return [f for f in jitted if hasattr(f, "_cache_size")]
 
 
-def build_model_dir(seed: int, out_dir: str):
+def build_model_dir(seed: int, out_dir: str, variances: bool = False):
     """Synthetic GAME model SAVED to disk with per-coordinate cold stores
     and feature-index sidecars — the two-tier arm's loading unit. Returns
-    the feature names for request building."""
+    the feature names for request building. With ``variances`` the model
+    carries posterior-variance columns (the Thompson arm's loading
+    unit)."""
     import numpy as np
     import jax.numpy as jnp
 
@@ -169,13 +171,20 @@ def build_model_dir(seed: int, out_dir: str):
     proj = np.zeros((E, K), np.int32)
     for e in range(E):
         proj[e] = np.sort(rng.choice(D, size=K, replace=False))
+    fvar = (jnp.asarray(np.abs(rng.normal(size=D)).astype(np.float32) * 0.1)
+            if variances else None)
     fixed = FixedEffectModel(
         GeneralizedLinearModel(
-            Coefficients(jnp.asarray(rng.normal(size=D).astype(np.float32))),
+            Coefficients(jnp.asarray(rng.normal(size=D).astype(np.float32)),
+                         fvar),
             TaskType.LINEAR_REGRESSION), "shardA")
+    rvar = (jnp.asarray(np.abs(rng.normal(size=(E, K))).astype(np.float32)
+                        * 0.05)
+            if variances else None)
     rem = RandomEffectModel(
         coefficients=jnp.asarray(coef), random_effect_type="userId",
-        feature_shard_id="shardA", task=TaskType.LINEAR_REGRESSION)
+        feature_shard_id="shardA", task=TaskType.LINEAR_REGRESSION,
+        variances=rvar)
     vocab = EntityVocabulary()
     vocab.build("userId", [f"u{e}" for e in range(E)])
     save_game_model(out_dir, GameModel({"global": fixed, "per-user": rem}),
@@ -549,6 +558,153 @@ def int8_arm(baseline, registry, compile_cache) -> list:
             print(f"ok: int8 arm served {served} over "
                   f"{n_modes} modes, swap to v{result.version} "
                   f"(int8_shadow=pass), steady-state compiles=0")
+    return failures
+
+
+def thompson_arm(baseline, registry, compile_cache) -> list:
+    """Same contract with Thompson explore/exploit serving active: the
+    model carries posterior variances, so the warmed set gains the
+    thompson programs (in-program counter-hash sampling). Traffic covers
+    every bucket, cold entities (typed EXPLORING_COLD_START exploration),
+    and a full bitwise replay — sampling is seeded per request, so the
+    SAME requests must reproduce the SAME scores with every compile
+    monitor frozen. A mid-run swap to a second variance-carrying model
+    restages the thompson tables through the gate ladder at zero
+    steady-state cost."""
+    import tempfile
+
+    import numpy as np
+
+    from photon_tpu.io.model_io import load_for_serving
+    from photon_tpu.serving import (
+        FallbackReason,
+        ScoreRequest,
+        ServingConfig,
+        ServingEngine,
+        SLOConfig,
+    )
+    from photon_tpu.serving.scorer import serving_modes
+    from photon_tpu.serving.swap import swap_staged
+
+    failures = []
+    with tempfile.TemporaryDirectory(prefix="thompson_ck_") as td:
+        import os as _os
+        d1, d2 = _os.path.join(td, "v1"), _os.path.join(td, "v2")
+        names = build_model_dir(7, d1, variances=True)
+        build_model_dir(23, d2, variances=True)
+        engine = ServingEngine.from_model_dir(d1, config=ServingConfig(
+            max_batch=8, max_wait_s=0.0, thompson_serving=True,
+            thompson_seed=5,
+            slo=SLOConfig(shed_queue_depth=6, reject_queue_depth=100)))
+        info = engine.warmup()
+        if "thompson" not in info["modes"]:
+            engine.shutdown()
+            return [f"thompson arm: thompson missing from warmed modes "
+                    f"{info['modes']}"]
+        n_modes = len(serving_modes(engine.model))
+        if info["programs"] != len(engine.ladder.buckets) * n_modes:
+            engine.shutdown()
+            return [f"thompson arm: warmed {info['programs']} programs, "
+                    f"expected {len(engine.ladder.buckets) * n_modes}"]
+
+        baseline = compile_cache.compile_counts()
+        misses0 = registry.counter("jitcache.misses").value
+        jitted = _jitted_programs(engine.model, engine.ladder)
+        traces0 = [f._cache_size() for f in jitted]
+
+        rng = np.random.default_rng(43)
+
+        def req(uid, n_feats, user):
+            feats = [(str(names[j]), "", float(rng.normal()))
+                     for j in rng.choice(len(names), size=n_feats,
+                                         replace=False)]
+            return ScoreRequest(uid, {"shardA": feats},
+                                {"userId": user} if user else {})
+
+        # fixed request set: every bucket full + partial, cold entities
+        batches = []
+        for n in range(1, engine.ladder.max_batch + 1):
+            batches.append([req(f"t{n}-{i}",
+                                int(rng.integers(0, len(names))),
+                                f"u{i % 5}" if i % 3 else "cold-entity")
+                            for i in range(n)])
+
+        def serve_all():
+            scores, reasons = {}, {}
+            for b in batches:
+                for r in engine.serve(b):
+                    scores[r.uid] = r.score
+                    reasons[r.uid] = sorted(f.reason.value
+                                            for f in r.fallbacks)
+            return scores, reasons
+
+        s1, r1 = serve_all()
+        s2, _ = serve_all()
+        served = 2 * sum(len(b) for b in batches)
+        if s1 != s2:
+            diff = [u for u in s1 if s1[u] != s2[u]]
+            failures.append(f"thompson replay not bitwise: {len(diff)} "
+                            f"score(s) differ, e.g. {diff[:3]}")
+        cold = [u for u, rs in r1.items()
+                if FallbackReason.EXPLORING_COLD_START.value in rs]
+        if not cold:
+            failures.append("thompson arm: no cold entity drew the typed "
+                            "EXPLORING_COLD_START exploration reason")
+        if any(FallbackReason.UNKNOWN_ENTITY.value in rs
+               for rs in r1.values()):
+            failures.append("thompson arm: cold entity fell back to "
+                            "UNKNOWN_ENTITY instead of exploring")
+        # shed mode still compiles nothing with thompson active
+        for i in range(engine.config.slo.shed_queue_depth + 3):
+            engine.submit(req(f"ts{i}", 4, f"u{i % 5}"))
+        served += len(engine.drain())
+
+        after = compile_cache.compile_counts()
+        misses1 = registry.counter("jitcache.misses").value
+        traces1 = [f._cache_size() for f in jitted]
+        if after["steady_state"] != baseline["steady_state"]:
+            failures.append(
+                f"thompson steady-state compiles moved: "
+                f"{baseline['steady_state']} -> {after['steady_state']}")
+        if misses1 != misses0:
+            failures.append(f"thompson jitcache.misses moved: "
+                            f"{misses0} -> {misses1}")
+        for i, (t0, t1) in enumerate(zip(traces0, traces1)):
+            if t1 > t0:
+                failures.append(f"thompson program {i} re-traced: "
+                                f"_cache_size {t0} -> {t1}")
+
+        # live swap to a second variance-carrying model: staged thompson
+        # programs are warmup-tagged; steady-state stays frozen
+        result = swap_staged(engine, load_for_serving(d2), "v2")
+        if not result.accepted:
+            failures.append(f"thompson swap rejected: {result.reason} "
+                            f"(gates {result.gates})")
+        else:
+            misses2 = registry.counter("jitcache.misses").value
+            jitted += _jitted_programs(engine.model, engine.ladder)
+            traces2 = [f._cache_size() for f in jitted]
+            for b in batches:
+                served += len(engine.serve(b))
+            final = compile_cache.compile_counts()
+            if final["steady_state"] != baseline["steady_state"]:
+                failures.append(
+                    f"thompson post-swap steady-state compiles moved: "
+                    f"{baseline['steady_state']} -> "
+                    f"{final['steady_state']}")
+            if registry.counter("jitcache.misses").value != misses2:
+                failures.append("thompson post-swap jitcache.misses moved")
+            for i, (t0, t1) in enumerate(
+                    zip(traces2, [f._cache_size() for f in jitted])):
+                if t1 > t0:
+                    failures.append(f"thompson post-swap program {i} "
+                                    f"re-traced: {t0} -> {t1}")
+        engine.shutdown()
+        if not failures:
+            print(f"ok: thompson arm served {served} over {n_modes} modes "
+                  f"(replay bitwise, {len(cold)} typed cold-start "
+                  f"explorations), swap to v{result.version}, "
+                  f"steady-state compiles=0")
     return failures
 
 
@@ -1119,6 +1275,15 @@ def main() -> int:
     if i8_failures:
         print("FAIL: int8 serving compiled:")
         for f in i8_failures:
+            print("  " + f)
+        return 1
+
+    # -- Thompson explore/exploit arm: posterior-sampling programs join
+    # the warmed set; replays are bitwise and still compile-free
+    th_failures = thompson_arm(baseline, registry, compile_cache)
+    if th_failures:
+        print("FAIL: thompson serving compiled:")
+        for f in th_failures:
             print("  " + f)
         return 1
 
